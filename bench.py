@@ -1,0 +1,62 @@
+"""Headline benchmark: single-chip bf16 16k×16k matmul TFLOPS.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} for the
+driver. The baseline is the reference's headline number: ~140 TFLOPS for a
+single RTX 6000 Ada doing bf16 16384×16384 `torch.matmul`
+(reference README.md:43, BASELINE.md). Protocol matches the reference's:
+10 warmup + 50 timed iterations (run_scaling_benchmark.sh:16-19).
+
+Runs on the real TPU chip (no platform override). Picks the best of the XLA
+and Pallas matmul implementations.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+
+BASELINE_TFLOPS = 140.0  # reference README.md:43 — 1× RTX 6000 Ada, bf16 16k
+
+
+def main() -> None:
+    from tpu_matmul_bench.utils.config import parse_config
+    from tpu_matmul_bench.benchmarks.matmul_benchmark import run
+
+    size = 16384
+    best = 0.0
+    for impl in ("xla", "pallas"):
+        try:
+            config = parse_config(
+                [
+                    "--sizes", str(size),
+                    "--dtype", "bfloat16",
+                    "--iterations", "50",
+                    "--warmup", "10",
+                    "--num-devices", "1",
+                    "--matmul-impl", impl,
+                ],
+                description="bench",
+            )
+            # keep stdout clean for the single JSON line; human report → stderr
+            with contextlib.redirect_stdout(sys.stderr):
+                records = run(config)
+            if records:
+                best = max(best, records[0].tflops_per_device)
+        except Exception as e:  # noqa: BLE001 — one impl failing shouldn't zero the bench
+            print(f"[bench] impl {impl} failed: {e}", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "bf16_matmul_16k_tflops_per_chip",
+                "value": round(best, 2),
+                "unit": "TFLOPS",
+                "vs_baseline": round(best / BASELINE_TFLOPS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Basic matmul benchmark launcher ≙ reference `run_benchmark.sh`.
+# Usage: ./run_benchmark.sh [NUM_DEVICES] [DTYPE] [--device=tpu|cpu|gpu]
+#
+# The reference branches single-process vs `torch.distributed.run` with one
+# process per GPU (run_benchmark.sh:13-27); under single-controller JAX one
+# process drives every chip, so NUM_DEVICES simply caps the device count.
+# --device=tpu drives a TPU slice with no GPU in the loop (BASELINE.json).
+set -euo pipefail
+
+NUM_DEVICES=${1:-1}
+DTYPE=${2:-bfloat16}
+DEVICE_FLAG=()
+EXTRA=()
+for arg in "${@:3}"; do
+  case "$arg" in
+    --device=*) DEVICE_FLAG=(--device "${arg#--device=}") ;;
+    *) EXTRA+=("$arg") ;;  # forwarded verbatim (e.g. --sizes 256 512)
+  esac
+done
+
+echo "Running matmul benchmark on ${NUM_DEVICES} device(s), dtype=${DTYPE}"
+exec python3 -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --num-devices "${NUM_DEVICES}" --dtype "${DTYPE}" "${DEVICE_FLAG[@]}" "${EXTRA[@]}"

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Distributed benchmark launcher ≙ reference `backup/run_distributed_benchmark.sh`.
+# Usage: ./run_distributed_benchmark.sh [NUM_DEVICES] [MODE] [DTYPE] [--device=tpu]
+#   MODE ∈ {independent, data_parallel, model_parallel}
+set -euo pipefail
+
+NUM_DEVICES=${1:-1}
+MODE=${2:-data_parallel}
+DTYPE=${3:-bfloat16}
+DEVICE_FLAG=()
+EXTRA=()
+for arg in "${@:4}"; do
+  case "$arg" in
+    --device=*) DEVICE_FLAG=(--device "${arg#--device=}") ;;
+    *) EXTRA+=("$arg") ;;  # forwarded verbatim (e.g. --sizes 256 512)
+  esac
+done
+
+echo "Running distributed benchmark: ${NUM_DEVICES} device(s), mode=${MODE}, dtype=${DTYPE}"
+exec python3 -m tpu_matmul_bench.benchmarks.matmul_distributed_benchmark \
+  --num-devices "${NUM_DEVICES}" --mode "${MODE}" --dtype "${DTYPE}" "${DEVICE_FLAG[@]}" "${EXTRA[@]}"

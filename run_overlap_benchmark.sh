@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Overlap benchmark launcher ≙ reference `backup/run_overlap_benchmark.sh`.
+# Usage: ./run_overlap_benchmark.sh [NUM_DEVICES] [MODE] [DTYPE] [--device=tpu]
+#   MODE ∈ {no_overlap, overlap, pipeline, collective_matmul}
+set -euo pipefail
+
+NUM_DEVICES=${1:-1}
+MODE=${2:-overlap}
+DTYPE=${3:-bfloat16}
+DEVICE_FLAG=()
+EXTRA=()
+for arg in "${@:4}"; do
+  case "$arg" in
+    --device=*) DEVICE_FLAG=(--device "${arg#--device=}") ;;
+    *) EXTRA+=("$arg") ;;  # forwarded verbatim (e.g. --sizes 256 512)
+  esac
+done
+
+echo "Running overlap benchmark: ${NUM_DEVICES} device(s), mode=${MODE}, dtype=${DTYPE}"
+exec python3 -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \
+  --num-devices "${NUM_DEVICES}" --mode "${MODE}" --dtype "${DTYPE}" "${DEVICE_FLAG[@]}" "${EXTRA[@]}"

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Scaling benchmark launcher ≙ reference `run_scaling_benchmark.sh:3-5`
+# (positional NUM_GPUS MODE DTYPE), plus --device=tpu (BASELINE.json).
+# Usage: ./run_scaling_benchmark.sh [NUM_DEVICES] [MODE] [DTYPE] [--device=tpu]
+#   MODE ∈ {independent, batch_parallel, matrix_parallel}
+set -euo pipefail
+
+NUM_DEVICES=${1:-1}
+MODE=${2:-independent}
+DTYPE=${3:-bfloat16}
+DEVICE_FLAG=()
+EXTRA=()
+for arg in "${@:4}"; do
+  case "$arg" in
+    --device=*) DEVICE_FLAG=(--device "${arg#--device=}") ;;
+    *) EXTRA+=("$arg") ;;  # forwarded verbatim (e.g. --sizes 256 512)
+  esac
+done
+
+echo "Running scaling benchmark: ${NUM_DEVICES} device(s), mode=${MODE}, dtype=${DTYPE}"
+exec python3 -m tpu_matmul_bench.benchmarks.matmul_scaling_benchmark \
+  --num-devices "${NUM_DEVICES}" --mode "${MODE}" --dtype "${DTYPE}" "${DEVICE_FLAG[@]}" "${EXTRA[@]}"

@@ -1,0 +1,36 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+This is the idiomatic JAX answer to "test multi-device without a cluster"
+(SURVEY §4): `--xla_force_host_platform_device_count=8` splits the host CPU
+into 8 XLA devices, so every sharded mode, collective, and the overlap suite
+run with real collectives, no TPU required. Must happen before the first
+backend initialization, hence module scope in conftest.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+# The container's sitecustomize registers the TPU backend and forces
+# jax_platforms=axon; tests always run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, "tests expect the 8-device virtual CPU mesh"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh(devices):
+    from tpu_matmul_bench.parallel.mesh import make_mesh
+
+    return make_mesh(devices)

@@ -1,0 +1,58 @@
+"""Collective layer tests on the virtual 8-device mesh (SURVEY I2, §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_matmul_bench.parallel.collectives import (
+    all_gather_over,
+    pmean_over,
+    psum_over,
+    verify_collectives,
+)
+from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal, world_size
+from jax.sharding import PartitionSpec as P
+
+
+def test_verify_collectives_passes(mesh):
+    assert verify_collectives(mesh, verbose=False)
+
+
+def test_psum(mesh):
+    n = world_size(mesh)
+    x = jnp.arange(1.0, n + 1)
+    out = np.asarray(psum_over(mesh)(x))
+    assert np.allclose(out, n * (n + 1) / 2)
+
+
+def test_pmean(mesh):
+    n = world_size(mesh)
+    x = jnp.arange(1.0, n + 1)
+    out = np.asarray(pmean_over(mesh)(x))
+    assert np.allclose(out, (n + 1) / 2)
+
+
+def test_all_gather(mesh):
+    n = world_size(mesh)
+    x = jnp.arange(float(n)) * 2
+    out = np.asarray(all_gather_over(mesh)(x))
+    assert np.allclose(out, np.arange(n) * 2.0)
+
+
+def test_mesh_shapes(devices):
+    m1 = make_mesh(devices)
+    assert m1.shape == {"x": 8}
+    m2 = make_mesh(devices, axis_names=("dp", "tp"), shape=(2, 4))
+    assert m2.shape == {"dp": 2, "tp": 4}
+    with pytest.raises(ValueError):
+        make_mesh(devices, axis_names=("dp", "tp"), shape=(3, 4))
+
+
+def test_sharded_normal_distinct_shards(mesh):
+    (a,) = sharded_normal(0, (8, 16, 16), jnp.float32, mesh, P("x"), count=1)
+    host = np.asarray(a)
+    # per-device slices differ (≙ torch.manual_seed(rank) distinctness,
+    # reference matmul_scaling_benchmark.py:73)
+    assert not np.allclose(host[0], host[1])
+    # sharded over the mesh axis
+    assert len(a.sharding.device_set) == 8

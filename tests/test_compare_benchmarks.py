@@ -1,0 +1,37 @@
+"""Comparison driver e2e on the CPU mesh (SURVEY I11) — consumes structured
+records, no stdout scraping."""
+
+import json
+
+from tpu_matmul_bench.benchmarks import compare_benchmarks
+
+
+def test_compare_small(tmp_path):
+    out = tmp_path / "cmp.jsonl"
+    results = compare_benchmarks.main(
+        ["--size", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", "--json-out", str(out)]
+    )
+    # all nine comparison points measured
+    expected = {"single", "independent", "batch_parallel", "matrix_parallel",
+                "no_overlap", "overlap", "pipeline", "collective_matmul"}
+    assert expected <= set(results)
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert {l["comparison_key"] for l in lines} >= expected
+    assert all(l["tflops_total"] > 0 for l in lines)
+
+
+def test_summarize_table():
+    from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+
+    def rec(mode, t):
+        return BenchmarkRecord(
+            benchmark="x", mode=mode, size=64, dtype="float32", world=8,
+            iterations=1, warmup=1, avg_time_s=t, tflops_per_device=1.0,
+            tflops_total=8.0,
+        )
+
+    s = compare_benchmarks.summarize(
+        {"no_overlap": rec("no_overlap", 0.2), "overlap": rec("overlap", 0.1)}
+    )
+    assert "Overlap hides 50.0%" in s

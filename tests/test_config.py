@@ -1,0 +1,73 @@
+"""CLI parsing tests (SURVEY I9)."""
+
+import jax.numpy as jnp
+import pytest
+
+from tpu_matmul_bench.utils.config import (
+    DEFAULT_SIZES,
+    parse_config,
+    parse_dtype,
+)
+
+
+def test_defaults_match_reference():
+    # defaults ≙ reference matmul_benchmark.py:157-165
+    cfg = parse_config([], "d")
+    assert cfg.sizes == DEFAULT_SIZES == [4096, 8192, 16384]
+    assert cfg.iterations == 50
+    assert cfg.warmup == 10
+    assert cfg.dtype_name == "bfloat16"
+    assert cfg.dtype == jnp.bfloat16
+    assert cfg.mode is None
+    assert cfg.device is None
+    assert cfg.matmul_impl == "xla"
+
+
+def test_flags():
+    cfg = parse_config(
+        [
+            "--sizes", "128", "256",
+            "--iterations", "7",
+            "--warmup", "2",
+            "--dtype", "float32",
+            "--device", "tpu",
+            "--num-devices", "4",
+            "--json-out", "out.jsonl",
+            "--matmul-impl", "pallas",
+            "--seed", "3",
+        ],
+        "d",
+    )
+    assert cfg.sizes == [128, 256]
+    assert cfg.iterations == 7
+    assert cfg.warmup == 2
+    assert cfg.dtype == jnp.float32
+    assert cfg.device == "tpu"
+    assert cfg.num_devices == 4
+    assert cfg.json_out == "out.jsonl"
+    assert cfg.matmul_impl == "pallas"
+    assert cfg.seed == 3
+
+
+def test_modes():
+    cfg = parse_config(
+        ["--mode", "batch_parallel"],
+        "d",
+        modes=["independent", "batch_parallel", "matrix_parallel"],
+        default_mode="independent",
+    )
+    assert cfg.mode == "batch_parallel"
+    cfg = parse_config(
+        [], "d", modes=["independent", "batch_parallel"], default_mode="independent"
+    )
+    assert cfg.mode == "independent"
+    with pytest.raises(SystemExit):
+        parse_config(["--mode", "bogus"], "d", modes=["independent"])
+
+
+def test_parse_dtype():
+    assert parse_dtype("bfloat16") == jnp.bfloat16
+    assert parse_dtype("float16") == jnp.float16
+    assert parse_dtype("float32") == jnp.float32
+    with pytest.raises(ValueError):
+        parse_dtype("int8")

@@ -1,0 +1,52 @@
+"""End-to-end test of the basic benchmark program on the CPU mesh."""
+
+import json
+
+from tpu_matmul_bench.benchmarks import matmul_benchmark
+
+
+def _argv(tmp_path, extra=()):
+    return [
+        "--sizes", "64", "128",
+        "--iterations", "3",
+        "--warmup", "1",
+        "--dtype", "float32",
+        "--json-out", str(tmp_path / "out.jsonl"),
+        *extra,
+    ]
+
+
+def test_single_device(tmp_path):
+    recs = matmul_benchmark.main(_argv(tmp_path, ["--num-devices", "1"]))
+    assert [r.size for r in recs] == [64, 128]
+    assert all(r.world == 1 for r in recs)
+    assert all(r.tflops_total > 0 for r in recs)
+    lines = (tmp_path / "out.jsonl").read_text().splitlines()
+    assert len(lines) == 2
+    parsed = json.loads(lines[0])
+    assert parsed["benchmark"] == "matmul"
+    assert parsed["mode"] == "single"
+
+
+def test_all_devices(tmp_path):
+    recs = matmul_benchmark.main(_argv(tmp_path))
+    assert all(r.world == 8 for r in recs)
+    # total = 8 × per-device (≙ all_reduce SUM of TFLOPS,
+    # reference matmul_benchmark.py:110-121)
+    for r in recs:
+        assert r.tflops_total == 8 * r.tflops_per_device
+
+
+def test_oom_resilience(tmp_path, monkeypatch):
+    # A size that fails mid-sweep is skipped and the sweep continues
+    # (≙ reference matmul_scaling_benchmark.py:337-342).
+    orig = matmul_benchmark._bench_single
+
+    def failing(config, size, kind, device=None):
+        if size == 64:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory (simulated)")
+        return orig(config, size, kind, device)
+
+    monkeypatch.setattr(matmul_benchmark, "_bench_single", failing)
+    recs = matmul_benchmark.main(_argv(tmp_path, ["--num-devices", "1"]))
+    assert [r.size for r in recs] == [128]

@@ -1,0 +1,65 @@
+"""Unit tests for the metrics math (SURVEY I4) — the reference has no tests;
+these cover the formulas its README numbers are derived from."""
+
+import jax.numpy as jnp
+import pytest
+
+from tpu_matmul_bench.utils.metrics import (
+    bytes_per_element,
+    calculate_tflops,
+    matmul_flops,
+    matrix_memory_gib,
+    scaling_efficiency,
+    theoretical_peak_tflops,
+)
+
+
+def test_matmul_flops_square():
+    # 2n³ ≙ reference matmul_benchmark.py:34-37; README's 4k/8k/16k work table
+    assert matmul_flops(4096) == pytest.approx(0.14e12, rel=0.05)
+    assert matmul_flops(8192) == pytest.approx(1.10e12, rel=0.01)
+    assert matmul_flops(16384) == pytest.approx(8.80e12, rel=0.01)
+
+
+def test_matmul_flops_rectangular():
+    assert matmul_flops(2, 3, 4) == 2 * 2 * 3 * 4
+
+
+def test_calculate_tflops():
+    # 2·16384³ FLOPs in 1s = 8.796 TFLOPS
+    assert calculate_tflops(16384, 1.0) == pytest.approx(8.796, rel=1e-3)
+    # num_ops multiplies (≙ bmm batch, matmul_scaling_benchmark.py:63-67)
+    assert calculate_tflops(16384, 1.0, num_ops=2) == pytest.approx(2 * 8.796, rel=1e-3)
+    assert calculate_tflops(1024, 0.0) == float("inf")
+
+
+def test_bytes_per_element():
+    assert bytes_per_element(jnp.float32) == 4
+    assert bytes_per_element(jnp.bfloat16) == 2
+    assert bytes_per_element(jnp.float16) == 2
+
+
+def test_matrix_memory_gib():
+    # 16384² bf16 = 0.5 GiB ≙ reference matmul_benchmark.py:99-103
+    assert matrix_memory_gib(16384, jnp.bfloat16) == pytest.approx(0.5)
+    assert matrix_memory_gib(16384, jnp.float32) == pytest.approx(1.0)
+    assert matrix_memory_gib(16384, jnp.bfloat16, count=3) == pytest.approx(1.5)
+
+
+def test_theoretical_peaks():
+    assert theoretical_peak_tflops("TPU v5 lite", jnp.bfloat16) == 197.0
+    assert theoretical_peak_tflops("TPU v4", jnp.bfloat16) == 275.0
+    # GPU parity constants ≙ reference matmul_benchmark.py:133-139
+    assert theoretical_peak_tflops("NVIDIA RTX 6000 Ada Generation", jnp.float32) == 91.1
+    assert theoretical_peak_tflops("AMD Radeon RX 7900 XTX", jnp.bfloat16) == 123.0
+    assert theoretical_peak_tflops("Mystery Device 9000", jnp.bfloat16) is None
+    # TPUs publish no fp32 matmul peak → None, efficiency line suppressed
+    assert theoretical_peak_tflops("TPU v5 lite", jnp.float32) is None
+
+
+def test_scaling_efficiency():
+    # total == single·world → 100% ≙ matmul_scaling_benchmark.py:315
+    assert scaling_efficiency(200.0, 100.0, 2) == pytest.approx(100.0)
+    assert scaling_efficiency(170.0, 100.0, 2) == pytest.approx(85.0)
+    assert scaling_efficiency(100.0, 0.0, 2) is None
+    assert scaling_efficiency(100.0, 100.0, 0) is None

@@ -1,0 +1,53 @@
+"""Pallas matmul kernel correctness (interpret mode on the CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_matmul_bench.ops.matmul import make_matmul, random_operands
+from tpu_matmul_bench.ops.pallas_matmul import _pick_block, pallas_matmul
+
+
+def test_pick_block():
+    assert _pick_block(4096, 512) == 512
+    assert _pick_block(256, 512) == 256
+    assert _pick_block(384, 512) == 128
+    assert _pick_block(7, 512) == 7  # odd tiny dim → single block
+
+
+@pytest.mark.parametrize("size", [128, 256])
+def test_matches_xla_matmul(size):
+    a, b = random_operands(0, (size, size), jnp.float32)
+    got = np.asarray(pallas_matmul(a, b))
+    want = np.asarray(a @ b)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_rectangular_and_multiblock():
+    a, b = random_operands(1, (128, 64), jnp.float32, count=1) + random_operands(
+        2, (64, 256), jnp.float32, count=1
+    )
+    got = np.asarray(pallas_matmul(a, b, block_m=64, block_n=128, block_k=32))
+    np.testing.assert_allclose(got, np.asarray(a @ b), rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_accumulates_fp32():
+    # fp32 accumulation: ones(256)·ones(256) sums 256 exactly even in bf16
+    a = jnp.ones((256, 256), jnp.bfloat16)
+    got = np.asarray(pallas_matmul(a, a, block_k=128).astype(jnp.float32))
+    np.testing.assert_array_equal(got, 256.0)
+
+
+def test_make_matmul_pallas_path():
+    mm = make_matmul("pallas")
+    a, b = random_operands(3, (128, 128), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(mm(a, b)), np.asarray(a @ b), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_bad_shapes():
+    a = jnp.ones((4, 8))
+    with pytest.raises(ValueError):
+        pallas_matmul(a, jnp.ones((4, 8)))

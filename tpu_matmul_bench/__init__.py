@@ -1,0 +1,21 @@
+"""tpu_matmul_bench — a TPU-native matmul scaling benchmark framework.
+
+A brand-new JAX/XLA/Pallas re-design of the capability surface of the
+PyTorch/CUDA reference `Rajakoduri-Mihira/pytorch-distributed-matmul-benchmark`
+(surveyed in SURVEY.md):
+
+- single-device dense matmul benchmarks (float32/float16/bfloat16, size sweep)
+- multi-chip scaling modes (independent, batch_parallel, matrix_parallel,
+  data_parallel, model_parallel) expressed as `shard_map`/`pjit` shardings over
+  a `jax.sharding.Mesh`, with XLA collectives over ICI
+- an overlap suite (no_overlap, overlap, pipeline) built on XLA's async
+  collectives and a ppermute-overlapped collective matmul, plus Pallas kernels
+- compute-vs-communication split timing, TFLOPS / scaling-efficiency /
+  memory reporting, collective verification, structured JSON results
+
+The reference is 100% Python over torch/NCCL (SURVEY.md §2: no native
+components); the native layer here is XLA-compiled jnp/Pallas kernels and XLA
+ICI collectives, which is the idiomatic TPU equivalent.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+"""Benchmark programs (SURVEY L2/L3 harness layer).
+
+Four programs mirror the reference's four scripts, sharing one core instead
+of copy-pasting it:
+
+- ``matmul_benchmark``       ≙ reference `matmul_benchmark.py`
+- ``matmul_scaling_benchmark``     ≙ `matmul_scaling_benchmark.py`
+- ``matmul_distributed_benchmark`` ≙ `backup/matmul_distributed_benchmark.py`
+- ``matmul_overlap_benchmark``     ≙ `backup/matmul_overlap_benchmark.py`
+- ``compare_benchmarks``     ≙ `backup/compare_benchmarks.py` (reads JSON,
+  not scraped stdout)
+
+Each has a `main(argv)` entry and is runnable as
+`python -m tpu_matmul_bench.benchmarks.<name>`.
+"""

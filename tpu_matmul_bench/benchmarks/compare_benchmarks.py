@@ -1,0 +1,124 @@
+"""Comparison driver ≙ reference `backup/compare_benchmarks.py` (SURVEY I11/L4).
+
+The reference subprocess-spawns the launchers and greps stdout for the
+16384×16384 block (`compare_benchmarks.py:17-26`). Here the benchmarks are
+invoked in-process and their *structured* records are compared directly — no
+scraping (SURVEY §5 recommends exactly this). The qualitative summary
+(overlap ≥ no_overlap, both below independent; `compare_benchmarks.py:51-63`)
+is derived from the measured numbers instead of asserted as prose.
+
+Run: python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
+        [--size 16384] [--num-devices N] [--dtype bfloat16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Sequence
+
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord, report
+
+
+def _run(module_main, argv: list[str]) -> list[BenchmarkRecord]:
+    try:
+        return module_main(argv)
+    except SystemExit:
+        return []
+
+
+def compare(size: int, dtype: str, num_devices: int | None,
+            iterations: int, warmup: int) -> dict[str, BenchmarkRecord]:
+    from tpu_matmul_bench.benchmarks import (
+        matmul_benchmark,
+        matmul_overlap_benchmark,
+        matmul_scaling_benchmark,
+    )
+
+    common = ["--sizes", str(size), "--dtype", dtype,
+              "--iterations", str(iterations), "--warmup", str(warmup)]
+    base = common + (["--num-devices", str(num_devices)] if num_devices else [])
+
+    results: dict[str, BenchmarkRecord] = {}
+
+    # the 'single' row is the per-chip baseline — always exactly 1 device
+    report("\n### single-device matmul " + "#" * 40)
+    for rec in _run(matmul_benchmark.main, common + ["--num-devices", "1"]):
+        results["single"] = rec
+
+    for mode in ("independent", "batch_parallel", "matrix_parallel"):
+        report(f"\n### scaling: {mode} " + "#" * 40)
+        for rec in _run(matmul_scaling_benchmark.main, base + ["--mode", mode]):
+            results[mode] = rec
+
+    for mode in ("no_overlap", "overlap", "pipeline", "collective_matmul"):
+        report(f"\n### overlap: {mode} " + "#" * 40)
+        for rec in _run(matmul_overlap_benchmark.main, base + ["--mode", mode]):
+            results[mode] = rec
+
+    return results
+
+
+def summarize(results: dict[str, BenchmarkRecord]) -> str:
+    """Build the comparison summary ≙ reference `compare_benchmarks.py:51-63`,
+    but computed from data."""
+    lines = ["", "=" * 70, "BENCHMARK COMPARISON SUMMARY", "=" * 70]
+    lines.append(f"{'mode':<20}{'total TFLOPS':>14}{'time/op ms':>12}{'comm ms':>10}")
+    for name, rec in results.items():
+        comm = f"{rec.comm_time_s * 1e3:.2f}" if rec.comm_time_s is not None else "-"
+        lines.append(
+            f"{name:<20}{rec.tflops_total:>14.2f}{rec.avg_time_s * 1e3:>12.3f}{comm:>10}"
+        )
+
+    def t(name: str) -> float | None:
+        return results[name].avg_time_s if name in results else None
+
+    lines.append("-" * 70)
+    if t("no_overlap") and t("overlap"):
+        gain = (t("no_overlap") - t("overlap")) / t("no_overlap") * 100
+        lines.append(
+            f"Overlap hides {gain:.1f}% of the serialized step time "
+            f"({'wins' if gain > 0 else 'no win'} vs no_overlap)"
+        )
+    if t("pipeline") and t("no_overlap"):
+        gain = (t("no_overlap") - t("pipeline")) / t("no_overlap") * 100
+        lines.append(f"Pipeline (depth 3) hides {gain:.1f}% of the serialized step time")
+    if "independent" in results and "batch_parallel" in results:
+        lines.append(
+            "Independent mode is the upper bound (no collectives); "
+            f"batch_parallel reaches {results['batch_parallel'].tflops_total:.1f} "
+            f"of its {results['independent'].tflops_total:.1f} total TFLOPS"
+        )
+    if "collective_matmul" in results:
+        sp = results["collective_matmul"].extras.get("overlap_speedup_x")
+        if sp:
+            lines.append(f"ppermute collective matmul: {sp}x vs gather-then-matmul")
+    lines.append("=" * 70)
+    return "\n".join(lines)
+
+
+def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--size", type=int, default=16384)
+    p.add_argument("--dtype", type=str, default="bfloat16",
+                   choices=["float32", "float16", "bfloat16"])
+    p.add_argument("--num-devices", type=int, default=None)
+    p.add_argument("--iterations", type=int, default=50)
+    p.add_argument("--warmup", type=int, default=10)
+    p.add_argument("--json-out", type=str, default=None,
+                   help="write the comparison table as JSON lines")
+    args = p.parse_args(argv)
+
+    results = compare(args.size, args.dtype, args.num_devices,
+                      args.iterations, args.warmup)
+    report(summarize(results))
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            for name, rec in results.items():
+                fh.write(json.dumps({"comparison_key": name,
+                                     **json.loads(rec.to_json())}) + "\n")
+    return results
+
+
+if __name__ == "__main__":
+    main()

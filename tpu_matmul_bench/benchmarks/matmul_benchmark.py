@@ -1,0 +1,143 @@
+"""Basic matmul benchmark ≙ reference `matmul_benchmark.py` (SURVEY P1).
+
+Single-device: a jitted C = A·B timed over the size/dtype sweep with TFLOPS
+and peak-efficiency reporting. Multi-device: every chip runs its own matmul
+concurrently (the reference's N-rank form, where each rank benchmarks
+independently and TFLOPS are all-reduce-summed, `matmul_benchmark.py:110-121`)
+— expressed here as a device-stacked `shard_map` einsum over a 1-D mesh with
+no collectives in the hot loop.
+
+Run: python -m tpu_matmul_bench.benchmarks.matmul_benchmark [--sizes ...]
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from tpu_matmul_bench.benchmarks.runner import run_sizes
+from tpu_matmul_bench.models.workloads import MatmulWorkload
+from tpu_matmul_bench.ops.matmul import make_matmul, matmul_2d
+from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
+from tpu_matmul_bench.utils.config import BenchConfig, parse_config
+from tpu_matmul_bench.utils.device import (
+    collect_device_info,
+    device_banner,
+    maybe_init_multihost,
+    resolve_devices,
+)
+from tpu_matmul_bench.utils.metrics import calculate_tflops
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord, header, report
+from tpu_matmul_bench.utils.timing import time_jitted
+
+
+def _bench_single(
+    config: BenchConfig, size: int, device_kind: str, device: jax.Device | None = None
+) -> BenchmarkRecord:
+    wl = MatmulWorkload(size, config.dtype, seed=config.seed)
+    # pin generation and compute to the *resolved* device so --device=cpu/tpu
+    # actually selects where the work runs, not just what the banner says
+    with jax.default_device(device if device is not None else jax.devices()[0]):
+        a, b = wl.operands()
+        mm = make_matmul(config.matmul_impl)
+        t = time_jitted(mm, (a, b), iterations=config.iterations, warmup=config.warmup)
+    tflops = calculate_tflops(size, t.avg_s)
+    return BenchmarkRecord(
+        benchmark="matmul",
+        mode="single",
+        size=size,
+        dtype=config.dtype_name,
+        world=1,
+        iterations=t.iterations,
+        warmup=config.warmup,
+        avg_time_s=t.avg_s,
+        tflops_per_device=tflops,
+        tflops_total=tflops,
+        device_kind=device_kind,
+        extras={} if t.reliable else {"timing_reliable": False},
+    )
+
+
+def _bench_all_devices(
+    config: BenchConfig, size: int, devices: Sequence[jax.Device], device_kind: str
+) -> BenchmarkRecord:
+    d = len(devices)
+    mesh = make_mesh(devices)
+    a, b = sharded_normal(
+        config.seed, (d, size, size), config.dtype, mesh, P("x")
+    )
+
+    # Per-device independent matmul, zero collectives in the timed loop —
+    # ≙ every rank calling benchmark_matmul concurrently.
+    mm2d = matmul_2d(config.matmul_impl)
+    mm = jax.jit(
+        shard_map(
+            lambda x, y: jnp.stack([mm2d(x[i], y[i]) for i in range(x.shape[0])]),
+            mesh=mesh,
+            in_specs=(P("x"), P("x")),
+            out_specs=P("x"),
+        )
+    )
+    t = time_jitted(mm, (a, b), iterations=config.iterations, warmup=config.warmup)
+    per_device = calculate_tflops(size, t.avg_s)  # each device did one matmul/iter
+    return BenchmarkRecord(
+        benchmark="matmul",
+        mode="single",
+        size=size,
+        dtype=config.dtype_name,
+        world=d,
+        iterations=t.iterations,
+        warmup=config.warmup,
+        avg_time_s=t.avg_s,
+        tflops_per_device=per_device,
+        tflops_total=per_device * d,  # ≙ all_reduce SUM of TFLOPS (:114)
+        device_kind=device_kind,
+        extras={} if t.reliable else {"timing_reliable": False},
+    )
+
+
+def run(config: BenchConfig) -> list[BenchmarkRecord]:
+    maybe_init_multihost()
+    devices = resolve_devices(config.device, config.num_devices)
+    info = collect_device_info(devices)
+    report(device_banner(info))
+    report(
+        header(
+            "Matrix Multiplication Benchmark (TPU-native)",
+            {
+                "Number of devices": len(devices),
+                "Data type": config.dtype_name,
+                "Platform": info.platform,
+                "Iterations per test": config.iterations,
+                "Warmup iterations": config.warmup,
+                "Matmul implementation": config.matmul_impl,
+            },
+        )
+    )
+
+    def bench_one(size: int) -> BenchmarkRecord:
+        if len(devices) == 1:
+            return _bench_single(config, size, info.device_kind, devices[0])
+        return _bench_all_devices(config, size, devices, info.device_kind)
+
+    records = run_sizes(
+        config,
+        bench_one,
+        memory_gib=lambda s: MatmulWorkload(s, config.dtype).memory_gib,
+        memory_limit_gib=info.memory_gib,
+    )
+    report("\n" + "=" * 60, "Benchmark completed!", "=" * 60)
+    return records
+
+
+def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
+    config = parse_config(argv, description=__doc__ or "matmul benchmark")
+    return run(config)
+
+
+if __name__ == "__main__":
+    main()

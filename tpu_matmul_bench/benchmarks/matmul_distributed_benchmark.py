@@ -1,0 +1,40 @@
+"""Distributed benchmark ≙ reference `backup/matmul_distributed_benchmark.py`
+(SURVEY P5-P6).
+
+Modes {independent, data_parallel, model_parallel}: the older variants of the
+scaling suite — full-replica matmul + all_reduce, and the inner-dim (k-split)
+model-parallel form. Shares the scaling harness; only the mode table and
+default differ (reference default data_parallel,
+`backup/matmul_distributed_benchmark.py:283-285`).
+
+Run: python -m tpu_matmul_bench.benchmarks.matmul_distributed_benchmark \
+        --mode model_parallel ...
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from tpu_matmul_bench.benchmarks.matmul_scaling_benchmark import run
+from tpu_matmul_bench.parallel.modes import DISTRIBUTED_MODES
+from tpu_matmul_bench.utils.config import BenchConfig, parse_config
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+
+
+def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
+    config = parse_config(
+        argv,
+        description=__doc__ or "distributed benchmark",
+        modes=list(DISTRIBUTED_MODES),
+        default_mode="data_parallel",
+    )
+    return run(
+        config,
+        modes_table=DISTRIBUTED_MODES,
+        benchmark_name="distributed",
+        title="Distributed Matrix Multiplication Benchmark (TPU-native)",
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Overlap benchmark ≙ reference `backup/matmul_overlap_benchmark.py`
+(SURVEY P7-P9).
+
+Modes {no_overlap, overlap, pipeline} re-designed for XLA's async collectives
+and latency-hiding scheduler (no user streams on TPU), plus the TPU-native
+`collective_matmul` mode — a ppermute-ring all-gather matmul where ICI
+transfers hide behind MXU work (the form BASELINE.json's north star names).
+Default mode `overlap` ≙ reference `backup/matmul_overlap_benchmark.py:369-371`.
+
+Run: python -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \
+        --mode overlap --num-devices 8 ...
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from tpu_matmul_bench.benchmarks.matmul_scaling_benchmark import run
+from tpu_matmul_bench.parallel.overlap import OVERLAP_MODES
+from tpu_matmul_bench.utils.config import parse_config
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+
+
+def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
+    config = parse_config(
+        argv,
+        description=__doc__ or "overlap benchmark",
+        modes=list(OVERLAP_MODES),
+        default_mode="overlap",
+    )
+    return run(
+        config,
+        modes_table=OVERLAP_MODES,
+        benchmark_name="overlap",
+        title="Compute/Communication Overlap Benchmark (TPU-native)",
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Scaling benchmark ≙ reference `matmul_scaling_benchmark.py` (SURVEY P2-P4).
+
+Modes {independent, batch_parallel, matrix_parallel} over a 1-D device mesh,
+with the reference's startup collective verification gate
+(`matmul_scaling_benchmark.py:388-394`) and per-mode TFLOPS/scaling-efficiency
+reporting (`:308-335`).
+
+Run: python -m tpu_matmul_bench.benchmarks.matmul_scaling_benchmark \
+        --mode batch_parallel --num-devices 8 ...
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Sequence
+
+from tpu_matmul_bench.benchmarks.runner import run_sizes
+from tpu_matmul_bench.parallel.collectives import verify_collectives
+from tpu_matmul_bench.parallel.mesh import make_mesh
+from tpu_matmul_bench.parallel.modes import (
+    SCALING_MODES,
+    estimate_memory_gib,
+    run_mode_benchmark,
+)
+from tpu_matmul_bench.utils.config import BenchConfig, parse_config
+from tpu_matmul_bench.utils.device import (
+    collect_device_info,
+    device_banner,
+    maybe_init_multihost,
+    resolve_devices,
+)
+from tpu_matmul_bench.utils.reporting import (
+    BenchmarkRecord,
+    attach_scaling_efficiency,
+    header,
+    report,
+)
+
+
+def run(
+    config: BenchConfig,
+    *,
+    modes_table=SCALING_MODES,
+    benchmark_name: str = "scaling",
+    title: str = "Matrix Multiplication Scaling Benchmark (TPU-native)",
+    verify: bool = True,
+) -> list[BenchmarkRecord]:
+    maybe_init_multihost()
+    devices = resolve_devices(config.device, config.num_devices)
+    info = collect_device_info(devices)
+    mesh = make_mesh(devices)
+    report(device_banner(info))
+    report(
+        header(
+            title,
+            {
+                "Mode": config.mode,
+                "Number of devices": len(devices),
+                "Data type": config.dtype_name,
+                "Iterations per test": config.iterations,
+                "Warmup iterations": config.warmup,
+            },
+        )
+    )
+
+    # startup collective gate ≙ reference :388-394
+    if verify and len(devices) > 1:
+        report("\nVerifying collectives:")
+        if not verify_collectives(mesh):
+            report("\nERROR: collective verification failed — aborting benchmark")
+            sys.exit(1)
+
+    builder = modes_table[config.mode]
+    d = len(devices)
+
+    def bench_one(size: int) -> BenchmarkRecord:
+        setup = builder(config, mesh, size, benchmark=benchmark_name)
+        rec = run_mode_benchmark(setup, config)
+        # Scaling efficiency against a *measured* single-device baseline
+        # (≙ the README's ~100% / ~85% scaling column; the reference's
+        # in-run formula at :315 compares ranks to each other, which is
+        # trivially 100% under a single controller — a real 1-device
+        # measurement is the meaningful denominator). matrix/model-parallel
+        # split one op across devices: same total work, scaling N/A
+        # (reference README.md:46).
+        if d > 1 and rec.mode in ("independent", "batch_parallel", "data_parallel"):
+            attach_scaling_efficiency(rec, _single_device_tflops(config, devices[0], size))
+        return rec
+
+    records = run_sizes(
+        config,
+        bench_one,
+        memory_gib=lambda s: estimate_memory_gib(config.mode, config, d, s),
+        memory_limit_gib=info.memory_gib,
+    )
+    report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)
+    return records
+
+
+def _single_device_tflops(config: BenchConfig, device, size: int) -> float:
+    """One-device matmul baseline for the efficiency denominator (cached)."""
+    key = (size, config.dtype_name)
+    if key not in _BASELINE_CACHE:
+        from tpu_matmul_bench.benchmarks.matmul_benchmark import _bench_single
+
+        rec = _bench_single(config, size, "", device)
+        _BASELINE_CACHE[key] = rec.tflops_per_device
+    return _BASELINE_CACHE[key]
+
+
+_BASELINE_CACHE: dict = {}
+
+
+def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
+    config = parse_config(
+        argv,
+        description=__doc__ or "scaling benchmark",
+        modes=list(SCALING_MODES),
+        default_mode="independent",  # ≙ reference :360-362
+    )
+    return run(config)
+
+
+if __name__ == "__main__":
+    main()

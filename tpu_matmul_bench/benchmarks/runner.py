@@ -1,0 +1,70 @@
+"""Shared per-size benchmark loop with OOM resilience (SURVEY L2 + I7).
+
+Every benchmark program iterates sizes through this runner: preamble → run →
+report/record, with per-size try/except-OOM-and-continue semantics matching
+reference `matmul_scaling_benchmark.py:268-347`.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Callable, Iterable
+
+from tpu_matmul_bench.utils.config import BenchConfig
+from tpu_matmul_bench.utils.errors import is_oom_error, release_device_memory
+from tpu_matmul_bench.utils.reporting import (
+    BenchmarkRecord,
+    JsonWriter,
+    format_record,
+    report,
+    size_preamble,
+)
+
+
+def run_sizes(
+    config: BenchConfig,
+    bench_one: Callable[[int], BenchmarkRecord],
+    *,
+    sizes: Iterable[int] | None = None,
+    memory_gib: Callable[[int], float] | None = None,
+    memory_limit_gib: float | None = None,
+) -> list[BenchmarkRecord]:
+    """Run `bench_one(size)` over the size sweep; skip OOM sizes and
+    continue (≙ reference `matmul_scaling_benchmark.py:337-342`).
+
+    When the per-device footprint estimate `memory_gib(size)` and the HBM
+    limit are known, oversized configs are skipped *before* touching the
+    allocator — on some backends a failed multi-GiB allocation degrades
+    subsequent allocations, so the guard is sturdier than try/except alone
+    (which remains as the backstop).
+    """
+    records: list[BenchmarkRecord] = []
+    with JsonWriter(config.json_out) as jw:
+        for size in sizes if sizes is not None else config.sizes:
+            report(size_preamble(size, config.dtype_name))
+            if (
+                memory_gib is not None
+                and memory_limit_gib is not None
+                and memory_gib(size) > 0.95 * memory_limit_gib
+            ):
+                report(
+                    f"\n  ERROR: Out of memory for {size}x{size} matrices "
+                    f"(needs ~{memory_gib(size):.1f} GiB, "
+                    f"device has {memory_limit_gib:.1f} GiB) — skipped"
+                )
+                continue
+            try:
+                rec = bench_one(size).finalize()
+            except Exception as e:  # noqa: BLE001 — per-size resilience
+                if is_oom_error(e):
+                    report(f"\n  ERROR: Out of memory for {size}x{size} matrices")
+                else:
+                    report(f"\n  ERROR: {e}")
+                    report(traceback.format_exc())
+                release_device_memory()
+                continue
+            records.append(rec)
+            jw.write(rec)
+            report(format_record(rec))
+            release_device_memory()
+    return records

@@ -1,0 +1,13 @@
+"""Benchmark workloads ("models" of this framework).
+
+The reference benchmarks exactly one model family — dense square matmul, in
+single and batched form. Workload dataclasses here describe those problems
+(shape, dtype, FLOPs, operand construction) so the benchmark programs and the
+parallel modes share one definition instead of re-deriving shapes inline the
+way the reference scripts do.
+"""
+
+from tpu_matmul_bench.models.workloads import (  # noqa: F401
+    BatchedMatmulWorkload,
+    MatmulWorkload,
+)

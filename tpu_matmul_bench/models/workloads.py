@@ -1,0 +1,61 @@
+"""Workload definitions for the matmul benchmark family.
+
+Shapes/dtypes/FLOPs for the two problem forms the reference exercises:
+square C = A·B (reference `matmul_benchmark.py:39-79`) and batched
+C[b] = A[b]·B[b] with a global batch of 4 (`matmul_scaling_benchmark.py:283`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+
+from tpu_matmul_bench.ops.matmul import random_operands
+from tpu_matmul_bench.utils.metrics import matmul_flops, matrix_memory_gib
+
+
+@dataclasses.dataclass(frozen=True)
+class MatmulWorkload:
+    """One square matmul C = A·B of `size`×`size` matrices."""
+
+    size: int
+    dtype: Any
+    seed: int = 0
+
+    @property
+    def flops(self) -> float:
+        return matmul_flops(self.size)
+
+    @property
+    def memory_gib(self) -> float:
+        # A, B and the produced C
+        return matrix_memory_gib(self.size, self.dtype, count=3)
+
+    def operands(self, seed_offset: int = 0) -> tuple[jax.Array, jax.Array]:
+        a, b = random_operands(
+            self.seed + seed_offset, (self.size, self.size), self.dtype
+        )
+        return a, b
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedMatmulWorkload:
+    """Batched matmul with global batch `batch` ≙ reference
+    `matmul_scaling_benchmark.py:106-165` (batch_size=4 at `:283`)."""
+
+    size: int
+    dtype: Any
+    batch: int = 4
+    seed: int = 0
+
+    @property
+    def flops(self) -> float:
+        return matmul_flops(self.size) * self.batch
+
+    def operands(self, seed_offset: int = 0) -> tuple[jax.Array, jax.Array]:
+        a, b = random_operands(
+            self.seed + seed_offset, (self.batch, self.size, self.size), self.dtype
+        )
+        return a, b

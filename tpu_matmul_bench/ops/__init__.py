@@ -1,0 +1,9 @@
+"""Compute + communication primitives (SURVEY L1), TPU-native.
+
+The reference's L1 is `torch.matmul`/`torch.bmm` on cuBLAS plus
+torch.distributed/NCCL collectives; here it is XLA-compiled `jnp` matmuls, an
+optional Pallas MXU matmul kernel, and XLA ICI collectives (in
+`tpu_matmul_bench.parallel.collectives`).
+"""
+
+from tpu_matmul_bench.ops.matmul import make_bmm, make_matmul, random_operands  # noqa: F401

@@ -1,0 +1,72 @@
+"""Dense matmul ops — the hot path of every benchmark (SURVEY P1).
+
+TPU-native counterpart of `torch.matmul` (reference `matmul_benchmark.py:62`)
+and `torch.bmm` (`matmul_scaling_benchmark.py:142`). The jitted fns below are
+what the timing engine dispatches in its hot loop; XLA lowers them onto the
+MXU with fp32 accumulation (the same internal-accumulate/downcast contract as
+cuBLAS bf16 matmul), so output dtype matches input dtype like the reference.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def make_matmul(impl: str = "xla") -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """A jitted C = A @ B. ``impl`` selects XLA's dot or the Pallas kernel."""
+    if impl == "pallas":
+        from tpu_matmul_bench.ops.pallas_matmul import pallas_matmul
+
+        return jax.jit(pallas_matmul)
+    if impl != "xla":
+        raise ValueError(f"unknown matmul impl {impl!r}")
+
+    @jax.jit
+    def matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+        return jnp.matmul(a, b)
+
+    return matmul
+
+
+def matmul_2d(impl: str = "xla") -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """Un-jitted 2-D matmul for use *inside* shard_map/jit bodies — the one
+    place every benchmark mode takes its hot op from, so `--matmul-impl
+    pallas` swaps the kernel uniformly across all modes."""
+    if impl == "pallas":
+        from tpu_matmul_bench.ops.pallas_matmul import pallas_matmul
+
+        return lambda a, b: pallas_matmul(a, b)
+    if impl != "xla":
+        raise ValueError(f"unknown matmul impl {impl!r}")
+    return lambda a, b: jnp.dot(a, b)
+
+
+def make_bmm() -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """Batched matmul ≙ `torch.bmm` (reference `matmul_scaling_benchmark.py:142`)."""
+
+    @jax.jit
+    def bmm(a: jax.Array, b: jax.Array) -> jax.Array:
+        return jnp.einsum("bij,bjk->bik", a, b)
+
+    return bmm
+
+
+@partial(jax.jit, static_argnames=("shape", "dtype"))
+def _normal(key: jax.Array, shape: tuple[int, ...], dtype: Any) -> jax.Array:
+    return jax.random.normal(key, shape, dtype=dtype)
+
+
+def random_operands(
+    seed: int, shape: tuple[int, ...], dtype: Any, *, count: int = 2
+) -> tuple[jax.Array, ...]:
+    """Standard-normal operands ≙ `torch.randn` (reference
+    `matmul_benchmark.py:41-42`). Distinct keys per operand; callers that need
+    per-device distinct data fold the device index into the seed, the
+    JAX-native analogue of `torch.manual_seed(rank)`
+    (`matmul_scaling_benchmark.py:73`)."""
+    keys = jax.random.split(jax.random.key(seed), count)
+    return tuple(_normal(k, shape, jnp.dtype(dtype)) for k in keys)

@@ -1,0 +1,120 @@
+"""Blocked Pallas matmul kernel for the TPU MXU.
+
+The reference reaches its native matmul through cuBLAS via `torch.matmul`
+(reference `matmul_benchmark.py:62`); the TPU-native analogue of "our own
+native kernel" is a Pallas/Mosaic kernel feeding the 128×128 MXU. This is the
+`--matmul-impl pallas` path of every benchmark and the base kernel the
+overlap suite builds on.
+
+Design (per the Pallas TPU playbook):
+- 3-D grid (M/bm, N/bn, K/bk) with K innermost ("arbitrary" semantics, the
+  M/N dims parallel) so each (i, j) output tile accumulates across K steps.
+- fp32 accumulator scratch in VMEM; inputs stream HBM→VMEM via the implicit
+  pallas pipeline (double-buffered by the compiler), output written on the
+  last K step and downcast to the input dtype — the same
+  accumulate-high/store-low contract as cuBLAS bf16 matmul.
+- 512³ blocks: A/B tiles 0.5 MB each in bf16, accumulator 1 MB fp32 — well
+  inside the ~16 MB/core VMEM budget including double buffering.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref):
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        a_ref[:], b_ref[:], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _store():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def _pick_block(dim: int, preferred: int) -> int:
+    """Largest hardware-aligned block ≤ preferred that divides dim."""
+    for candidate in (preferred, 512, 256, 128, 64, 32, 16, 8):
+        if candidate <= preferred and dim % candidate == 0:
+            return candidate
+    return dim  # tiny/odd dim: single block
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_m", "block_n", "block_k", "interpret")
+)
+def pallas_matmul(
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    block_m: int = 512,
+    block_n: int = 512,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """C = A @ B with a blocked Pallas kernel.
+
+    `interpret=None` auto-selects interpreter mode off-TPU so the kernel is
+    testable on the virtual CPU mesh (SURVEY §4 testing strategy).
+    """
+    if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+        raise ValueError(f"bad matmul shapes {a.shape} @ {b.shape}")
+    m, k = a.shape
+    _, n = b.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # Pad awkward (e.g. prime) dims up to a 128 multiple so a hardware-aligned
+    # block always divides; zero padding does not change the product block.
+    def pad_to(x: jax.Array, rows: int, cols: int) -> jax.Array:
+        pr, pc = rows - x.shape[0], cols - x.shape[1]
+        return jnp.pad(x, ((0, pr), (0, pc))) if (pr or pc) else x
+
+    def rounded(dim: int) -> int:
+        return dim if _pick_block(dim, 512) >= 8 else -(-dim // 128) * 128
+
+    mp, kp, np_ = rounded(m), rounded(k), rounded(n)
+    if (mp, kp, np_) != (m, k, n):
+        out = pallas_matmul(
+            pad_to(a, mp, kp), pad_to(b, kp, np_),
+            block_m=block_m, block_n=block_n, block_k=block_k,
+            interpret=interpret,
+        )
+        return out[:m, :n]
+
+    bm = _pick_block(m, block_m)
+    bn = _pick_block(n, block_n)
+    bk = _pick_block(k, block_k)
+    out_dtype = jnp.promote_types(a.dtype, b.dtype)
+
+    grid = (m // bm, n // bn, k // bk)
+    return pl.pallas_call(
+        _matmul_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=(m * k + k * n) * a.dtype.itemsize
+            + m * n * jnp.dtype(out_dtype).itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(a, b)

@@ -1,0 +1,11 @@
+"""Parallelism layer: device meshes, XLA collectives, and the benchmark modes.
+
+TPU-native replacement for the reference's torch.distributed/NCCL layer
+(SURVEY §2 "distributed communication backend"): a `jax.sharding.Mesh` over
+the chips replaces the torchrun process group; `psum`/`pmean`/`all_gather`/
+`ppermute` over ICI replace NCCL all_reduce/all_gather; single-controller
+dispatch replaces rank-gated SPMD processes.
+"""
+
+from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal  # noqa: F401
+from tpu_matmul_bench.parallel.collectives import verify_collectives  # noqa: F401

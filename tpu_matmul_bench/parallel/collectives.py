@@ -1,0 +1,112 @@
+"""XLA collective wrappers + startup collective verification (SURVEY I2).
+
+The reference gates every scaling run on a pre-flight smoke test of its NCCL
+collectives — all_reduce of rank+1 against the closed-form sum, an element-wise
+all_gather check, and a barrier (reference `matmul_scaling_benchmark.py:26-57`,
+invoked at `:388-394`). `verify_collectives` is the same gate re-expressed
+over a JAX mesh: `psum` / `pmean` / `all_gather` / `ppermute` inside
+`shard_map`, checked on the controller against closed forms.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_matmul_bench.parallel.mesh import ring_perm, smap as _smap
+
+
+def psum_over(mesh: Mesh, axis: str = "x"):
+    """all_reduce(SUM) over the mesh axis ≙ `dist.all_reduce(..., SUM)`
+    (reference `matmul_scaling_benchmark.py:150`).
+
+    Like NCCL all_reduce, every device ends up holding the sum in its local
+    buffer — `pvary` re-marks the (replicated-valued) psum output as
+    device-varying so the stacked per-device view matches the reference's.
+    """
+
+    def body(x):
+        return jax.lax.pcast(jax.lax.psum(x, axis), axis, to="varying")
+
+    return _smap(body, mesh, in_specs=P(axis), out_specs=P(axis))
+
+
+def pmean_over(mesh: Mesh, axis: str = "x"):
+    """all_reduce(AVG) ≙ `dist.all_reduce(..., AVG)`
+    (reference `matmul_scaling_benchmark.py:301`)."""
+
+    def body(x):
+        return jax.lax.pcast(jax.lax.pmean(x, axis), axis, to="varying")
+
+    return _smap(body, mesh, in_specs=P(axis), out_specs=P(axis))
+
+
+def all_gather_over(mesh: Mesh, axis: str = "x", *, gather_axis: int = 0):
+    """all_gather ≙ `dist.all_gather` (reference
+    `matmul_scaling_benchmark.py:219-221`): every device ends with the
+    concatenation of all shards along `gather_axis`."""
+
+    def body(x):
+        return jax.lax.all_gather(x, axis, axis=gather_axis, tiled=True)
+
+    in_spec = [None] * (gather_axis + 1)
+    in_spec[gather_axis] = axis
+    # all_gather leaves every device holding the full concatenation; its VMA
+    # type is still axis-varying, so the replicated out_spec needs check_vma
+    # off (values are equal by construction of the collective).
+    return _smap(body, mesh, in_specs=P(*in_spec), out_specs=P(), check_vma=False)
+
+
+def verify_collectives(mesh: Mesh, axis: str = "x", *, verbose: bool = True) -> bool:
+    """Pre-flight smoke test of the collectives this suite depends on,
+    ≙ reference `verify_collectives` (`matmul_scaling_benchmark.py:26-57`).
+
+    Returns True iff every check passes; benchmark mains abort when it fails,
+    matching the reference's startup gate (`:390-394`).
+    """
+    n = mesh.shape[axis]
+    ok = True
+
+    def check(name: str, got: np.ndarray, want: np.ndarray, tol: float = 1e-3) -> bool:
+        good = bool(np.allclose(got, want, rtol=tol, atol=tol))
+        if verbose and jax.process_index() == 0:
+            status = "PASSED" if good else "FAILED"
+            print(f"  - {name}: {status}")
+            if not good:
+                print(f"      got {got!r}, want {want!r}")
+        return good
+
+    # all_reduce(SUM) of (rank+1) == n(n+1)/2 ≙ reference :33-37
+    ranks_plus_one = jnp.arange(1, n + 1, dtype=jnp.float32)
+    summed = np.asarray(psum_over(mesh, axis)(ranks_plus_one))
+    ok &= check("psum (all_reduce SUM)", summed, np.full(n, n * (n + 1) / 2.0))
+
+    # all_reduce(AVG) == mean of (rank+1)
+    avged = np.asarray(pmean_over(mesh, axis)(ranks_plus_one))
+    ok &= check("pmean (all_reduce AVG)", avged, np.full(n, (n + 1) / 2.0))
+
+    # all_gather of (rank*2) == [0, 2, 4, ...] everywhere ≙ reference :41-47
+    gathered = np.asarray(all_gather_over(mesh, axis)(jnp.arange(n, dtype=jnp.float32) * 2))
+    ok &= check("all_gather", gathered, np.arange(n, dtype=np.float32) * 2)
+
+    # ppermute ring shift: device d receives from d-1 (the primitive the
+    # overlap suite's ring collectives are built on; no reference analogue —
+    # NCCL send/recv is not used there, CUDA streams are; SURVEY P8).
+    def ring(x):
+        return jax.lax.ppermute(x, axis, ring_perm(n))
+
+    shifted = np.asarray(
+        _smap(ring, mesh, in_specs=P(axis), out_specs=P(axis))(
+            jnp.arange(n, dtype=jnp.float32)
+        )
+    )
+    ok &= check("ppermute (ring shift)", shifted, np.roll(np.arange(n, dtype=np.float32), 1))
+
+    # barrier ≙ reference :50 — under single-controller JAX a barrier is
+    # implicit in blocking on any collective's result, which the checks above
+    # already did; nothing separate to test.
+    return bool(ok)

@@ -1,0 +1,85 @@
+"""Device-mesh construction and sharded operand generation.
+
+Replaces the reference's process-group setup (SURVEY I1): where torchrun
+spawns one process per GPU and `dist.init_process_group` performs rendezvous
+(reference `matmul_scaling_benchmark.py:15-24`), JAX's single controller sees
+all chips and the "world" is a named mesh axis. Collectives over a mesh axis
+ride ICI on a real TPU slice.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    devices: Sequence[jax.Device] | None = None,
+    axis_names: tuple[str, ...] = ("x",),
+    shape: tuple[int, ...] | None = None,
+) -> Mesh:
+    """Build a mesh over `devices`.
+
+    Default is the 1-D mesh ('x' = the world axis, ≙ the reference's
+    WORLD_SIZE ranks). Pass `shape`/`axis_names` for 2-D meshes such as
+    ('dp', 'tp') used by the combined training-step demo.
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (devs.size,) if len(axis_names) == 1 else None
+    if shape is None:
+        raise ValueError("shape required for multi-axis meshes")
+    if int(np.prod(shape)) != devs.size:
+        raise ValueError(f"mesh shape {shape} does not cover {devs.size} devices")
+    return Mesh(devs.reshape(shape), axis_names)
+
+
+def world_size(mesh: Mesh, axis: str = "x") -> int:
+    return mesh.shape[axis]
+
+
+def sharded_normal(
+    seed: int,
+    shape: tuple[int, ...],
+    dtype: Any,
+    mesh: Mesh,
+    spec: P,
+    *,
+    count: int = 2,
+) -> tuple[jax.Array, ...]:
+    """Generate `count` standard-normal arrays directly with the given
+    sharding — each device materializes only its shard (no host-side global
+    array, no transfer), the JAX-native analogue of every rank calling
+    `torch.randn(..., device=rank)` (reference `matmul_scaling_benchmark.py:
+    73-75`). Distinct shards get distinct values by construction since the
+    whole logical array comes from one counter-based PRNG."""
+    sharding = NamedSharding(mesh, spec)
+
+    @partial(jax.jit, static_argnums=(1, 2), out_shardings=sharding)
+    def gen(key: jax.Array, shape: tuple[int, ...], dtype: Any) -> jax.Array:
+        return jax.random.normal(key, shape, dtype=dtype)
+
+    keys = jax.random.split(jax.random.key(seed), count)
+    return tuple(gen(k, tuple(shape), jnp.dtype(dtype)) for k in keys)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def smap(fn, mesh: Mesh, in_specs, out_specs, check_vma: bool = True):
+    """jit(shard_map(...)) — the one wrapper every collective/mode uses."""
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+    )
+
+
+def ring_perm(n: int) -> list[tuple[int, int]]:
+    """Unidirectional ring permutation for ppermute (d → d+1 mod n)."""
+    return [(i, (i + 1) % n) for i in range(n)]

@@ -1,0 +1,292 @@
+"""Overlap suite (SURVEY P7-P9) — compute/communication overlap, TPU-native.
+
+The reference implements overlap with CUDA streams: a no-overlap baseline
+that synchronizes between matmul and all_reduce every iteration
+(`backup/matmul_overlap_benchmark.py:36-91`), a double-buffered variant where
+the previous result's async all_reduce rides a comm stream while the next
+matmul runs on the compute stream (`:93-180`), and a depth-k software
+pipeline (`:182-278`).
+
+TPUs have no user-visible streams; the equivalents are XLA's async
+collectives + latency-hiding scheduler inside ONE compiled program:
+
+- ``no_overlap``: a `lax.scan` whose carry forces each step's psum to finish
+  before the next matmul starts (optimization_barrier-chained dependency) —
+  the *forced serialization* that makes the baseline meaningful, since XLA
+  would otherwise hide the collective on its own (SURVEY §7 hard part #2).
+- ``overlap``: double-buffered scan — step i all_reduces the previous
+  product while computing the next one from the other buffer pair; the two
+  ops share no data dependency, so XLA's scheduler runs the collective
+  concurrently with the MXU work (≙ the two-stream pattern `:129-144`).
+- ``pipeline``: same with a depth-k ring of in-flight products
+  (≙ `pipeline_depth=3`, `:184-255`).
+- ``collective_matmul``: the TPU-idiomatic showcase — a ppermute-ring
+  all-gather matmul where each step multiplies the chunk it currently holds
+  while the chunk simultaneously hops to the next neighbor (the
+  latency-hiding collective-matmul pattern; BASELINE.json's north-star names
+  this form). No reference analogue — this is what the stream tricks become
+  when re-designed for ICI.
+
+Every variant times ONE jitted scan program of `steps_per_call` steps, so the
+host never intervenes mid-pipeline (the scan is the stream). The ring-buffer
+fill (≙ the reference's prologue `:213-218`) is precomputed *outside* the
+timed program, so all variants execute exactly `steps` matmuls and `steps`
+psums per call — the no_overlap − overlap difference is pure scheduling.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_matmul_bench.ops.matmul import matmul_2d
+from tpu_matmul_bench.parallel.mesh import (
+    ring_perm,
+    sharded_normal,
+    smap,
+    world_size,
+)
+from tpu_matmul_bench.parallel.modes import ModeSetup, estimate_memory_gib
+from tpu_matmul_bench.utils.config import BenchConfig
+from tpu_matmul_bench.utils.metrics import calculate_tflops
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+from tpu_matmul_bench.utils.timing import Timing
+
+
+# ---------------------------------------------------------------------------
+# P7/P8/P9 — matmul + all_reduce with varying overlap, as scan programs
+# ---------------------------------------------------------------------------
+
+def _steps_program(mesh: Mesh, variant: str, steps: int, impl: str = "xla"):
+    """Scan program for {compute_only, no_overlap, overlap, pipeline}.
+
+    Operands: A, B stacked [buffers, n, n] per device (≙ the reference's
+    `pipeline_depth` matrix sets, `:188-195`); overlap/pipeline additionally
+    take the precomputed in-flight product ring [k, n, n].
+    """
+    mm = matmul_2d(impl)
+
+    if variant == "compute_only":
+        # compute leg alone, serialized step-to-step (≙ the reference's
+        # separate compute-only re-measure for TFLOPS, :78-89)
+        def body(a, b):
+            def step(a_cur, i):
+                c = mm(a_cur[0], b[0])
+                # next step's input depends on this product → steps ordered
+                a_dep = jax.lax.optimization_barrier(a_cur + 0 * c[0, 0])
+                return a_dep, c[0, 0]
+
+            _, outs = jax.lax.scan(step, a, jnp.arange(steps))
+            return outs
+
+        return smap(body, mesh, in_specs=(P("x"), P("x")), out_specs=P("x"),
+                    check_vma=False)
+
+    if variant == "no_overlap":
+        def body(a, b):
+            def step(a_cur, i):
+                c = mm(a_cur[0], b[0])
+                c = jax.lax.optimization_barrier(c)
+                r = jax.lax.psum(c, "x")  # ≙ all_reduce + sync (:56-68)
+                # next matmul's input depends on r → full serialization
+                a_dep = jax.lax.optimization_barrier(a_cur + 0 * r[0, 0])
+                return a_dep, r[0, 0]
+
+            _, outs = jax.lax.scan(step, a, jnp.arange(steps))
+            return outs
+
+        return smap(body, mesh, in_specs=(P("x"), P("x")), out_specs=P("x"),
+                    check_vma=False)
+
+    if variant in ("overlap", "pipeline"):
+        def body(a, b, ring0):
+            k = ring0.shape[0]
+
+            def step(ring, i):
+                slot = i % k
+                oldest = jax.lax.dynamic_index_in_dim(ring, slot, axis=0,
+                                                      keepdims=False)
+                # all_reduce the oldest in-flight product; deliberately NO
+                # dependency with this step's matmul — XLA's latency-hiding
+                # scheduler overlaps them (the dataflow analogue of the
+                # two-stream trick, :129-144)
+                r = jax.lax.psum(oldest, "x")
+                c_new = mm(a[slot % a.shape[0]], b[slot % b.shape[0]])
+                ring = jax.lax.dynamic_update_index_in_dim(ring, c_new, slot,
+                                                           axis=0)
+                return ring, r[0, 0]
+
+            _, outs = jax.lax.scan(step, ring0, jnp.arange(steps))
+            return outs
+
+        return smap(body, mesh, in_specs=(P("x"), P("x"), P("x")),
+                    out_specs=P("x"), check_vma=False)
+
+    raise ValueError(variant)
+
+
+def _fill_ring(mesh: Mesh, k: int, impl: str = "xla"):
+    """Prologue: the k in-flight products (≙ fill phase :213-218), computed
+    once at setup, outside every timed call."""
+    mm = matmul_2d(impl)
+
+    def body(a, b):
+        return jnp.stack([mm(a[i % a.shape[0]], b[i % b.shape[0]])
+                          for i in range(k)])
+
+    return smap(body, mesh, in_specs=(P("x"), P("x")), out_specs=P("x"),
+                check_vma=False)
+
+
+def overlap_mode(config: BenchConfig, mesh: Mesh, size: int, variant: str,
+                 *, steps_per_call: int = 8, depth: int = 3,
+                 benchmark: str = "overlap") -> ModeSetup:
+    """ModeSetup for the overlap suite. The timed unit is one scan program of
+    `steps_per_call` matmul+all_reduce steps; reported per-step time =
+    program time / steps."""
+    d = world_size(mesh)
+    impl = config.matmul_impl
+    nbuf = 1 if variant == "no_overlap" else (2 if variant == "overlap" else depth)
+    # stacked buffers: global [d*nbuf, n, n] sharded so each device owns nbuf
+    a, b = sharded_normal(
+        config.seed, (d * nbuf, size, size), config.dtype, mesh, P("x")
+    )
+    operands: tuple[Any, ...] = (a, b)
+    if variant in ("overlap", "pipeline"):
+        k = 2 if variant == "overlap" else depth
+        ring0 = _fill_ring(mesh, k, impl)(a, b)
+        operands = (a, b, ring0)
+
+    compute = _steps_program(mesh, "compute_only", steps_per_call, impl)
+    full = _steps_program(mesh, variant, steps_per_call, impl)
+    # compute program takes (a, b) only; wrap so both share `operands`
+    compute_fn = (lambda a, b, ring0=None: compute(a, b)) \
+        if len(operands) == 3 else compute
+
+    def build(t_compute: Timing, t_full: Timing | None, comm_s: float) -> BenchmarkRecord:
+        total_s = (t_full.avg_s if t_full else t_compute.avg_s) / steps_per_call
+        compute_s = t_compute.avg_s / steps_per_call
+        comm_step = max(total_s - compute_s, 0.0)
+        per_dev = calculate_tflops(size, total_s)  # one matmul per device-step
+        overhead = 100.0 * comm_step / total_s if total_s > 0 else 0.0
+        return BenchmarkRecord(
+            benchmark=benchmark, mode=variant, size=size,
+            dtype=config.dtype_name, world=d,
+            iterations=(t_full or t_compute).iterations * steps_per_call,
+            warmup=config.warmup,
+            avg_time_s=total_s,
+            tflops_per_device=per_dev,
+            tflops_total=per_dev * d,
+            compute_time_s=compute_s,
+            comm_time_s=comm_step,
+            extras={
+                "steps_per_program": steps_per_call,
+                "buffers": nbuf,
+                "matmul_impl": impl,
+                "comm_overhead_vs_compute_pct": round(overhead, 2),
+            },
+        )
+
+    return ModeSetup(variant, operands, compute_fn, full, build,
+                     memory_gib_per_device=estimate_memory_gib(
+                         variant, config, d, size))
+
+
+# ---------------------------------------------------------------------------
+# collective_matmul — ppermute-ring all-gather matmul (latency hiding)
+# ---------------------------------------------------------------------------
+
+def collective_matmul_program(mesh: Mesh, overlap: bool = True,
+                              impl: str = "xla"):
+    """Y = X·W with X row-sharded [m/D, k] and W column-sharded [k, n/D]:
+    logically Y_local = all_gather(X) @ W_local. The overlapped form never
+    materializes the gather — each of the D ring steps multiplies the X chunk
+    currently resident while ppermute streams it onward, so the ICI transfer
+    of chunk t+1 hides behind the MXU work on chunk t (the collective-matmul
+    pattern; the TPU re-design of the reference's stream overlap `:129-144`).
+
+    With overlap=False the same math runs as gather-then-matmul (the
+    baseline the overlapped form is compared against).
+    """
+    d = mesh.shape["x"]
+    mm = matmul_2d(impl)
+
+    def body(x_local, w_local):  # [m/d, k], [k, n/d]
+        mshard = x_local.shape[0]
+
+        if not overlap:
+            x_full = jax.lax.all_gather(x_local, "x", axis=0, tiled=True)
+            x_full = jax.lax.optimization_barrier(x_full)
+            return mm(x_full, w_local)
+
+        my = jax.lax.axis_index("x")
+        m = mshard * d
+        y = jnp.zeros((m, w_local.shape[1]), dtype=x_local.dtype)
+        x_cur = x_local
+        for t in range(d):
+            # chunk held at step t originated at device (my - t) mod d
+            src = (my - t) % d
+            if t + 1 < d:
+                x_next = jax.lax.ppermute(x_cur, "x", ring_perm(d))
+            else:
+                x_next = x_cur
+            y = jax.lax.dynamic_update_slice(
+                y, mm(x_cur, w_local), (src * mshard, 0)
+            )
+            x_cur = x_next
+        return y
+
+    return smap(body, mesh, in_specs=(P("x", None), P(None, "x")),
+                out_specs=P(None, "x"), check_vma=False)
+
+
+def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
+                           benchmark: str = "overlap") -> ModeSetup:
+    d = world_size(mesh)
+    (x,) = sharded_normal(config.seed, (size, size), config.dtype, mesh,
+                          P("x", None), count=1)
+    (w,) = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
+                          P(None, "x"), count=1)
+    baseline = collective_matmul_program(mesh, overlap=False,
+                                         impl=config.matmul_impl)
+    overlapped = collective_matmul_program(mesh, overlap=True,
+                                           impl=config.matmul_impl)
+
+    def build(t_compute: Timing, t_full: Timing | None, comm_s: float) -> BenchmarkRecord:
+        # here 'compute' = gather-then-matmul baseline, 'full' = overlapped
+        t_base = t_compute
+        t_ovl = t_full if t_full else t_compute
+        actual = calculate_tflops(size, t_ovl.avg_s)
+        speedup = t_base.avg_s / t_ovl.avg_s if t_ovl.avg_s > 0 else 1.0
+        return BenchmarkRecord(
+            benchmark=benchmark, mode="collective_matmul", size=size,
+            dtype=config.dtype_name, world=d,
+            iterations=t_ovl.iterations, warmup=config.warmup,
+            avg_time_s=t_ovl.avg_s,
+            tflops_per_device=actual / d,
+            tflops_total=actual,
+            compute_time_s=t_base.avg_s,
+            comm_time_s=None,
+            extras={
+                "baseline": "all_gather-then-matmul",
+                "baseline_time_ms": round(t_base.avg_ms, 3),
+                "overlap_speedup_x": round(speedup, 3),
+                "matmul_impl": config.matmul_impl,
+            },
+        )
+
+    return ModeSetup("collective_matmul", (x, w), baseline, overlapped, build,
+                     memory_gib_per_device=estimate_memory_gib(
+                         "collective_matmul", config, d, size))
+
+
+OVERLAP_MODES = {
+    "no_overlap": functools.partial(overlap_mode, variant="no_overlap"),
+    "overlap": functools.partial(overlap_mode, variant="overlap"),
+    "pipeline": functools.partial(overlap_mode, variant="pipeline"),
+    "collective_matmul": collective_matmul_mode,
+}

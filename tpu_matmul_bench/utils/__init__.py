@@ -1,0 +1,26 @@
+"""Shared core: device setup, timing engine, metrics math, reporting, config.
+
+The reference copy-pastes `setup_distributed`, `cleanup_distributed`,
+`calculate_tflops`, the device banner, and OOM handling across all four of its
+benchmark scripts (reference `matmul_benchmark.py:9-37`,
+`matmul_scaling_benchmark.py:15-67`, `backup/matmul_distributed_benchmark.py:
+15-33`, `backup/matmul_overlap_benchmark.py:16-34`). Here they are factored
+into one shared core, as SURVEY.md §1 prescribes.
+"""
+
+from tpu_matmul_bench.utils.device import (  # noqa: F401
+    DeviceInfo,
+    collect_device_info,
+    device_banner,
+    platform_name,
+    resolve_devices,
+)
+from tpu_matmul_bench.utils.metrics import (  # noqa: F401
+    bytes_per_element,
+    calculate_tflops,
+    matmul_flops,
+    matrix_memory_gib,
+    scaling_efficiency,
+    theoretical_peak_tflops,
+)
+from tpu_matmul_bench.utils.timing import Timing, time_jitted, time_legs  # noqa: F401

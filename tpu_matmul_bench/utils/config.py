@@ -1,0 +1,127 @@
+"""Shared CLI config (SURVEY I9) — one argparse module instead of four copies.
+
+Reproduces the reference's flag surface (`matmul_scaling_benchmark.py:350-362`):
+--sizes (default 4096 8192 16384), --iterations (50), --warmup (10),
+--dtype {float32,float16,bfloat16} (default bfloat16), --mode (per benchmark),
+and adds the TPU-era flags from BASELINE.json's north star: --device
+(tpu/cpu/gpu), --num-devices (≙ torchrun --nproc_per_node), --json-out
+(structured results), --matmul-impl (xla | pallas).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+
+DEFAULT_SIZES = [4096, 8192, 16384]  # ≙ reference matmul_benchmark.py:157
+DTYPE_CHOICES = ["float32", "float16", "bfloat16"]  # ≙ matmul_benchmark.py:164
+
+_DTYPE_MAP = {
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+}
+
+
+def parse_dtype(name: str) -> Any:
+    """dtype string → jnp dtype ≙ reference `matmul_scaling_benchmark.py:366-371`."""
+    try:
+        return _DTYPE_MAP[name]
+    except KeyError:
+        raise ValueError(f"unknown dtype {name!r}; choose from {DTYPE_CHOICES}")
+
+
+@dataclasses.dataclass
+class BenchConfig:
+    """Parsed benchmark configuration shared by all four programs."""
+
+    sizes: list[int]
+    iterations: int
+    warmup: int
+    dtype_name: str
+    mode: str | None
+    device: str | None
+    num_devices: int | None
+    json_out: str | None
+    matmul_impl: str
+    seed: int
+
+    @property
+    def dtype(self) -> Any:
+        return parse_dtype(self.dtype_name)
+
+
+def build_parser(
+    description: str,
+    modes: Sequence[str] | None = None,
+    default_mode: str | None = None,
+) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument(
+        "--sizes", type=int, nargs="+", default=list(DEFAULT_SIZES),
+        help=f"Matrix sizes to benchmark (default: {DEFAULT_SIZES})",
+    )
+    p.add_argument(
+        "--iterations", type=int, default=50,
+        help="Number of timed iterations per benchmark (default: 50)",
+    )
+    p.add_argument(
+        "--warmup", type=int, default=10,
+        help="Warmup iterations (absorbs jit compile/autotune; default: 10)",
+    )
+    p.add_argument(
+        "--dtype", type=str, default="bfloat16", choices=DTYPE_CHOICES,
+        help="Matrix dtype (default: bfloat16)",
+    )
+    if modes:
+        p.add_argument(
+            "--mode", type=str, default=default_mode or modes[0], choices=list(modes),
+            help=f"Benchmark mode (default: {default_mode or modes[0]})",
+        )
+    p.add_argument(
+        "--device", type=str, default=None, choices=["tpu", "cpu", "gpu"],
+        help="Platform to run on (default: JAX default backend). "
+             "--device=tpu drives a TPU slice with no GPU in the loop.",
+    )
+    p.add_argument(
+        "--num-devices", type=int, default=None,
+        help="Use only the first N devices (≙ torchrun --nproc_per_node)",
+    )
+    p.add_argument(
+        "--json-out", type=str, default=None,
+        help="Write JSON-lines results here ('-' for stdout)",
+    )
+    p.add_argument(
+        "--matmul-impl", type=str, default="xla", choices=["xla", "pallas"],
+        help="Matmul implementation: XLA jnp.matmul or the Pallas kernel",
+    )
+    p.add_argument("--seed", type=int, default=0, help="PRNG seed for operand data")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> BenchConfig:
+    return BenchConfig(
+        sizes=list(args.sizes),
+        iterations=args.iterations,
+        warmup=args.warmup,
+        dtype_name=args.dtype,
+        mode=getattr(args, "mode", None),
+        device=args.device,
+        num_devices=args.num_devices,
+        json_out=args.json_out,
+        matmul_impl=args.matmul_impl,
+        seed=args.seed,
+    )
+
+
+def parse_config(
+    argv: Sequence[str] | None,
+    description: str,
+    modes: Sequence[str] | None = None,
+    default_mode: str | None = None,
+) -> BenchConfig:
+    parser = build_parser(description, modes=modes, default_mode=default_mode)
+    return config_from_args(parser.parse_args(argv))

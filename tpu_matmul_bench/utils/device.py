@@ -1,0 +1,154 @@
+"""Device / platform setup for the benchmark suite.
+
+TPU-native replacement for the reference's distributed init/teardown (SURVEY
+I1). The reference reads torchrun's RANK/WORLD_SIZE env vars and calls
+`dist.init_process_group` per process (reference `matmul_benchmark.py:9-32`);
+under single-controller JAX there is one process that sees every chip through
+`jax.devices()`, so "init" reduces to device discovery + mesh construction and
+"teardown" is a no-op. The reference's AMD-GPU backend autodetect
+(`matmul_benchmark.py:14-22`) maps to platform detection via
+`jax.devices()[0].platform`, which also powers the launchers' `--device=tpu`
+flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Sequence
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceInfo:
+    """Environment description for the rank-0-style banner (SURVEY I6)."""
+
+    platform: str  # 'tpu' | 'cpu' | 'gpu'
+    device_kind: str  # e.g. 'TPU v5 lite'
+    num_devices: int
+    jax_version: str
+    process_index: int
+    num_processes: int
+    memory_gib: float | None  # per-device HBM, when the backend reports it
+
+
+def platform_name(devices: Sequence[jax.Device] | None = None) -> str:
+    """Platform of the (first) benchmark device: 'tpu', 'gpu', or 'cpu'."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return devices[0].platform if devices else jax.default_backend()
+
+
+def resolve_devices(
+    device: str | None = None, num_devices: int | None = None
+) -> list[jax.Device]:
+    """Pick the devices to benchmark on.
+
+    ``device`` is the launchers' ``--device`` flag value ('tpu', 'cpu', 'gpu',
+    or None = default backend). ``num_devices`` truncates to the first N
+    devices — the analogue of torchrun's ``--nproc_per_node=N`` (reference
+    `run_scaling_benchmark.sh:23-31`), which caps how many chips participate.
+    """
+    if device is None:
+        devices = jax.devices()
+    else:
+        devices = jax.devices(device)
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices but only {len(devices)} "
+                f"{platform_name(devices)} device(s) are available"
+            )
+        devices = devices[:num_devices]
+    return list(devices)
+
+
+# Per-device HBM capacity fallback (GiB) for backends whose PJRT plugin does
+# not report memory_stats. Keyed by device_kind substring, like the peak table.
+_KNOWN_HBM_GIB = {
+    "v6 lite": 32.0,
+    "v6e": 32.0,
+    "v5p": 95.0,
+    "v5 lite": 16.0,
+    "v5e": 16.0,
+    "v4": 32.0,
+    "v3": 16.0,  # per JAX device (= TensorCore) on v3
+    "v2": 8.0,
+}
+
+
+def _device_memory_gib(dev: jax.Device) -> float | None:
+    try:
+        stats = dev.memory_stats()
+    except Exception:  # CPU backend has no memory_stats
+        stats = None
+    if stats:
+        limit = stats.get("bytes_limit")
+        if limit:
+            return limit / (1024**3)
+    kind = dev.device_kind.lower()
+    for key, gib in _KNOWN_HBM_GIB.items():
+        if key in kind:
+            return gib
+    return None
+
+
+def collect_device_info(devices: Sequence[jax.Device] | None = None) -> DeviceInfo:
+    devices = list(devices) if devices is not None else jax.devices()
+    first = devices[0]
+    return DeviceInfo(
+        platform=first.platform,
+        device_kind=first.device_kind,
+        num_devices=len(devices),
+        jax_version=jax.__version__,
+        process_index=jax.process_index(),
+        num_processes=jax.process_count(),
+        memory_gib=_device_memory_gib(first),
+    )
+
+
+def device_banner(info: DeviceInfo) -> str:
+    """Environment banner ≙ reference `matmul_benchmark.py:178-190` (versions,
+    device names, memory) re-expressed for JAX/TPU."""
+    lines = [
+        f"JAX version: {info.jax_version}",
+        f"Backend platform: {info.platform}",
+        f"Number of devices: {info.num_devices}",
+        f"Device kind: {info.device_kind}",
+        f"Processes: {info.num_processes} (this is process {info.process_index})",
+    ]
+    if info.memory_gib is not None:
+        lines.append(f"Memory per device: {info.memory_gib:.2f} GiB")
+    return "\n".join(lines)
+
+
+def maybe_init_multihost() -> None:
+    """Multi-host rendezvous hook.
+
+    The reference is single-node only (SURVEY §2: no --nnodes/--rdzv flags in
+    any launcher). The TPU-native analogue of going multi-node is
+    `jax.distributed.initialize()`, which joins this process to a multi-host
+    TPU slice so collectives ride ICI/DCN across hosts. We call it only when
+    the standard cluster env vars are present, keeping single-host runs
+    untouched.
+    """
+    in_cluster = any(
+        v in os.environ
+        for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    # Must run before any backend-initializing call (jax.devices(),
+    # process_count(), ...), so gate on env vars only.
+    if in_cluster:
+        try:
+            jax.distributed.initialize()
+        except Exception as e:
+            msg = str(e).lower()
+            if "already" in msg or "initialized" in msg:
+                return  # benign: called twice in one process
+            import sys
+
+            print(
+                f"WARNING: multi-host init failed ({e}); continuing single-host "
+                f"— world size will only cover local devices",
+                file=sys.stderr,
+            )

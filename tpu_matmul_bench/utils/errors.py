@@ -1,0 +1,32 @@
+"""OOM / error resilience helpers (SURVEY I7).
+
+The reference wraps each matrix size in try/except CUDA-OOM and continues to
+the next size (`matmul_scaling_benchmark.py:337-342`), then empties the CUDA
+cache between sizes (`:344-347`). The XLA analogue of the OOM type is an
+XlaRuntimeError carrying RESOURCE_EXHAUSTED; buffer reclamation happens when
+the operand arrays are deleted, so the "empty cache" step is dropping
+references (plus an optional live-array delete for eagerness).
+"""
+
+from __future__ import annotations
+
+import gc
+
+import jax
+
+
+def is_oom_error(e: BaseException) -> bool:
+    msg = str(e)
+    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "out of memory" in msg
+
+
+def release_device_memory(*arrays: object) -> None:
+    """Drop operand references and collect, ≙ `torch.cuda.empty_cache()`
+    between sizes (reference `matmul_scaling_benchmark.py:344`)."""
+    for a in arrays:
+        try:
+            if isinstance(a, jax.Array):
+                a.delete()
+        except Exception:
+            pass
+    gc.collect()

@@ -1,0 +1,93 @@
+"""Metrics math (SURVEY I4): FLOPs, TFLOPS, memory footprint, efficiency.
+
+TPU-native counterpart of the reference's `calculate_tflops`
+(`matmul_scaling_benchmark.py:63-67`), memory report
+(`matmul_benchmark.py:99-103`), and hardcoded GPU theoretical peaks
+(`matmul_benchmark.py:130-141`) — the peak table below slots TPU chips into
+the same efficiency-% calculation (BASELINE.md: v5e ≈ 197 bf16 TFLOPS/chip
+replaces the RTX 6000 Ada constant).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def matmul_flops(m: int, n: int | None = None, k: int | None = None) -> float:
+    """FLOPs of one dense (m×k)·(k×n) matmul = 2·m·n·k.
+
+    With a single argument, the square case 2·n³ used throughout the
+    reference (`matmul_benchmark.py:34-37`).
+    """
+    n = m if n is None else n
+    k = m if k is None else k
+    return 2.0 * m * n * k
+
+
+def calculate_tflops(matrix_size: int, time_seconds: float, num_ops: int = 1) -> float:
+    """TFLOPS of `num_ops` square matmuls of `matrix_size` done in
+    `time_seconds` ≙ reference `matmul_scaling_benchmark.py:63-67`."""
+    if time_seconds <= 0:
+        return float("inf")
+    return matmul_flops(matrix_size) * num_ops / time_seconds / 1e12
+
+
+def bytes_per_element(dtype: Any) -> int:
+    """≙ reference `matmul_benchmark.py:99` (4 for fp32 else 2), but exact for
+    any dtype via the dtype itself."""
+    return jnp.dtype(dtype).itemsize
+
+
+def matrix_memory_gib(size: int, dtype: Any, count: int = 1) -> float:
+    """Memory of `count` size×size matrices in GiB ≙ `matmul_benchmark.py:99-103`."""
+    return count * size * size * bytes_per_element(dtype) / (1024**3)
+
+
+# Theoretical peak dense-matmul throughput per chip, TFLOPS, by device kind.
+# TPU rows are from Google's published per-chip specs; TPUs execute matmuls on
+# the MXU in bf16 (fp32 inputs are handled via multi-pass bf16, so no separate
+# fp32 peak is published — efficiency is reported against the bf16 peak, and
+# the dtype sweep shows the achieved gap instead). GPU rows reproduce the
+# constants the reference hardcodes (`matmul_benchmark.py:133-139`) so runs on
+# those GPUs report identical efficiency percentages.
+_PEAKS: dict[str, dict[str, float | None]] = {
+    # key: lowercase substring of jax Device.device_kind
+    "v6 lite": {"bfloat16": 918.0, "float16": 918.0, "float32": None},
+    "v6e": {"bfloat16": 918.0, "float16": 918.0, "float32": None},
+    "v5p": {"bfloat16": 459.0, "float16": 459.0, "float32": None},
+    "v5 lite": {"bfloat16": 197.0, "float16": 197.0, "float32": None},
+    "v5e": {"bfloat16": 197.0, "float16": 197.0, "float32": None},
+    "v4": {"bfloat16": 275.0, "float16": 275.0, "float32": None},
+    "v3": {"bfloat16": 123.0, "float16": 123.0, "float32": None},
+    "v2": {"bfloat16": 45.0, "float16": 45.0, "float32": None},
+    # GPU parity rows (reference matmul_benchmark.py:133-139)
+    "rtx 6000 ada": {"bfloat16": 182.2, "float16": 182.2, "float32": 91.1},
+    "radeon": {"bfloat16": 123.0, "float16": 123.0, "float32": 61.4},
+    "amd": {"bfloat16": 123.0, "float16": 123.0, "float32": 61.4},
+}
+
+
+def theoretical_peak_tflops(device_kind: str, dtype: Any) -> float | None:
+    """Per-chip theoretical peak for the efficiency %; None when unknown.
+
+    Device matching is by substring, the same scheme the reference uses for
+    its AMD detection (`matmul_benchmark.py:131-132`).
+    """
+    kind = device_kind.lower()
+    dtype_name = jnp.dtype(dtype).name
+    for key, peaks in _PEAKS.items():
+        if key in kind:
+            return peaks.get(dtype_name)
+    return None
+
+
+def scaling_efficiency(total_tflops: float, single_tflops: float, world: int) -> float | None:
+    """Scaling efficiency % = total / (single·world) · 100 ≙ reference
+    `matmul_scaling_benchmark.py:315`. None when the single-device figure is
+    unavailable or world == 0."""
+    if world <= 0 or single_tflops <= 0 or not np.isfinite(single_tflops):
+        return None
+    return total_tflops / (single_tflops * world) * 100.0

@@ -1,0 +1,169 @@
+"""Reporting (SURVEY I5/I6): human-readable stdout blocks + structured JSON.
+
+The reference prints rank-0-gated text only, and its comparison driver scrapes
+that stdout (`backup/compare_benchmarks.py:20-26`). Here every benchmark emits
+*both* the human report and structured JSON-lines records, so the comparison
+driver consumes data instead of grepping (SURVEY §5 "observability"
+recommendation). Under single-controller JAX all metrics are already global,
+so there is no rank gating; multi-host runs gate on process_index == 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+from typing import Any, IO
+
+import jax
+
+from tpu_matmul_bench.utils.metrics import (
+    matmul_flops,
+    matrix_memory_gib,
+    scaling_efficiency,
+    theoretical_peak_tflops,
+)
+
+
+@dataclasses.dataclass
+class BenchmarkRecord:
+    """One (benchmark, mode, size) measurement — the unit of reporting.
+
+    Mirrors the fields of the reference's per-size results block
+    (`matmul_scaling_benchmark.py:308-335`), plus the compute/comm split
+    (`:162-163`) when the mode measures it.
+    """
+
+    benchmark: str  # e.g. 'matmul', 'scaling', 'distributed', 'overlap'
+    mode: str  # e.g. 'single', 'independent', ...
+    size: int
+    dtype: str
+    world: int
+    iterations: int
+    warmup: int
+    avg_time_s: float
+    tflops_per_device: float
+    tflops_total: float
+    device_kind: str = ""
+    compute_time_s: float | None = None
+    comm_time_s: float | None = None
+    comm_overhead_pct: float | None = None
+    scaling_efficiency_pct: float | None = None
+    peak_efficiency_pct: float | None = None
+    extras: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def finalize(self) -> "BenchmarkRecord":
+        """Fill derived fields (comm overhead, peak efficiency)."""
+        if (
+            self.comm_overhead_pct is None
+            and self.comm_time_s is not None
+            and self.compute_time_s is not None
+            and (self.compute_time_s + self.comm_time_s) > 0
+        ):
+            self.comm_overhead_pct = (
+                100.0 * self.comm_time_s / (self.compute_time_s + self.comm_time_s)
+            )
+        if self.peak_efficiency_pct is None and self.device_kind:
+            peak = theoretical_peak_tflops(self.device_kind, self.dtype)
+            if peak:
+                self.peak_efficiency_pct = 100.0 * self.tflops_per_device / peak
+        return self
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+
+def is_reporting_process() -> bool:
+    """≙ the reference's `if rank == 0:` gate — true on the controller."""
+    return jax.process_index() == 0
+
+
+def report(*lines: str, file: IO[str] | None = None) -> None:
+    """Print on the reporting process only (SURVEY I5 rank-0 printing)."""
+    if is_reporting_process():
+        print(*lines, sep="\n", file=file or sys.stdout, flush=True)
+
+
+def header(title: str, config: dict[str, Any]) -> str:
+    """Config header block ≙ reference `matmul_scaling_benchmark.py:256-266`."""
+    bar = "=" * 60
+    lines = [bar, title, bar, "Configuration:"]
+    lines += [f"  - {k}: {v}" for k, v in config.items()]
+    lines.append(bar)
+    return "\n".join(lines)
+
+
+def size_preamble(size: int, dtype: str) -> str:
+    """Per-size memory preamble ≙ reference `matmul_benchmark.py:99-103`."""
+    per = matrix_memory_gib(size, dtype)
+    return (
+        f"\nBenchmarking {size}x{size} matrix multiplication:\n"
+        f"  - Memory per matrix: {per:.2f} GiB ({dtype})\n"
+        f"  - Total memory for A, B, C: {3 * per:.2f} GiB"
+    )
+
+
+def format_record(rec: BenchmarkRecord) -> str:
+    """Per-size results block ≙ reference `matmul_scaling_benchmark.py:308-335`."""
+    rec.finalize()
+    lines = [
+        f"\nResults for {rec.size}x{rec.size} [{rec.mode}]:",
+        f"  - Average time per operation: {rec.avg_time_s * 1e3:.3f} ms",
+        f"  - TFLOPS per device: {rec.tflops_per_device:.2f}",
+        f"  - Total TFLOPS ({rec.world} device(s)): {rec.tflops_total:.2f}",
+        f"  - FLOPs per operation: {matmul_flops(rec.size) / 1e12:.2f} TFLOPs",
+    ]
+    if rec.compute_time_s is not None and rec.comm_time_s is not None:
+        # compute/comm split line ≙ matmul_scaling_benchmark.py:162-163
+        lines.append(
+            f"  - Compute: {rec.compute_time_s * 1e3:.3f} ms, "
+            f"Comm: {rec.comm_time_s * 1e3:.3f} ms "
+            f"({rec.comm_overhead_pct:.1f}% comm overhead)"
+        )
+    if rec.scaling_efficiency_pct is not None:
+        lines.append(f"  - Scaling efficiency: {rec.scaling_efficiency_pct:.1f}%")
+    if rec.peak_efficiency_pct is not None:
+        lines.append(
+            f"  - Device efficiency: {rec.peak_efficiency_pct:.1f}% of "
+            f"{rec.device_kind} theoretical peak"
+        )
+    for k, v in rec.extras.items():
+        lines.append(f"  - {k}: {v}")
+    return "\n".join(lines)
+
+
+class JsonWriter:
+    """JSON-lines sink for BenchmarkRecords (the structured channel the
+    comparison driver reads instead of scraping stdout)."""
+
+    def __init__(self, path: str | None):
+        self._path = path
+        self._fh: IO[str] | None = None
+        if path and is_reporting_process():
+            self._fh = sys.stdout if path == "-" else open(path, "w")
+
+    def write(self, rec: BenchmarkRecord) -> None:
+        if self._fh is not None:
+            self._fh.write(rec.to_json() + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None and self._fh is not sys.stdout:
+            self._fh.close()
+        self._fh = None
+
+    def __enter__(self) -> "JsonWriter":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def attach_scaling_efficiency(
+    rec: BenchmarkRecord, single_device_tflops: float | None
+) -> BenchmarkRecord:
+    if single_device_tflops:
+        rec.scaling_efficiency_pct = scaling_efficiency(
+            rec.tflops_total, single_device_tflops, rec.world
+        )
+    return rec

@@ -1,0 +1,203 @@
+"""Timing engine — the XLA-native replacement for CUDA-event timing (SURVEY I3).
+
+The reference has two timing regimes:
+
+1. *Whole-loop* timing: one CUDA event pair around N iterations (reference
+   `matmul_benchmark.py:54-68`). Under JAX, dispatch is async exactly like
+   CUDA stream submission, so the equivalent is a host clock around N
+   dispatches followed by one synchronization.
+2. *Per-iteration split* timing: an event pair around the compute leg and one
+   around the comm leg, deliberately serialized (reference
+   `matmul_scaling_benchmark.py:135-153`). XLA fuses whole programs — there
+   are no event boundaries inside a compiled fn — so the idiomatic equivalent
+   is timing *program variants*: the compute-only program vs the serialized
+   compute+comm program, with comm = full − compute (SURVEY §7 "hard parts").
+   `time_legs` (separately jitted legs, each synced) is also provided for the
+   faithful per-iteration form.
+
+Synchronization: `jax.block_until_ready` is the normal barrier, but on
+tunneled/experimental PJRT backends (e.g. the 'axon' remote-TPU platform in
+this environment) it can return before the queue drains. The only reliable
+barrier there is a device→host transfer of a value data-dependent on the
+result. `sync()` therefore reduces the output to a scalar and fetches it; the
+fixed round-trip latency of that fetch is measured per call site and
+subtracted from the timed loop, so reported times converge to pure device
+time as iterations grow.
+
+Warmup precedes every timed loop and absorbs jit compilation and XLA
+autotuning, mirroring how the reference's warmup absorbs cuBLAS autotuning
+(reference `matmul_benchmark.py:44-49`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _to_scalar(x: jax.Array) -> jax.Array:
+    # cheap data-dependent scalar; sum keeps it shape-polymorphic via jit cache
+    return jnp.sum(x, dtype=jnp.float32) if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.sum(x)
+
+
+def sync(out: Any) -> None:
+    """Barrier that provably waits: fetch a scalar derived from `out`.
+
+    ≙ `torch.cuda.synchronize()` / event `elapsed_time` in the reference;
+    works even where block_until_ready is a no-op (see module docstring).
+    """
+    leaf = jax.tree_util.tree_leaves(out)[-1]
+    if isinstance(leaf, jax.Array):
+        np.asarray(_to_scalar(leaf))
+    # non-array leaves are host values already
+
+
+@dataclasses.dataclass(frozen=True)
+class Timing:
+    """Wall-clock result of a timed loop (sync overhead already removed)."""
+
+    total_s: float
+    iterations: int
+    sync_overhead_s: float = 0.0  # measured fixed barrier cost, for reporting
+    reliable: bool = True  # False when device time never cleared the barrier noise
+
+    @property
+    def avg_s(self) -> float:
+        return self.total_s / self.iterations
+
+    @property
+    def avg_ms(self) -> float:
+        return self.avg_s * 1e3
+
+
+def _measure_sync_overhead(out: Any, samples: int = 3) -> float:
+    """Fixed cost of `sync` on already-finished work (round-trip latency)."""
+    best = float("inf")
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def time_jitted(
+    fn: Callable[..., Any],
+    args: Sequence[Any],
+    *,
+    iterations: int = 50,
+    warmup: int = 10,
+) -> Timing:
+    """Whole-loop timing of a jitted fn ≙ reference `matmul_benchmark.py:39-79`.
+
+    N async dispatches bracketed by one barrier; warmup (which includes the
+    compile on first call) runs first and is excluded, and the barrier's fixed
+    round-trip latency is measured and subtracted.
+    """
+    out = None
+    for _ in range(max(warmup, 1)):  # at least once, to absorb compilation
+        out = fn(*args)
+    sync(out)
+    overhead = _measure_sync_overhead(out)
+
+    # Auto-scale the iteration count until device time dominates the barrier
+    # round-trip, else short loops on high-latency backends measure only the
+    # barrier. One barrier per loop regardless of scale, so the overhead stays
+    # amortized. Capped to keep worst-case wall time bounded.
+    factor = 1
+    while True:
+        n = iterations * factor
+        start = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        sync(out)
+        raw = time.perf_counter() - start
+        device_total = raw - overhead
+        if device_total >= 5 * overhead or factor >= 256:
+            break
+        per_iter = max(device_total / n, 1e-9)
+        need = int(5 * overhead / (per_iter * iterations)) + 1
+        factor = min(max(need, factor * 2), 256)
+    return Timing(
+        total_s=max(device_total, 1e-12),
+        iterations=n,
+        sync_overhead_s=overhead,
+        reliable=device_total >= 2 * overhead,
+    )
+
+
+def time_variants(
+    compute_fn: Callable[..., Any],
+    full_fn: Callable[..., Any],
+    args: Sequence[Any],
+    *,
+    iterations: int = 50,
+    warmup: int = 10,
+) -> tuple[Timing, Timing, float]:
+    """Compute/comm split via program variants (the XLA-native split, SURVEY §7).
+
+    Times the compute-only program and the full (serialized compute+comm)
+    program under identical protocol; returns (compute, full, comm_seconds)
+    where comm = max(full − compute, 0) per iteration. The full program must
+    serialize its legs (e.g. with `optimization_barrier`) for the difference
+    to equal the comm leg — the builders in `parallel.modes` do this.
+    """
+    t_compute = time_jitted(compute_fn, args, iterations=iterations, warmup=warmup)
+    t_full = time_jitted(full_fn, args, iterations=iterations, warmup=warmup)
+    comm_s = max(t_full.avg_s - t_compute.avg_s, 0.0)
+    return t_compute, t_full, comm_s
+
+
+def time_legs(
+    legs: Sequence[Callable[..., Any]],
+    args: Sequence[Any],
+    *,
+    iterations: int = 50,
+    warmup: int = 10,
+) -> list[Timing]:
+    """Per-iteration split timing ≙ reference `matmul_scaling_benchmark.py:135-153`.
+
+    ``legs`` is a chain: ``legs[0](*args)`` produces ``x``; each later leg is
+    called as ``leg(x)`` on the previous leg's output. Every leg is synced
+    before the next leg's clock starts — the deliberate serialization that
+    makes compute and comm separately measurable (and that the overlap suite
+    then beats). Per-leg sync overhead is subtracted. On high-latency
+    tunneled backends prefer `time_variants` (2 barriers total instead of
+    2·iterations).
+    """
+    if not legs:
+        raise ValueError("need at least one leg")
+
+    def run_chain() -> Any:
+        x = legs[0](*args)
+        for leg in legs[1:]:
+            x = leg(x)
+        return x
+
+    out = None
+    for _ in range(max(warmup, 1)):
+        out = run_chain()
+    sync(out)
+    overhead = _measure_sync_overhead(out)
+
+    totals = [0.0] * len(legs)
+    for _ in range(iterations):
+        x: Any = args
+        for i, leg in enumerate(legs):
+            start = time.perf_counter()
+            x = leg(*x) if i == 0 else leg(x)
+            sync(x)
+            totals[i] += time.perf_counter() - start
+    return [
+        Timing(
+            total_s=max(t - overhead * iterations, 1e-12),
+            iterations=iterations,
+            sync_overhead_s=overhead,
+        )
+        for t in totals
+    ]

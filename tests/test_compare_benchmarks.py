@@ -14,7 +14,8 @@ def test_compare_small(tmp_path):
     )
     # all nine comparison points measured
     expected = {"single", "independent", "batch_parallel", "matrix_parallel",
-                "no_overlap", "overlap", "pipeline", "collective_matmul"}
+                "no_overlap", "overlap", "pipeline", "collective_matmul",
+                "pallas_ring", "single_float32", "single_bfloat16"}
     assert expected <= set(results)
     lines = [json.loads(l) for l in out.read_text().splitlines()]
     assert {l["comparison_key"] for l in lines} >= expected

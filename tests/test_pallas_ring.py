@@ -1,0 +1,34 @@
+"""Pallas ring all-gather matmul: full ring semantics (RDMA + barrier +
+double buffering) exercised in interpreter mode on the 8-device CPU mesh."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+from tpu_matmul_bench.ops.pallas_ring import ring_allgather_matmul
+from tpu_matmul_bench.parallel.mesh import sharded_normal
+
+
+@pytest.mark.parametrize("m,k,n", [(64, 32, 64), (128, 128, 128)])
+def test_matches_dense(mesh, m, k, n):
+    (x,) = sharded_normal(0, (m, k), jnp.float32, mesh, P("x", None), count=1)
+    (w,) = sharded_normal(1, (k, n), jnp.float32, mesh, P(None, "x"), count=1)
+    fn = ring_allgather_matmul(mesh)
+    got = np.asarray(fn(x, w))
+    want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_chunk_placement(mesh):
+    # make each device's X chunk a distinct constant; with W = identity the
+    # output rows must land in origin order, proving the ring bookkeeping
+    d = 8
+    m, k = 64, 64
+    x = jnp.repeat(jnp.arange(d, dtype=jnp.float32), m // d)[:, None] * jnp.ones((1, k))
+    w = jnp.eye(k, dtype=jnp.float32)
+    fn = ring_allgather_matmul(mesh)
+    got = np.asarray(fn(x, w))
+    want = np.asarray(x) @ np.eye(k, dtype=np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

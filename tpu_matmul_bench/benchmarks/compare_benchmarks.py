@@ -56,6 +56,34 @@ def compare(size: int, dtype: str, num_devices: int | None,
         for rec in _run(matmul_overlap_benchmark.main, base + ["--mode", mode]):
             results[mode] = rec
 
+    # pallas_ring is VMEM-resident — run it at the largest size that fits
+    from tpu_matmul_bench.parallel.overlap import pallas_ring_max_size
+    import jax
+
+    ring_size = size
+    if jax.default_backend() == "tpu":
+        ring_size = min(size, pallas_ring_max_size(num_devices or 1, dtype))
+    ring_args = [a if a != str(size) else str(ring_size) for a in base]
+    report(f"\n### overlap: pallas_ring (size {ring_size}) " + "#" * 30)
+    for rec in _run(matmul_overlap_benchmark.main, ring_args + ["--mode", "pallas_ring"]):
+        if ring_size != size:
+            rec.extras["note"] = f"run at {ring_size} (VMEM-resident kernel), not {size}"
+        results["pallas_ring"] = rec
+
+    # dtype sweep on one device ≙ the reference README's bf16-vs-fp32
+    # key insight (README.md:50, ~5× on the RTX 6000 Ada)
+    for dt in ("float32", "float16", "bfloat16"):
+        if dt == dtype:
+            if "single" in results:
+                results[f"single_{dt}"] = results["single"]
+            continue
+        report(f"\n### single-device {dt} " + "#" * 40)
+        sweep_args = ["--sizes", str(size), "--dtype", dt,
+                      "--iterations", str(iterations), "--warmup", str(warmup),
+                      "--num-devices", "1"]
+        for rec in _run(matmul_benchmark.main, sweep_args):
+            results[f"single_{dt}"] = rec
+
     return results
 
 
@@ -93,6 +121,13 @@ def summarize(results: dict[str, BenchmarkRecord]) -> str:
         sp = results["collective_matmul"].extras.get("overlap_speedup_x")
         if sp:
             lines.append(f"ppermute collective matmul: {sp}x vs gather-then-matmul")
+    if "single_bfloat16" in results and "single_float32" in results:
+        f32, bf16 = results["single_float32"], results["single_bfloat16"]
+        if f32.avg_time_s > 0 and bf16.avg_time_s > 0:
+            lines.append(
+                f"bf16 vs fp32 speedup: {f32.avg_time_s / bf16.avg_time_s:.2f}x "
+                f"(reference observed ~5x on the RTX 6000 Ada, README.md:50)"
+            )
     lines.append("=" * 70)
     return "\n".join(lines)
 

@@ -31,6 +31,7 @@ from tpu_matmul_bench.utils.device import (
     resolve_devices,
 )
 from tpu_matmul_bench.utils.metrics import calculate_tflops
+from tpu_matmul_bench.utils.profiling import maybe_trace
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord, header, report
 from tpu_matmul_bench.utils.timing import time_jitted
 
@@ -124,12 +125,13 @@ def run(config: BenchConfig) -> list[BenchmarkRecord]:
             return _bench_single(config, size, info.device_kind, devices[0])
         return _bench_all_devices(config, size, devices, info.device_kind)
 
-    records = run_sizes(
-        config,
-        bench_one,
-        memory_gib=lambda s: MatmulWorkload(s, config.dtype).memory_gib,
-        memory_limit_gib=info.memory_gib,
-    )
+    with maybe_trace(config.profile_dir):
+        records = run_sizes(
+            config,
+            bench_one,
+            memory_gib=lambda s: MatmulWorkload(s, config.dtype).memory_gib,
+            memory_limit_gib=info.memory_gib,
+        )
     report("\n" + "=" * 60, "Benchmark completed!", "=" * 60)
     return records
 

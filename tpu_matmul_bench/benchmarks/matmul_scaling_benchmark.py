@@ -23,6 +23,7 @@ from tpu_matmul_bench.parallel.modes import (
     run_mode_benchmark,
 )
 from tpu_matmul_bench.utils.config import BenchConfig, parse_config
+from tpu_matmul_bench.utils.profiling import maybe_trace
 from tpu_matmul_bench.utils.device import (
     collect_device_info,
     device_banner,
@@ -87,12 +88,13 @@ def run(
             attach_scaling_efficiency(rec, _single_device_tflops(config, devices[0], size))
         return rec
 
-    records = run_sizes(
-        config,
-        bench_one,
-        memory_gib=lambda s: estimate_memory_gib(config.mode, config, d, s),
-        memory_limit_gib=info.memory_gib,
-    )
+    with maybe_trace(config.profile_dir):
+        records = run_sizes(
+            config,
+            bench_one,
+            memory_gib=lambda s: estimate_memory_gib(config.mode, config, d, s),
+            memory_limit_gib=info.memory_gib,
+        )
     report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)
     return records
 

@@ -1,0 +1,147 @@
+"""Pallas ring all-gather matmul — in-kernel RDMA overlapped with MXU work.
+
+The north-star form of the overlap suite (BASELINE.json): where the reference
+overlaps NCCL all_reduce with cuBLAS matmul via two CUDA streams
+(`backup/matmul_overlap_benchmark.py:124-157`), this kernel overlaps the
+inter-chip transfer with the matmul *inside one Pallas kernel*: a
+double-buffered ring where step t multiplies the X chunk currently resident
+in VMEM while `make_async_remote_copy` streams that chunk to the right
+neighbor over ICI (pattern: Pallas guide "Ring Collectives" + "Double
+Buffering").
+
+Y = X·W with X row-sharded [m/D, k] and W column-sharded [k, n/D]; each
+device produces its Y column block [m, n/D] without ever materializing the
+gathered X. The lax-level counterpart (XLA-scheduled) lives in
+`parallel/overlap.py collective_matmul_program`; this kernel is the
+hand-scheduled version where the overlap is explicit rather than left to the
+XLA scheduler.
+
+Scope note: operands are VMEM-resident, so per-device shards must fit the
+~16 MB/core VMEM budget (shard_m·k + k·shard_n + buffers). Fine for the ring
+sizes this mode benchmarks per-chunk; an HBM-blocked variant is future work.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_matmul_bench.parallel.mesh import smap
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ring_kernel(d: int, axis: str, use_barrier: bool, x_ref, w_ref, o_ref,
+                 comm_buf, send_sem, recv_sem, free_sem):
+    """One device's program: ring-rotate X chunks, matmul each into place.
+
+    Flow control: with only 2 comm slots, a device running ahead could RDMA
+    into the slot its right neighbor is still multiplying from (the slot
+    reused every 2 steps). Each device therefore acks its writer — after
+    finishing the matmul on slot s it signals `free_sem[s]` on its LEFT
+    neighbor, and a writer targeting the right neighbor's slot s at step
+    t ≥ 1 first waits for that ack. Ack counts are balanced (d−2 signals,
+    d−2 waits per device), so all semaphores drain to zero at kernel exit
+    as Mosaic requires.
+    """
+    mshard = x_ref.shape[0]
+    my = jax.lax.axis_index(axis)
+    right = jax.lax.rem(my + 1, d)
+    left = jax.lax.rem(my + d - 1, d)
+
+    if use_barrier:
+        # neighbor barrier: both neighbors must have entered the kernel
+        # (their comm buffers exist) before any RDMA lands in them.
+        # get_barrier_semaphore has no interpreter lowering, so this runs on
+        # compiled TPU only — the interpreter executes shards without the
+        # hazard the barrier guards against.
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    comm_buf[0] = x_ref[:]  # own chunk seeds slot 0
+
+    for t in range(d):
+        cur, nxt = t % 2, (t + 1) % 2
+        if t + 1 < d:
+            if t >= 1 and use_barrier:
+                # right neighbor read slot `nxt` during its step t-1; wait
+                # for its ack before overwriting (WAR hazard, see docstring).
+                # Gated with use_barrier: the interpreter has no remote
+                # signal support and also no cross-device timing race.
+                pltpu.semaphore_wait(free_sem.at[nxt], 1)
+            # stream the resident chunk onward while we multiply it
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[cur],
+                dst_ref=comm_buf.at[nxt],
+                send_sem=send_sem.at[cur],
+                recv_sem=recv_sem.at[nxt],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+
+        # chunk resident at step t originated at device (my - t) mod d
+        src = jax.lax.rem(my + d - t, d) if t else my
+        block = jnp.dot(comm_buf[cur], w_ref[:],
+                        preferred_element_type=jnp.float32)
+        o_ref[pl.ds(src * mshard, mshard), :] = block.astype(o_ref.dtype)
+
+        if t <= d - 3 and use_barrier:
+            # done reading slot `cur` — tell our writer it may reuse it
+            pltpu.semaphore_signal(free_sem.at[cur], inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        if t + 1 < d:
+            # wait: our send drained AND the left neighbor's chunk arrived
+            rdma.wait()
+
+
+def ring_allgather_matmul(mesh: Mesh, axis: str = "x",
+                          interpret: bool | None = None):
+    """Build the jitted shard_map'd kernel for `mesh`.
+
+    Returns fn(x, w) with x sharded P(axis, None) and w P(None, axis),
+    yielding y sharded P(None, axis) — same contract as
+    `collective_matmul_program`. `interpret=None` auto-selects interpreter
+    mode off-TPU (the CPU-mesh tests exercise the full ring semantics
+    including the remote DMAs).
+    """
+    d = mesh.shape[axis]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def per_device(x_local, w_local):
+        mshard, k = x_local.shape
+        nshard = w_local.shape[1]
+        m = mshard * d
+        kernel = functools.partial(_ring_kernel, d, axis, not interpret)
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((m, nshard), x_local.dtype),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((2, mshard, k), x_local.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR((2,)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=0,
+            ),
+            interpret=interpret,
+        )(x_local, w_local)
+
+    return smap(per_device, mesh, in_specs=(P(axis, None), P(None, axis)),
+                out_specs=P(None, axis), check_vma=False)

@@ -244,8 +244,12 @@ def collective_matmul_program(mesh: Mesh, overlap: bool = True,
                 out_specs=P(None, "x"), check_vma=False)
 
 
-def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
-                           benchmark: str = "overlap") -> ModeSetup:
+def _vs_baseline_mode(config: BenchConfig, mesh: Mesh, size: int,
+                      mode_name: str, overlapped_program,
+                      extra_fields: dict, benchmark: str) -> ModeSetup:
+    """Shared builder for the two collective-matmul forms: same operands and
+    gather-then-matmul baseline leg; only the overlapped program and the
+    extras labeling differ."""
     d = world_size(mesh)
     (x,) = sharded_normal(config.seed, (size, size), config.dtype, mesh,
                           P("x", None), count=1)
@@ -253,8 +257,6 @@ def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
                           P(None, "x"), count=1)
     baseline = collective_matmul_program(mesh, overlap=False,
                                          impl=config.matmul_impl)
-    overlapped = collective_matmul_program(mesh, overlap=True,
-                                           impl=config.matmul_impl)
 
     def build(t_compute: Timing, t_full: Timing | None, comm_s: float) -> BenchmarkRecord:
         # here 'compute' = gather-then-matmul baseline, 'full' = overlapped
@@ -263,7 +265,7 @@ def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
         actual = calculate_tflops(size, t_ovl.avg_s)
         speedup = t_base.avg_s / t_ovl.avg_s if t_ovl.avg_s > 0 else 1.0
         return BenchmarkRecord(
-            benchmark=benchmark, mode="collective_matmul", size=size,
+            benchmark=benchmark, mode=mode_name, size=size,
             dtype=config.dtype_name, world=d,
             iterations=t_ovl.iterations, warmup=config.warmup,
             avg_time_s=t_ovl.avg_s,
@@ -275,13 +277,62 @@ def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
                 "baseline": "all_gather-then-matmul",
                 "baseline_time_ms": round(t_base.avg_ms, 3),
                 "overlap_speedup_x": round(speedup, 3),
-                "matmul_impl": config.matmul_impl,
+                **extra_fields,
             },
         )
 
-    return ModeSetup("collective_matmul", (x, w), baseline, overlapped, build,
+    return ModeSetup(mode_name, (x, w), baseline, overlapped_program, build,
                      memory_gib_per_device=estimate_memory_gib(
                          "collective_matmul", config, d, size))
+
+
+def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
+                           benchmark: str = "overlap") -> ModeSetup:
+    overlapped = collective_matmul_program(mesh, overlap=True,
+                                           impl=config.matmul_impl)
+    return _vs_baseline_mode(
+        config, mesh, size, "collective_matmul", overlapped,
+        {"matmul_impl": config.matmul_impl}, benchmark,
+    )
+
+
+def pallas_ring_max_size(world: int, dtype) -> int:
+    """Largest lane-aligned size whose pallas_ring VMEM footprint fits the
+    ~14 MiB/core budget: x shard + 2 ring buffers + w shard + y shard
+    ≈ 5·size²/world elements."""
+    item = jnp.dtype(dtype).itemsize
+    budget = 14 * 1024 * 1024
+    s = int((budget * world / (5 * item)) ** 0.5)
+    step = 128 * world  # keep shards lane-aligned and divisible by world
+    return max((s // step) * step, step)
+
+
+def pallas_ring_mode(config: BenchConfig, mesh: Mesh, size: int,
+                     benchmark: str = "overlap") -> ModeSetup:
+    """The in-kernel Pallas version of collective_matmul: ring RDMA
+    (`make_async_remote_copy`) explicitly overlapped with the MXU matmul in
+    one kernel (`ops/pallas_ring.py`). Baseline leg = the XLA
+    gather-then-matmul program, so the record's speedup compares
+    hand-scheduled RDMA overlap against no overlap."""
+    d = world_size(mesh)
+    # VMEM residency bound applies to the compiled TPU kernel only — the
+    # interpreter (CPU mesh) has no VMEM constraint.
+    if jax.default_backend() == "tpu":
+        limit = pallas_ring_max_size(d, config.dtype)
+        if size > limit:
+            raise ValueError(
+                f"pallas_ring at size {size} exceeds the ~14 MiB/core VMEM "
+                f"budget (max size for {d} devices/{config.dtype_name}: "
+                f"{limit}); use --sizes {limit} or the XLA-scheduled "
+                f"collective_matmul mode"
+            )
+    from tpu_matmul_bench.ops.pallas_ring import ring_allgather_matmul
+
+    kernel = ring_allgather_matmul(mesh)
+    return _vs_baseline_mode(
+        config, mesh, size, "pallas_ring", kernel,
+        {"kernel": "pallas ring RDMA all-gather matmul"}, benchmark,
+    )
 
 
 OVERLAP_MODES = {
@@ -289,4 +340,5 @@ OVERLAP_MODES = {
     "overlap": functools.partial(overlap_mode, variant="overlap"),
     "pipeline": functools.partial(overlap_mode, variant="pipeline"),
     "collective_matmul": collective_matmul_mode,
+    "pallas_ring": pallas_ring_mode,
 }

@@ -48,6 +48,7 @@ class BenchConfig:
     json_out: str | None
     matmul_impl: str
     seed: int
+    profile_dir: str | None = None
 
     @property
     def dtype(self) -> Any:
@@ -99,6 +100,13 @@ def build_parser(
         help="Matmul implementation: XLA jnp.matmul or the Pallas kernel",
     )
     p.add_argument("--seed", type=int, default=0, help="PRNG seed for operand data")
+    p.add_argument(
+        "--profile-dir", type=str, default=None,
+        help="Write a jax.profiler trace of the benchmark here (view with "
+             "TensorBoard / Perfetto). The reference's nearest analogue is "
+             "NCCL_DEBUG=INFO (run_benchmark.sh:16-17); this is the TPU-native "
+             "tracing subsystem.",
+    )
     return p
 
 
@@ -114,6 +122,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         json_out=args.json_out,
         matmul_impl=args.matmul_impl,
         seed=args.seed,
+        profile_dir=getattr(args, "profile_dir", None),
     )
 
 

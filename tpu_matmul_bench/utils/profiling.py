@@ -1,0 +1,31 @@
+"""Tracing/profiling subsystem (SURVEY §5 'auxiliary subsystems').
+
+The reference's only observability beyond timing is `NCCL_DEBUG=INFO`
+(`run_benchmark.sh:16-17`); the TPU-native equivalent is a `jax.profiler`
+trace capturing XLA ops, collectives, and HBM traffic, viewable in
+TensorBoard or Perfetto. Enabled per run via `--profile-dir`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+
+from tpu_matmul_bench.utils.reporting import report
+
+
+@contextlib.contextmanager
+def maybe_trace(profile_dir: str | None) -> Iterator[None]:
+    """Wrap a benchmark run in a profiler trace when a directory is given."""
+    if not profile_dir:
+        yield
+        return
+    report(f"\n[profiler] tracing to {profile_dir}")
+    try:
+        with jax.profiler.trace(profile_dir):
+            yield
+    finally:
+        report(f"[profiler] trace written to {profile_dir} "
+               "(view: tensorboard --logdir <dir> or Perfetto)")

@@ -1,0 +1,67 @@
+"""Tests for device/platform setup (SURVEY I1) and memory estimation (I7)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_matmul_bench.parallel.modes import estimate_memory_gib
+from tpu_matmul_bench.parallel.overlap import pallas_ring_max_size
+from tpu_matmul_bench.utils.config import parse_config
+from tpu_matmul_bench.utils.device import (
+    collect_device_info,
+    device_banner,
+    platform_name,
+    resolve_devices,
+)
+
+
+def test_resolve_devices_caps_count(devices):
+    assert len(resolve_devices(None, 2)) == 2
+    assert len(resolve_devices("cpu", None)) == 8
+    with pytest.raises(ValueError, match="only 8"):
+        resolve_devices(None, 99)
+
+
+def test_platform_and_banner(devices):
+    assert platform_name(devices) == "cpu"
+    info = collect_device_info(devices)
+    assert info.num_devices == 8 and info.platform == "cpu"
+    banner = device_banner(info)
+    assert f"JAX version: {jax.__version__}" in banner
+    assert "Number of devices: 8" in banner
+
+
+def _cfg(dtype="bfloat16"):
+    return parse_config(["--dtype", dtype], "t")
+
+
+def test_estimate_memory_matches_hand_math():
+    cfg = _cfg()
+    # independent: full A, B, C per device = 3·n²·2 bytes
+    n = 1024
+    want = 3 * n * n * 2 / 2**30
+    assert estimate_memory_gib("independent", cfg, 8, n) == pytest.approx(want)
+    # matrix_parallel on 8 devices: 2 + 2/8 matrices
+    want_mp = (2 + 0.25) * n * n * 2 / 2**30
+    assert estimate_memory_gib("matrix_parallel", cfg, 8, n) == pytest.approx(want_mp)
+    # overlap: 2 buffer pairs (3·2 matrices) + ring/temp (2)
+    want_ov = 8 * n * n * 2 / 2**30
+    assert estimate_memory_gib("overlap", cfg, 8, n) == pytest.approx(want_ov)
+
+
+def test_estimate_memory_scales_with_dtype():
+    n = 512
+    bf16 = estimate_memory_gib("independent", _cfg(), 4, n)
+    fp32 = estimate_memory_gib("independent", _cfg("float32"), 4, n)
+    assert fp32 == pytest.approx(2 * bf16)
+
+
+def test_pallas_ring_max_size_fits_budget():
+    for world in (2, 4, 8):
+        s = pallas_ring_max_size(world, jnp.bfloat16)
+        assert s % (128 * world) == 0  # lane-aligned, divisible by world
+        # 5·s²/world elements must be within the ~14 MiB budget
+        assert 5 * s * s // world * 2 <= 14 * 2**20
+        # and the next step up must exceed it (the bound is tight)
+        s2 = s + 128 * world
+        assert 5 * s2 * s2 // world * 2 > 14 * 2**20

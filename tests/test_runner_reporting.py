@@ -1,0 +1,98 @@
+"""Tests for the shared per-size runner (SURVEY I7) and reporting (I5/I6)."""
+
+import json
+
+import pytest
+
+from tpu_matmul_bench.utils.config import parse_config
+from tpu_matmul_bench.utils.reporting import (
+    BenchmarkRecord,
+    JsonWriter,
+    attach_scaling_efficiency,
+    format_record,
+    header,
+    size_preamble,
+)
+from tpu_matmul_bench.benchmarks.runner import run_sizes
+
+
+def _rec(size=64, **kw):
+    base = dict(
+        benchmark="t", mode="m", size=size, dtype="bfloat16", world=2,
+        iterations=5, warmup=1, avg_time_s=0.01, tflops_per_device=1.0,
+        tflops_total=2.0,
+    )
+    base.update(kw)
+    return BenchmarkRecord(**base)
+
+
+def test_run_sizes_skips_failures_and_continues(tmp_path):
+    config = parse_config(
+        ["--sizes", "32", "64", "128", "--json-out", str(tmp_path / "o.jsonl")],
+        "t",
+    )
+    seen = []
+
+    def bench_one(size):
+        seen.append(size)
+        if size == 64:
+            raise RuntimeError("boom")
+        return _rec(size)
+
+    records = run_sizes(config, bench_one)
+    assert seen == [32, 64, 128]  # failure did not stop the sweep (≙ I7)
+    assert [r.size for r in records] == [32, 128]
+    lines = (tmp_path / "o.jsonl").read_text().splitlines()
+    assert [json.loads(l)["size"] for l in lines] == [32, 128]
+
+
+def test_run_sizes_preflight_memory_guard():
+    config = parse_config(["--sizes", "32", "1024"], "t")
+    ran = []
+
+    def bench_one(size):
+        ran.append(size)
+        return _rec(size)
+
+    # 1024 'needs' 100 GiB vs a 1 GiB device → skipped before bench_one
+    records = run_sizes(
+        config, bench_one,
+        memory_gib=lambda s: 100.0 if s == 1024 else 0.001,
+        memory_limit_gib=1.0,
+    )
+    assert ran == [32]
+    assert [r.size for r in records] == [32]
+
+
+def test_finalize_fills_comm_overhead_and_peak():
+    rec = _rec(compute_time_s=0.008, comm_time_s=0.002,
+               device_kind="TPU v5 lite", tflops_per_device=98.5)
+    rec.finalize()
+    assert rec.comm_overhead_pct == pytest.approx(20.0)
+    assert rec.peak_efficiency_pct == pytest.approx(50.0, rel=1e-3)  # /197
+
+
+def test_json_roundtrip_and_writer_stdout_mode(capsys):
+    rec = _rec()
+    with JsonWriter("-") as jw:
+        jw.write(rec)
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["mode"] == "m" and parsed["tflops_total"] == 2.0
+
+
+def test_attach_scaling_efficiency():
+    rec = attach_scaling_efficiency(_rec(), single_device_tflops=1.0)
+    assert rec.scaling_efficiency_pct == pytest.approx(100.0)
+    rec2 = attach_scaling_efficiency(_rec(), single_device_tflops=None)
+    assert rec2.scaling_efficiency_pct is None
+
+
+def test_format_blocks_contain_reference_fields():
+    # the same info the reference's per-size block prints (:308-335)
+    text = format_record(_rec(compute_time_s=0.008, comm_time_s=0.002))
+    assert "Results for 64x64" in text
+    assert "TFLOPS per device" in text
+    assert "comm overhead" in text
+    assert "64x64" in size_preamble(64, "bfloat16")
+    h = header("T", {"Devices": 2})
+    assert "Configuration:" in h and "Devices: 2" in h

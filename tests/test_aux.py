@@ -1,0 +1,68 @@
+"""Tests for auxiliary subsystems: errors (I7), profiling (§5), workloads,
+and the top-level CLI."""
+
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from tpu_matmul_bench.models.workloads import BatchedMatmulWorkload, MatmulWorkload
+from tpu_matmul_bench.utils.errors import is_oom_error, release_device_memory
+from tpu_matmul_bench.utils.profiling import maybe_trace
+
+
+def test_is_oom_error_classification():
+    assert is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: allocating 2.0G"))
+    assert is_oom_error(MemoryError("Out of memory while trying to allocate"))
+    assert not is_oom_error(ValueError("bad shapes"))
+
+
+def test_release_device_memory_deletes_arrays():
+    x = jnp.ones((8, 8))
+    release_device_memory(x, "not-an-array", None)  # non-arrays tolerated
+    assert x.is_deleted()
+
+
+def test_maybe_trace_noop_and_active(tmp_path):
+    with maybe_trace(None):
+        pass  # no-op path
+    d = str(tmp_path / "trace")
+    with maybe_trace(d):
+        (jnp.ones((16, 16)) @ jnp.ones((16, 16))).block_until_ready()
+    # jax.profiler writes plugins/profile/<timestamp>/ under the dir
+    assert any(os.scandir(d)), "trace directory is empty"
+
+
+def test_workload_math_and_operands():
+    wl = MatmulWorkload(128, jnp.bfloat16)
+    assert wl.flops == 2 * 128**3
+    assert wl.memory_gib == pytest.approx(3 * 128 * 128 * 2 / 2**30)
+    a, b = wl.operands()
+    assert a.shape == (128, 128) and a.dtype == jnp.bfloat16
+    # distinct operands, deterministic across calls
+    assert not jnp.array_equal(a, b)
+    a2, _ = wl.operands()
+    assert jnp.array_equal(a, a2)
+
+    bwl = BatchedMatmulWorkload(64, jnp.float32, batch=4)
+    assert bwl.flops == 4 * 2 * 64**3
+    ab, _ = bwl.operands()
+    assert ab.shape == (4, 64, 64)
+
+
+def test_cli_dispatch(capsys):
+    from tpu_matmul_bench.__main__ import main
+
+    with pytest.raises(SystemExit) as ei:
+        main([])
+    assert ei.value.code == 2
+    with pytest.raises(SystemExit) as ei:
+        main(["--help"])
+    assert ei.value.code == 0
+    assert "usage:" in capsys.readouterr().out
+
+    # real dispatch: tiny single-device run through the matmul program
+    records = main(["matmul", "--sizes", "64", "--iterations", "2",
+                    "--warmup", "1", "--num-devices", "1"])
+    assert len(records) == 1 and records[0].size == 64
+    assert "Results for 64x64" in capsys.readouterr().out

@@ -1,0 +1,45 @@
+"""Top-level CLI: `python -m tpu_matmul_bench <program> [flags]`.
+
+One entry point over the four benchmark programs and the comparison driver
+(≙ the reference's four launcher scripts + compare driver, SURVEY I10/I11,
+which have no common CLI). The per-program flags are unchanged — everything
+after the program name is forwarded verbatim.
+"""
+
+from __future__ import annotations
+
+import sys
+
+_PROGRAMS = {
+    "matmul": "tpu_matmul_bench.benchmarks.matmul_benchmark",
+    "scaling": "tpu_matmul_bench.benchmarks.matmul_scaling_benchmark",
+    "distributed": "tpu_matmul_bench.benchmarks.matmul_distributed_benchmark",
+    "overlap": "tpu_matmul_bench.benchmarks.matmul_overlap_benchmark",
+    "compare": "tpu_matmul_bench.benchmarks.compare_benchmarks",
+}
+
+
+def main(argv: list[str] | None = None):
+    """Dispatch to a program's main(); returns its records list."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help") or argv[0] not in _PROGRAMS:
+        is_help = bool(argv) and argv[0] in ("-h", "--help")
+        names = ", ".join(_PROGRAMS)
+        print(f"usage: python -m tpu_matmul_bench {{{names}}} [flags]\n"
+              f"Per-program flags: add --help after the program name.",
+              file=sys.stdout if is_help else sys.stderr)
+        raise SystemExit(0 if is_help else 2)
+    import importlib
+
+    module = importlib.import_module(_PROGRAMS[argv[0]])
+    return module.main(argv[1:])
+
+
+def script_main() -> None:
+    """Console-script entry: discards main()'s records (setuptools wraps the
+    entry point in sys.exit(), and a non-empty list must not become status 1)."""
+    main()
+
+
+if __name__ == "__main__":
+    script_main()

@@ -1,0 +1,91 @@
+"""Tests for the collective bandwidth benchmark (ICI micro-benchmarks)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_matmul_bench.parallel.collective_bench import (
+    COLLECTIVES,
+    collective_setup,
+    run_collective_benchmark,
+)
+from tpu_matmul_bench.utils.config import parse_config
+
+
+def _cfg(extra=()):
+    return parse_config(
+        ["--sizes", "64", "--iterations", "3", "--warmup", "1", *extra], "t"
+    )
+
+
+@pytest.mark.parametrize("op", sorted(COLLECTIVES))
+def test_collective_ops_execute_and_keep_shape_contract(mesh, op):
+    fn, x, spec = collective_setup(_cfg(), mesh, 64, op)
+    out = np.asarray(jnp.asarray(fn(x), jnp.float32))
+    assert np.isfinite(out).all()
+    # shape contract under the stacked P('x') output view: all_gather grows
+    # the global leading dim by d (every shard holds the concatenation),
+    # reduce_scatter shrinks it by d (every shard keeps 1/d of its payload)
+    if op == "all_gather":
+        assert out.shape == (8 * x.shape[0], x.shape[1])
+    elif op == "reduce_scatter":
+        assert out.shape == (x.shape[0] // 8, x.shape[1])
+    else:
+        assert out.shape == x.shape
+
+
+def test_psum_record_bandwidth_math(mesh):
+    rec = run_collective_benchmark(_cfg(), mesh, 64, "psum")
+    payload = 64 * 64 * 2  # bf16
+    assert rec.bytes_per_device == payload
+    assert rec.algbw_gbps == pytest.approx(payload / rec.avg_time_s / 1e9)
+    assert rec.busbw_gbps == pytest.approx(rec.algbw_gbps * 2 * 7 / 8)
+    assert rec.benchmark == "collective" and rec.mode == "psum"
+    assert rec.world == 8
+
+
+def test_bandwidth_conventions():
+    # nccl-tests pairings: (conventional size, bus factor) per op at d=8
+    assert COLLECTIVES["psum"].bus_factor(8) == pytest.approx(1.75)
+    assert COLLECTIVES["all_gather"].bus_factor(8) == pytest.approx(0.875)
+    assert COLLECTIVES["reduce_scatter"].bus_factor(8) == pytest.approx(0.875)
+    assert COLLECTIVES["ppermute"].bus_factor(8) == 1.0
+    assert COLLECTIVES["all_to_all"].bus_factor(8) == pytest.approx(0.875)
+    # all_gather's algbw divides by the total gathered output, others by the
+    # per-rank shard — so per-link traffic/time (busbw) is comparable across
+    # ops: e.g. all_gather busbw = (d-1)·s/t, a full ring's worth
+    s = 1000
+    assert COLLECTIVES["all_gather"].conv_size(8, s) == 8 * s
+    for op in ("psum", "reduce_scatter", "ppermute", "all_to_all"):
+        assert COLLECTIVES[op].conv_size(8, s) == s
+
+
+def test_all_gather_record_uses_output_convention(mesh):
+    rec = run_collective_benchmark(_cfg(), mesh, 64, "all_gather")
+    s = 64 * 64 * 2
+    assert rec.bytes_per_device == s
+    assert rec.algbw_gbps == pytest.approx(8 * s / rec.avg_time_s / 1e9)
+    assert rec.busbw_gbps == pytest.approx(rec.algbw_gbps * 7 / 8)
+
+
+def test_memory_factors_cover_gather_output():
+    assert COLLECTIVES["all_gather"].mem_factor(8) == 10.0  # input + d·out + temp
+    assert COLLECTIVES["psum"].mem_factor(8) == 3.0
+
+
+def test_cli_end_to_end(capsys):
+    from tpu_matmul_bench.benchmarks.collective_benchmark import main
+
+    records = main(["--mode", "all_gather", "--sizes", "64",
+                    "--iterations", "2", "--warmup", "1"])
+    out = capsys.readouterr().out
+    assert "Collective Bandwidth Benchmark" in out
+    assert "Bandwidth:" in out and "GB/s" in out
+    assert len(records) == 1 and records[0].mode == "all_gather"
+
+
+def test_cli_rejects_single_device():
+    from tpu_matmul_bench.benchmarks.collective_benchmark import main
+
+    with pytest.raises(SystemExit):
+        main(["--num-devices", "1", "--sizes", "64"])

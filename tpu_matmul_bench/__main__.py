@@ -15,6 +15,7 @@ _PROGRAMS = {
     "scaling": "tpu_matmul_bench.benchmarks.matmul_scaling_benchmark",
     "distributed": "tpu_matmul_bench.benchmarks.matmul_distributed_benchmark",
     "overlap": "tpu_matmul_bench.benchmarks.matmul_overlap_benchmark",
+    "collectives": "tpu_matmul_bench.benchmarks.collective_benchmark",
     "compare": "tpu_matmul_bench.benchmarks.compare_benchmarks",
 }
 

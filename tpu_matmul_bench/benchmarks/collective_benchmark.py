@@ -1,0 +1,93 @@
+"""Collective bandwidth benchmark — raw ICI throughput per collective op.
+
+A capability beyond the reference (whose interconnect is only measured
+implicitly through the matmul modes' comm leg,
+`matmul_scaling_benchmark.py:144-151`): nccl-tests-style per-op bandwidth
+over the device mesh. Ops: psum, all_gather, reduce_scatter, ppermute,
+all_to_all. Reports algorithmic and bus bandwidth; `--sizes N` sweeps an
+N×N-per-device payload of the benchmark dtype.
+
+Run: python -m tpu_matmul_bench.benchmarks.collective_benchmark \
+        --mode psum --num-devices 8 --sizes 4096 ...
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Sequence
+
+from tpu_matmul_bench.benchmarks.runner import run_sizes
+from tpu_matmul_bench.parallel.collective_bench import (
+    COLLECTIVES,
+    run_collective_benchmark,
+)
+from tpu_matmul_bench.parallel.collectives import verify_collectives
+from tpu_matmul_bench.parallel.mesh import make_mesh
+from tpu_matmul_bench.utils.config import BenchConfig, parse_config
+from tpu_matmul_bench.utils.device import (
+    collect_device_info,
+    device_banner,
+    maybe_init_multihost,
+    resolve_devices,
+)
+from tpu_matmul_bench.utils.metrics import matrix_memory_gib
+from tpu_matmul_bench.utils.profiling import maybe_trace
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord, header, report
+
+
+def run(config: BenchConfig) -> list[BenchmarkRecord]:
+    maybe_init_multihost()
+    devices = resolve_devices(config.device, config.num_devices)
+    if len(devices) < 2:
+        report("ERROR: collective benchmark needs >= 2 devices "
+               "(use --num-devices, or the 8-device virtual CPU mesh)")
+        sys.exit(1)
+    info = collect_device_info(devices)
+    mesh = make_mesh(devices)
+    report(device_banner(info))
+    report(
+        header(
+            "Collective Bandwidth Benchmark (TPU-native)",
+            {
+                "Collective": config.mode,
+                "Number of devices": len(devices),
+                "Data type": config.dtype_name,
+                "Iterations per test": config.iterations,
+                "Warmup iterations": config.warmup,
+            },
+        )
+    )
+
+    report("\nVerifying collectives:")
+    if not verify_collectives(mesh):
+        report("\nERROR: collective verification failed — aborting benchmark")
+        sys.exit(1)
+
+    def bench_one(size: int) -> BenchmarkRecord:
+        return run_collective_benchmark(config, mesh, size, config.mode)
+
+    mem_factor = COLLECTIVES[config.mode].mem_factor(len(devices))
+    with maybe_trace(config.profile_dir):
+        records = run_sizes(
+            config,
+            bench_one,
+            memory_gib=lambda s: matrix_memory_gib(s, config.dtype,
+                                                   count=mem_factor),
+            memory_limit_gib=info.memory_gib,
+        )
+    report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)
+    return records
+
+
+def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
+    config = parse_config(
+        argv,
+        description=__doc__ or "collective benchmark",
+        modes=list(COLLECTIVES),
+        default_mode="psum",
+    )
+    return run(config)
+
+
+if __name__ == "__main__":
+    main()

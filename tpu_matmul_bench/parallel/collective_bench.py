@@ -1,0 +1,142 @@
+"""Raw collective micro-benchmarks over the mesh — ICI bandwidth per op.
+
+The reference measures its interconnect only implicitly, through the comm leg
+of the matmul modes (`matmul_scaling_benchmark.py:144-151`); it has no
+dedicated collective benchmark. This module adds one, in nccl-tests style but
+TPU-native: each op is a `shard_map` program over the world axis timed by the
+shared engine, reporting algorithmic bandwidth (payload bytes / time) and bus
+bandwidth (algbw scaled by the ring traffic factor for the op, the standard
+convention for comparing collectives to link speed).
+
+Ops: psum (all_reduce), all_gather, reduce_scatter, ppermute (one ring hop),
+all_to_all. Payload per device is an n×n array of the benchmark dtype (the
+same --sizes sweep as the matmul programs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_matmul_bench.parallel.mesh import ring_perm, sharded_normal, smap, world_size
+from tpu_matmul_bench.utils.config import BenchConfig
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+from tpu_matmul_bench.utils.timing import time_jitted
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveSpec:
+    """One collective op: program body + the nccl-tests bandwidth convention.
+
+    `conv_size(d, s)` is the op's conventional size for a per-device input
+    shard of `s` bytes — what algbw divides by (nccl-tests: all_reduce and
+    reduce_scatter and all_to_all use the per-rank buffer `s`; all_gather
+    uses the total gathered output `d·s`). `bus_factor(d)` then converts
+    that algbw to bus bandwidth — per-link ring traffic over time:
+    all_reduce 2(d−1)/d, all_gather / reduce_scatter / all_to_all (d−1)/d,
+    a single ring hop 1. Under these pairings every op's busbw is directly
+    comparable to link speed.
+
+    `mem_factor(d)` is the per-device resident footprint in payload units
+    (operand + result + one temp), for the pre-flight OOM guard — the
+    gather's output alone is d payloads.
+    """
+
+    name: str
+    body: Callable[[int], Callable[[jax.Array], jax.Array]]  # d -> shard fn
+    conv_size: Callable[[int, int], float]
+    bus_factor: Callable[[int], float]
+    mem_factor: Callable[[int], float]
+
+
+COLLECTIVES: dict[str, CollectiveSpec] = {
+    "psum": CollectiveSpec(
+        "psum",
+        lambda d: lambda x: jax.lax.pcast(
+            jax.lax.psum(x, "x"), "x", to="varying"),
+        lambda d, s: s,
+        lambda d: 2.0 * (d - 1) / d,
+        lambda d: 3.0,
+    ),
+    "all_gather": CollectiveSpec(
+        "all_gather",
+        lambda d: lambda x: jax.lax.all_gather(x, "x", axis=0, tiled=True),
+        lambda d, s: d * s,
+        lambda d: (d - 1) / d,
+        lambda d: d + 2.0,
+    ),
+    "reduce_scatter": CollectiveSpec(
+        "reduce_scatter",
+        lambda d: lambda x: jax.lax.psum_scatter(x, "x", scatter_dimension=0,
+                                                 tiled=True),
+        lambda d, s: s,
+        lambda d: (d - 1) / d,
+        lambda d: 3.0,
+    ),
+    "ppermute": CollectiveSpec(
+        "ppermute",
+        lambda d: lambda x: jax.lax.ppermute(x, "x", ring_perm(d)),
+        lambda d, s: s,
+        lambda d: 1.0,
+        lambda d: 3.0,
+    ),
+    "all_to_all": CollectiveSpec(
+        "all_to_all",
+        lambda d: lambda x: jax.lax.all_to_all(x, "x", split_axis=0,
+                                               concat_axis=0, tiled=True),
+        lambda d, s: s,
+        lambda d: (d - 1) / d,
+        lambda d: 3.0,
+    ),
+}
+
+
+def collective_setup(config: BenchConfig, mesh: Mesh, size: int,
+                     op: str) -> tuple[Callable[..., Any], jax.Array, CollectiveSpec]:
+    """Build the jitted program + sharded operand for one op at one size.
+
+    The per-device payload is a [size, size] array; the global operand is
+    [d·size, size] sharded on the leading axis so every shard is exactly the
+    payload (ops that change shape — all_gather/reduce_scatter — still move
+    the same per-device payload through the links).
+    """
+    spec = COLLECTIVES[op]
+    d = world_size(mesh)
+    (x,) = sharded_normal(config.seed, (d * size, size), config.dtype, mesh,
+                          P("x"), count=1)
+    fn = smap(spec.body(d), mesh, in_specs=P("x"), out_specs=P("x"),
+              check_vma=False)
+    return fn, x, spec
+
+
+def run_collective_benchmark(config: BenchConfig, mesh: Mesh, size: int,
+                             op: str) -> BenchmarkRecord:
+    fn, x, spec = collective_setup(config, mesh, size, op)
+    d = world_size(mesh)
+    t = time_jitted(fn, (x,), iterations=config.iterations,
+                    warmup=config.warmup)
+    payload = size * size * x.dtype.itemsize  # per-device input shard bytes
+    algbw = spec.conv_size(d, payload) / t.avg_s / 1e9
+    rec = BenchmarkRecord(
+        benchmark="collective",
+        mode=op,
+        size=size,
+        dtype=config.dtype_name,
+        world=d,
+        iterations=t.iterations,
+        warmup=config.warmup,
+        avg_time_s=t.avg_s,
+        tflops_per_device=0.0,  # not a FLOP benchmark
+        tflops_total=0.0,
+        bytes_per_device=payload,
+        algbw_gbps=algbw,
+        busbw_gbps=algbw * spec.bus_factor(d),
+        comm_time_s=t.avg_s,
+        extras={"bus_factor": round(spec.bus_factor(d), 4)},
+    )
+    if not t.reliable:
+        rec.extras["timing_reliable"] = False
+    return rec

@@ -45,6 +45,11 @@ class BenchmarkRecord:
     tflops_per_device: float
     tflops_total: float
     device_kind: str = ""
+    # collective-bandwidth benchmarks: payload bytes per device per iteration
+    # and the derived algorithmic/bus bandwidth (matmul benchmarks leave None)
+    bytes_per_device: int | None = None
+    algbw_gbps: float | None = None
+    busbw_gbps: float | None = None
     compute_time_s: float | None = None
     comm_time_s: float | None = None
     comm_overhead_pct: float | None = None
@@ -109,10 +114,19 @@ def format_record(rec: BenchmarkRecord) -> str:
     lines = [
         f"\nResults for {rec.size}x{rec.size} [{rec.mode}]:",
         f"  - Average time per operation: {rec.avg_time_s * 1e3:.3f} ms",
-        f"  - TFLOPS per device: {rec.tflops_per_device:.2f}",
-        f"  - Total TFLOPS ({rec.world} device(s)): {rec.tflops_total:.2f}",
-        f"  - FLOPs per operation: {matmul_flops(rec.size) / 1e12:.2f} TFLOPs",
     ]
+    if rec.algbw_gbps is None:  # FLOP benchmark; collectives do no matmul
+        lines += [
+            f"  - TFLOPS per device: {rec.tflops_per_device:.2f}",
+            f"  - Total TFLOPS ({rec.world} device(s)): {rec.tflops_total:.2f}",
+            f"  - FLOPs per operation: {matmul_flops(rec.size) / 1e12:.2f} TFLOPs",
+        ]
+    if rec.algbw_gbps is not None:
+        bus = f", bus {rec.busbw_gbps:.2f} GB/s" if rec.busbw_gbps is not None else ""
+        lines.append(
+            f"  - Bandwidth: {rec.algbw_gbps:.2f} GB/s algorithmic{bus} "
+            f"({rec.bytes_per_device / 2**20:.1f} MiB/device)"
+        )
     if rec.compute_time_s is not None and rec.comm_time_s is not None:
         # compute/comm split line ≙ matmul_scaling_benchmark.py:162-163
         lines.append(

@@ -8,6 +8,7 @@ from tpu_matmul_bench.parallel.modes import run_mode_benchmark
 from tpu_matmul_bench.parallel.overlap import (
     OVERLAP_MODES,
     collective_matmul_program,
+    collective_matmul_rs_program,
     overlap_mode,
 )
 from tpu_matmul_bench.parallel.mesh import sharded_normal
@@ -33,6 +34,18 @@ def test_collective_matmul_matches_dense(mesh):
     want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
     overlapped = collective_matmul_program(mesh, overlap=True)
     baseline = collective_matmul_program(mesh, overlap=False)
+    np.testing.assert_allclose(np.asarray(overlapped(x, w)), want, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(baseline(x, w)), want, rtol=1e-4, atol=1e-4)
+
+
+def test_collective_matmul_rs_matches_dense(mesh):
+    # the chunked ring reduce-scatter matmul must equal the dense product:
+    # X k-split P(None,'x'), W row-sharded P('x',None) → Y row-sharded
+    (x,) = sharded_normal(0, (SIZE, SIZE), jnp.float32, mesh, P(None, "x"), count=1)
+    (w,) = sharded_normal(1, (SIZE, SIZE), jnp.float32, mesh, P("x", None), count=1)
+    want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+    overlapped = collective_matmul_rs_program(mesh, overlap=True)
+    baseline = collective_matmul_rs_program(mesh, overlap=False)
     np.testing.assert_allclose(np.asarray(overlapped(x, w)), want, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(baseline(x, w)), want, rtol=1e-4, atol=1e-4)
 

@@ -66,11 +66,21 @@ def run(config: BenchConfig) -> list[BenchmarkRecord]:
     def bench_one(size: int) -> BenchmarkRecord:
         return run_collective_benchmark(config, mesh, size, config.mode)
 
-    mem_factor = COLLECTIVES[config.mode].mem_factor(len(devices))
+    d = len(devices)
+    sizes = list(config.sizes)
+    if config.mode in ("reduce_scatter", "all_to_all"):
+        # these split the per-device payload's leading dim across devices
+        for s in [s for s in sizes if s % d]:
+            report(f"\nSkipping size {s}: {config.mode} needs the size "
+                   f"divisible by the {d}-device world")
+        sizes = [s for s in sizes if s % d == 0]
+
+    mem_factor = COLLECTIVES[config.mode].mem_factor(d)
     with maybe_trace(config.profile_dir):
         records = run_sizes(
             config,
             bench_one,
+            sizes=sizes,
             memory_gib=lambda s: matrix_memory_gib(s, config.dtype,
                                                    count=mem_factor),
             memory_limit_gib=info.memory_gib,

@@ -51,7 +51,8 @@ def compare(size: int, dtype: str, num_devices: int | None,
         for rec in _run(matmul_scaling_benchmark.main, base + ["--mode", mode]):
             results[mode] = rec
 
-    for mode in ("no_overlap", "overlap", "pipeline", "collective_matmul"):
+    for mode in ("no_overlap", "overlap", "pipeline", "collective_matmul",
+                 "collective_matmul_rs"):
         report(f"\n### overlap: {mode} " + "#" * 40)
         for rec in _run(matmul_overlap_benchmark.main, base + ["--mode", mode]):
             results[mode] = rec

@@ -245,21 +245,21 @@ def collective_matmul_program(mesh: Mesh, overlap: bool = True,
 
 
 def _vs_baseline_mode(config: BenchConfig, mesh: Mesh, size: int,
-                      mode_name: str, overlapped_program,
-                      extra_fields: dict, benchmark: str) -> ModeSetup:
-    """Shared builder for the two collective-matmul forms: same operands and
-    gather-then-matmul baseline leg; only the overlapped program and the
-    extras labeling differ."""
+                      mode_name: str, baseline_program, overlapped_program,
+                      baseline_label: str, extra_fields: dict, benchmark: str,
+                      x_spec: P = P("x", None),
+                      w_spec: P = P(None, "x")) -> ModeSetup:
+    """Shared builder for the collective-matmul forms (all-gather ring,
+    reduce-scatter ring, in-kernel Pallas ring): a serialized baseline leg
+    timed against the overlapped program, with the speedup in extras."""
     d = world_size(mesh)
     (x,) = sharded_normal(config.seed, (size, size), config.dtype, mesh,
-                          P("x", None), count=1)
+                          x_spec, count=1)
     (w,) = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
-                          P(None, "x"), count=1)
-    baseline = collective_matmul_program(mesh, overlap=False,
-                                         impl=config.matmul_impl)
+                          w_spec, count=1)
 
     def build(t_compute: Timing, t_full: Timing | None, comm_s: float) -> BenchmarkRecord:
-        # here 'compute' = gather-then-matmul baseline, 'full' = overlapped
+        # here 'compute' = the serialized baseline, 'full' = overlapped
         t_base = t_compute
         t_ovl = t_full if t_full else t_compute
         actual = calculate_tflops(size, t_ovl.avg_s)
@@ -274,25 +274,83 @@ def _vs_baseline_mode(config: BenchConfig, mesh: Mesh, size: int,
             compute_time_s=t_base.avg_s,
             comm_time_s=None,
             extras={
-                "baseline": "all_gather-then-matmul",
+                "baseline": baseline_label,
                 "baseline_time_ms": round(t_base.avg_ms, 3),
                 "overlap_speedup_x": round(speedup, 3),
                 **extra_fields,
             },
         )
 
-    return ModeSetup(mode_name, (x, w), baseline, overlapped_program, build,
+    return ModeSetup(mode_name, (x, w), baseline_program, overlapped_program,
+                     build,
                      memory_gib_per_device=estimate_memory_gib(
                          "collective_matmul", config, d, size))
 
 
 def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
                            benchmark: str = "overlap") -> ModeSetup:
-    overlapped = collective_matmul_program(mesh, overlap=True,
-                                           impl=config.matmul_impl)
     return _vs_baseline_mode(
-        config, mesh, size, "collective_matmul", overlapped,
+        config, mesh, size, "collective_matmul",
+        collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl),
+        collective_matmul_program(mesh, overlap=True, impl=config.matmul_impl),
+        "all_gather-then-matmul",
         {"matmul_impl": config.matmul_impl}, benchmark,
+    )
+
+
+def collective_matmul_rs_program(mesh: Mesh, overlap: bool = True,
+                                 impl: str = "xla"):
+    """Y = X·W with the contraction dim sharded: X [m, k/D] column-sharded,
+    W [k/D, n] row-sharded; every device's local product is a full-shape
+    partial sum, and Y lands row-sharded [m/D, n] — the matmul+reduce_scatter
+    form (the dual of `collective_matmul_program`'s all_gather form, and the
+    shape of a TP layer's "matmul then gradient/activation sync").
+
+    Overlapped form: the partial product is computed one row chunk at a time
+    while the accumulator ring rotates — the chunk-c accumulator starts at
+    device c+1, picks up every device's contribution as it hops right, and
+    arrives home summed after D−1 hops. The ppermute of step t rides the ICI
+    under the matmul of step t+1 (ring reduce-scatter latency hiding).
+    With overlap=False: whole partial product, then psum_scatter, serialized
+    by an optimization_barrier (the baseline leg).
+    """
+    d = mesh.shape["x"]
+    mm = matmul_2d(impl)
+
+    def body(x_local, w_local):  # [m, k/d], [k/d, n]
+        m = x_local.shape[0]
+        mshard = m // d
+
+        if not overlap:
+            partial = mm(x_local, w_local)  # full [m, n] partial sum
+            partial = jax.lax.optimization_barrier(partial)
+            return jax.lax.psum_scatter(partial, "x", scatter_dimension=0,
+                                        tiled=True)
+
+        my = jax.lax.axis_index("x")
+        acc = jnp.zeros((mshard, w_local.shape[1]), dtype=x_local.dtype)
+        for t in range(d):
+            # accumulator resident here at step t belongs to row chunk c
+            c = jax.lax.rem(my - 1 - t + 2 * d, d)
+            rows = jax.lax.dynamic_slice_in_dim(x_local, c * mshard, mshard)
+            acc = acc + mm(rows, w_local)
+            if t + 1 < d:
+                acc = jax.lax.ppermute(acc, "x", ring_perm(d))
+        return acc  # after d−1 hops chunk my is home and fully summed
+
+    return smap(body, mesh, in_specs=(P(None, "x"), P("x", None)),
+                out_specs=P("x", None), check_vma=False)
+
+
+def collective_matmul_rs_mode(config: BenchConfig, mesh: Mesh, size: int,
+                              benchmark: str = "overlap") -> ModeSetup:
+    return _vs_baseline_mode(
+        config, mesh, size, "collective_matmul_rs",
+        collective_matmul_rs_program(mesh, overlap=False, impl=config.matmul_impl),
+        collective_matmul_rs_program(mesh, overlap=True, impl=config.matmul_impl),
+        "matmul-then-psum_scatter",
+        {"matmul_impl": config.matmul_impl}, benchmark,
+        x_spec=P(None, "x"), w_spec=P("x", None),
     )
 
 
@@ -328,9 +386,11 @@ def pallas_ring_mode(config: BenchConfig, mesh: Mesh, size: int,
             )
     from tpu_matmul_bench.ops.pallas_ring import ring_allgather_matmul
 
-    kernel = ring_allgather_matmul(mesh)
     return _vs_baseline_mode(
-        config, mesh, size, "pallas_ring", kernel,
+        config, mesh, size, "pallas_ring",
+        collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl),
+        ring_allgather_matmul(mesh),
+        "all_gather-then-matmul",
         {"kernel": "pallas ring RDMA all-gather matmul"}, benchmark,
     )
 
@@ -340,5 +400,6 @@ OVERLAP_MODES = {
     "overlap": functools.partial(overlap_mode, variant="overlap"),
     "pipeline": functools.partial(overlap_mode, variant="pipeline"),
     "collective_matmul": collective_matmul_mode,
+    "collective_matmul_rs": collective_matmul_rs_mode,
     "pallas_ring": pallas_ring_mode,
 }

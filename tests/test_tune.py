@@ -1,0 +1,89 @@
+"""Tests for the Pallas block tuner and the --block-m/n/k plumbing."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_matmul_bench.ops.matmul import matmul_2d
+from tpu_matmul_bench.utils.config import parse_config
+
+
+def test_blocks_property():
+    assert parse_config([], "t").blocks is None
+    cfg = parse_config(["--block-n", "256"], "t")
+    assert cfg.blocks == (512, 256, 512)  # unset dims → kernel DEFAULT_BLOCK
+    cfg = parse_config(["--block-m", "64", "--block-n", "64", "--block-k", "32"], "t")
+    assert cfg.blocks == (64, 64, 32)
+    with pytest.raises(ValueError, match="positive"):
+        parse_config(["--block-n", "0"], "t").blocks
+
+
+def test_effective_blocks_clamping():
+    from tpu_matmul_bench.ops.pallas_matmul import effective_blocks
+
+    # 768 does not divide 8192 → clamps to the 512 fallback
+    assert effective_blocks(8192, 8192, 8192, 768, 768, 768) == (512, 512, 512)
+    assert effective_blocks(8192, 8192, 8192, 512, 1024, 512) == (512, 1024, 512)
+    assert effective_blocks(64, 64, 64, 512, 512, 512) == (64, 64, 64)
+
+
+def test_tune_dedupes_clamped_candidates(capsys):
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    # 96 doesn't divide 128 → clamps to 64; the explicit 64,64,64 candidate
+    # is then a duplicate of what already ran
+    records = main([
+        "--sizes", "128", "--iterations", "2", "--warmup", "1",
+        "--dtype", "float32", "--candidates", "96,96,96", "64,64,64",
+    ])
+    out = capsys.readouterr().out
+    assert "requested (96, 96, 96)" in out  # clamp is reported
+    assert "skip" in out and "already-measured" in out
+    assert len(records) == 1  # only the effective blocking ran
+    assert records[0].extras["block_m"] == 64
+
+
+def test_tune_honors_block_flags(capsys):
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    records = main([
+        "--sizes", "64", "--iterations", "2", "--warmup", "1",
+        "--dtype", "float32", "--block-m", "32", "--block-n", "32",
+        "--block-k", "32", "--candidates", "64,64,64",
+    ])
+    ran = [tuple(r.extras[k] for k in ("block_m", "block_n", "block_k"))
+           for r in records]
+    assert ran == [(32, 32, 32), (64, 64, 64)]  # explicit blocking tried first
+
+
+def test_matmul_2d_blocks_override_correctness():
+    a = np.random.default_rng(0).standard_normal((64, 96), np.float32)
+    b = np.random.default_rng(1).standard_normal((96, 32), np.float32)
+    mm = matmul_2d("pallas", (32, 32, 32))
+    got = np.asarray(mm(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_tune_cli_end_to_end(tmp_path, capsys):
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    records = main([
+        "--sizes", "64", "--iterations", "2", "--warmup", "1",
+        "--dtype", "float32",
+        "--candidates", "32,32,32", "64,64,64",
+        "--json-out", str(tmp_path / "tune.jsonl"),
+    ])
+    out = capsys.readouterr().out
+    assert "BEST: --block-m" in out
+    assert len(records) == 2
+    assert {tuple(r.extras[k] for k in ("block_m", "block_n", "block_k"))
+            for r in records} == {(32, 32, 32), (64, 64, 64)}
+    lines = (tmp_path / "tune.jsonl").read_text().splitlines()
+    assert len(lines) == 2
+
+
+def test_tune_rejects_bad_candidate():
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    with pytest.raises(SystemExit):
+        main(["--candidates", "64,64"])

@@ -16,6 +16,7 @@ _PROGRAMS = {
     "distributed": "tpu_matmul_bench.benchmarks.matmul_distributed_benchmark",
     "overlap": "tpu_matmul_bench.benchmarks.matmul_overlap_benchmark",
     "collectives": "tpu_matmul_bench.benchmarks.collective_benchmark",
+    "tune": "tpu_matmul_bench.benchmarks.pallas_tune",
     "compare": "tpu_matmul_bench.benchmarks.compare_benchmarks",
 }
 

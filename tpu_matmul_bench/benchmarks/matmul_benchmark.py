@@ -44,7 +44,7 @@ def _bench_single(
     # actually selects where the work runs, not just what the banner says
     with jax.default_device(device if device is not None else jax.devices()[0]):
         a, b = wl.operands()
-        mm = make_matmul(config.matmul_impl)
+        mm = make_matmul(config.matmul_impl, config.blocks)
         t = time_jitted(mm, (a, b), iterations=config.iterations, warmup=config.warmup)
     tflops = calculate_tflops(size, t.avg_s)
     return BenchmarkRecord(
@@ -74,7 +74,7 @@ def _bench_all_devices(
 
     # Per-device independent matmul, zero collectives in the timed loop —
     # ≙ every rank calling benchmark_matmul concurrently.
-    mm2d = matmul_2d(config.matmul_impl)
+    mm2d = matmul_2d(config.matmul_impl, config.blocks)
     mm = jax.jit(
         shard_map(
             lambda x, y: jnp.stack([mm2d(x[i], y[i]) for i in range(x.shape[0])]),

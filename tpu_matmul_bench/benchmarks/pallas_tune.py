@@ -1,0 +1,145 @@
+"""Pallas matmul block-size tuner — sweep (bm, bn, bk) on the target chip.
+
+No reference analogue (cuBLAS autotunes internally; the reference's warmup
+absorbs it, `matmul_benchmark.py:44-49`). An explicit Pallas kernel exposes
+its blocking, so this program measures each candidate on the real device
+and reports the ranking; feed the winner back via --block-m/n/k (accepted
+by every benchmark program).
+
+Run: python -m tpu_matmul_bench tune --sizes 16384 --iterations 10 \
+        [--candidates 512,512,512 512,1024,512 ...]
+
+Progress prints *before* each compile so a slow/hung backend is visible
+(each candidate's first call can take minutes on a tunneled TPU).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Sequence
+
+import jax
+
+from tpu_matmul_bench.models.workloads import MatmulWorkload
+from tpu_matmul_bench.ops.matmul import make_matmul
+from tpu_matmul_bench.ops.pallas_matmul import effective_blocks
+from tpu_matmul_bench.utils.config import build_parser, config_from_args
+from tpu_matmul_bench.utils.device import (
+    collect_device_info,
+    device_banner,
+    resolve_devices,
+)
+from tpu_matmul_bench.utils.metrics import calculate_tflops
+from tpu_matmul_bench.utils.reporting import (
+    BenchmarkRecord,
+    JsonWriter,
+    header,
+    report,
+)
+from tpu_matmul_bench.utils.timing import time_jitted
+
+# Hardware-aligned candidates inside the ~16 MB VMEM budget (bf16 tiles +
+# fp32 accumulator, double-buffered inputs).
+DEFAULT_CANDIDATES = [
+    (512, 512, 512),
+    (512, 1024, 512),
+    (1024, 512, 512),
+    (1024, 1024, 512),
+    (512, 512, 1024),
+    (512, 1024, 1024),
+    (256, 1024, 512),
+    (512, 2048, 512),
+]
+
+
+def _parse_candidate(text: str) -> tuple[int, int, int]:
+    parts = tuple(int(p) for p in text.split(","))
+    if len(parts) != 3 or any(p <= 0 for p in parts):
+        raise argparse.ArgumentTypeError(
+            f"candidate must be 'bm,bn,bk' positive ints, got {text!r}")
+    return parts
+
+
+def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
+    parser = build_parser(__doc__ or "pallas block tuner")
+    parser.add_argument(
+        "--candidates", type=_parse_candidate, nargs="+",
+        default=list(DEFAULT_CANDIDATES),
+        help="Blockings to try, each as 'bm,bn,bk' (default: a VMEM-safe grid)",
+    )
+    args = parser.parse_args(argv)
+    config = config_from_args(args)
+
+    devices = resolve_devices(config.device, config.num_devices)
+    info = collect_device_info(devices)
+    report(device_banner(info))
+    report(header(
+        "Pallas Matmul Block Tuner",
+        {
+            "Sizes": config.sizes,
+            "Data type": config.dtype_name,
+            "Candidates": len(args.candidates),
+            "Iterations per candidate": config.iterations,
+        },
+    ))
+
+    # an explicit --block-m/n/k blocking is tried first, ahead of the grid
+    candidates = list(args.candidates)
+    if config.blocks is not None:
+        candidates.insert(0, config.blocks)
+
+    records: list[BenchmarkRecord] = []
+    with JsonWriter(config.json_out) as jw:
+        for size in config.sizes:
+            wl = MatmulWorkload(size, config.dtype, seed=config.seed)
+            # pin operands + compute to the resolved device, like every other
+            # benchmark (matmul_benchmark.py _bench_single): --device must
+            # select where the work runs, not just what the banner says
+            with jax.default_device(devices[0]):
+                a, b = wl.operands()
+                results: list[tuple[tuple[int, int, int], float]] = []
+                seen: set[tuple[int, int, int]] = set()
+                for want in candidates:
+                    # requested blocks are clamped to dividing sizes by the
+                    # kernel — dedupe and report on what actually runs
+                    eff = effective_blocks(size, size, size, *want)
+                    if eff in seen:
+                        report(f"\n[{size}] skip {want}: clamps to already-"
+                               f"measured bm={eff[0]} bn={eff[1]} bk={eff[2]}")
+                        continue
+                    seen.add(eff)
+                    bm, bn, bk = eff
+                    note = "" if eff == tuple(want) else f" (requested {want})"
+                    report(f"\n[{size}] compiling + timing bm={bm} bn={bn} "
+                           f"bk={bk}{note} ...")
+                    try:
+                        mm = make_matmul("pallas", eff)
+                        t = time_jitted(mm, (a, b),
+                                        iterations=config.iterations,
+                                        warmup=config.warmup)
+                    except Exception as e:  # noqa: BLE001 — a bad blocking skips
+                        report(f"  FAILED: {type(e).__name__}: {str(e)[:160]}")
+                        continue
+                    tflops = calculate_tflops(size, t.avg_s)
+                    results.append((eff, tflops))
+                    report(f"  {tflops:.2f} TFLOPS ({t.avg_ms:.3f} ms)")
+                    rec = BenchmarkRecord(
+                        benchmark="tune", mode="pallas_tune", size=size,
+                        dtype=config.dtype_name, world=1,
+                        iterations=t.iterations, warmup=config.warmup,
+                        avg_time_s=t.avg_s, tflops_per_device=tflops,
+                        tflops_total=tflops, device_kind=info.device_kind,
+                        extras={"block_m": bm, "block_n": bn, "block_k": bk},
+                    ).finalize()
+                    records.append(rec)
+                    jw.write(rec)
+            if results:
+                results.sort(key=lambda r: -r[1])
+                (bm, bn, bk), best = results[0]
+                report(f"\n[{size}] BEST: --block-m {bm} --block-n {bn} "
+                       f"--block-k {bk}  ({best:.2f} TFLOPS)")
+    return records
+
+
+if __name__ == "__main__":
+    main()

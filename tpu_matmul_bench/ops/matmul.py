@@ -16,30 +16,29 @@ import jax
 import jax.numpy as jnp
 
 
-def make_matmul(impl: str = "xla") -> Callable[[jax.Array, jax.Array], jax.Array]:
-    """A jitted C = A @ B. ``impl`` selects XLA's dot or the Pallas kernel."""
-    if impl == "pallas":
-        from tpu_matmul_bench.ops.pallas_matmul import pallas_matmul
-
-        return jax.jit(pallas_matmul)
-    if impl != "xla":
-        raise ValueError(f"unknown matmul impl {impl!r}")
-
-    @jax.jit
-    def matmul(a: jax.Array, b: jax.Array) -> jax.Array:
-        return jnp.matmul(a, b)
-
-    return matmul
+def make_matmul(
+    impl: str = "xla", blocks: tuple[int, int, int] | None = None
+) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """A jitted C = A @ B. ``impl`` selects XLA's dot or the Pallas kernel;
+    ``blocks`` overrides the Pallas (bm, bn, bk) blocking (config.blocks)."""
+    return jax.jit(matmul_2d(impl, blocks))
 
 
-def matmul_2d(impl: str = "xla") -> Callable[[jax.Array, jax.Array], jax.Array]:
+def matmul_2d(
+    impl: str = "xla", blocks: tuple[int, int, int] | None = None
+) -> Callable[[jax.Array, jax.Array], jax.Array]:
     """Un-jitted 2-D matmul for use *inside* shard_map/jit bodies — the one
     place every benchmark mode takes its hot op from, so `--matmul-impl
-    pallas` swaps the kernel uniformly across all modes."""
+    pallas` (and a `--block-m/n/k` override) swaps the kernel uniformly
+    across all modes."""
     if impl == "pallas":
         from tpu_matmul_bench.ops.pallas_matmul import pallas_matmul
 
-        return lambda a, b: pallas_matmul(a, b)
+        if blocks is None:
+            return lambda a, b: pallas_matmul(a, b)
+        bm, bn, bk = blocks
+        return lambda a, b: pallas_matmul(a, b, block_m=bm, block_n=bn,
+                                          block_k=bk)
     if impl != "xla":
         raise ValueError(f"unknown matmul impl {impl!r}")
     return lambda a, b: jnp.dot(a, b)

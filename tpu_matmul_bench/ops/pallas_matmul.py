@@ -41,12 +41,24 @@ def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref):
         o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
 
+DEFAULT_BLOCK = 512  # the kernel's baseline (bm, bn, bk); see module docstring
+
+
 def _pick_block(dim: int, preferred: int) -> int:
     """Largest hardware-aligned block ≤ preferred that divides dim."""
     for candidate in (preferred, 512, 256, 128, 64, 32, 16, 8):
         if candidate <= preferred and dim % candidate == 0:
             return candidate
     return dim  # tiny/odd dim: single block
+
+
+def effective_blocks(
+    m: int, n: int, k: int, block_m: int, block_n: int, block_k: int
+) -> tuple[int, int, int]:
+    """The (bm, bn, bk) the kernel will actually use for an m×k·k×n problem —
+    requested blocks are clamped to hardware-aligned divisors of each dim
+    (tuners should dedupe/report on this, not the requested values)."""
+    return _pick_block(m, block_m), _pick_block(n, block_n), _pick_block(k, block_k)
 
 
 @functools.partial(
@@ -56,9 +68,9 @@ def pallas_matmul(
     a: jax.Array,
     b: jax.Array,
     *,
-    block_m: int = 512,
-    block_n: int = 512,
-    block_k: int = 512,
+    block_m: int = DEFAULT_BLOCK,
+    block_n: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
     interpret: bool | None = None,
 ) -> jax.Array:
     """C = A @ B with a blocked Pallas kernel.

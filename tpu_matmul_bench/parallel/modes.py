@@ -106,7 +106,7 @@ def independent(config: BenchConfig, mesh: Mesh, size: int,
     total / (per-device · world) (reference `:313-315`).
     """
     d = world_size(mesh)
-    mm = matmul_2d(config.matmul_impl)
+    mm = matmul_2d(config.matmul_impl, config.blocks)
     a, b = sharded_normal(config.seed, (d, size, size), config.dtype, mesh, P("x"))
     compute = _smap(
         _stacked_mm(mm),
@@ -149,7 +149,7 @@ def batch_parallel(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
     d = world_size(mesh)
     local_batch = max(batch // d, 1)
     g = local_batch * d
-    mm = matmul_2d(config.matmul_impl)
+    mm = matmul_2d(config.matmul_impl, config.blocks)
     a, b = sharded_normal(config.seed, (g, size, size), config.dtype, mesh, P("x"))
     compute = _smap(
         _stacked_mm(mm),
@@ -207,7 +207,7 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
     (b,) = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
                           P(None, "x"), count=1)
 
-    mm = matmul_2d(config.matmul_impl)
+    mm = matmul_2d(config.matmul_impl, config.blocks)
     compute = _smap(
         mm,
         mesh, in_specs=(P(), P(None, "x")), out_specs=P(None, "x"),
@@ -251,7 +251,7 @@ def data_parallel(config: BenchConfig, mesh: Mesh, size: int,
     comm reported separately.
     """
     d = world_size(mesh)
-    mm = matmul_2d(config.matmul_impl)
+    mm = matmul_2d(config.matmul_impl, config.blocks)
     a, b = sharded_normal(config.seed, (d, size, size), config.dtype, mesh, P("x"))
     compute = _smap(
         _stacked_mm(mm),
@@ -304,7 +304,7 @@ def model_parallel(config: BenchConfig, mesh: Mesh, size: int,
     (b,) = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
                           P("x", None), count=1)
 
-    partial_product = matmul_2d(config.matmul_impl)
+    partial_product = matmul_2d(config.matmul_impl, config.blocks)
 
     compute = _smap(
         partial_product, mesh,

@@ -61,14 +61,15 @@ from tpu_matmul_bench.utils.timing import Timing
 # P7/P8/P9 — matmul + all_reduce with varying overlap, as scan programs
 # ---------------------------------------------------------------------------
 
-def _steps_program(mesh: Mesh, variant: str, steps: int, impl: str = "xla"):
+def _steps_program(mesh: Mesh, variant: str, steps: int, impl: str = "xla",
+                   blocks: tuple[int, int, int] | None = None):
     """Scan program for {compute_only, no_overlap, overlap, pipeline}.
 
     Operands: A, B stacked [buffers, n, n] per device (≙ the reference's
     `pipeline_depth` matrix sets, `:188-195`); overlap/pipeline additionally
     take the precomputed in-flight product ring [k, n, n].
     """
-    mm = matmul_2d(impl)
+    mm = matmul_2d(impl, blocks)
 
     if variant == "compute_only":
         # compute leg alone, serialized step-to-step (≙ the reference's
@@ -129,10 +130,11 @@ def _steps_program(mesh: Mesh, variant: str, steps: int, impl: str = "xla"):
     raise ValueError(variant)
 
 
-def _fill_ring(mesh: Mesh, k: int, impl: str = "xla"):
+def _fill_ring(mesh: Mesh, k: int, impl: str = "xla",
+               blocks: tuple[int, int, int] | None = None):
     """Prologue: the k in-flight products (≙ fill phase :213-218), computed
     once at setup, outside every timed call."""
-    mm = matmul_2d(impl)
+    mm = matmul_2d(impl, blocks)
 
     def body(a, b):
         return jnp.stack([mm(a[i % a.shape[0]], b[i % b.shape[0]])
@@ -158,11 +160,13 @@ def overlap_mode(config: BenchConfig, mesh: Mesh, size: int, variant: str,
     operands: tuple[Any, ...] = (a, b)
     if variant in ("overlap", "pipeline"):
         k = 2 if variant == "overlap" else depth
-        ring0 = _fill_ring(mesh, k, impl)(a, b)
+        ring0 = _fill_ring(mesh, k, impl, config.blocks)(a, b)
         operands = (a, b, ring0)
 
-    compute = _steps_program(mesh, "compute_only", steps_per_call, impl)
-    full = _steps_program(mesh, variant, steps_per_call, impl)
+    compute = _steps_program(mesh, "compute_only", steps_per_call, impl,
+                             config.blocks)
+    full = _steps_program(mesh, variant, steps_per_call, impl,
+                          config.blocks)
     # compute program takes (a, b) only; wrap so both share `operands`
     compute_fn = (lambda a, b, ring0=None: compute(a, b)) \
         if len(operands) == 3 else compute
@@ -201,7 +205,8 @@ def overlap_mode(config: BenchConfig, mesh: Mesh, size: int, variant: str,
 # ---------------------------------------------------------------------------
 
 def collective_matmul_program(mesh: Mesh, overlap: bool = True,
-                              impl: str = "xla"):
+                              impl: str = "xla",
+                              blocks: tuple[int, int, int] | None = None):
     """Y = X·W with X row-sharded [m/D, k] and W column-sharded [k, n/D]:
     logically Y_local = all_gather(X) @ W_local. The overlapped form never
     materializes the gather — each of the D ring steps multiplies the X chunk
@@ -213,7 +218,7 @@ def collective_matmul_program(mesh: Mesh, overlap: bool = True,
     baseline the overlapped form is compared against).
     """
     d = mesh.shape["x"]
-    mm = matmul_2d(impl)
+    mm = matmul_2d(impl, blocks)
 
     def body(x_local, w_local):  # [m/d, k], [k, n/d]
         mshard = x_local.shape[0]
@@ -291,15 +296,18 @@ def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
                            benchmark: str = "overlap") -> ModeSetup:
     return _vs_baseline_mode(
         config, mesh, size, "collective_matmul",
-        collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl),
-        collective_matmul_program(mesh, overlap=True, impl=config.matmul_impl),
+        collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl,
+                                  blocks=config.blocks),
+        collective_matmul_program(mesh, overlap=True, impl=config.matmul_impl,
+                                  blocks=config.blocks),
         "all_gather-then-matmul",
         {"matmul_impl": config.matmul_impl}, benchmark,
     )
 
 
 def collective_matmul_rs_program(mesh: Mesh, overlap: bool = True,
-                                 impl: str = "xla"):
+                                 impl: str = "xla",
+                                 blocks: tuple[int, int, int] | None = None):
     """Y = X·W with the contraction dim sharded: X [m, k/D] column-sharded,
     W [k/D, n] row-sharded; every device's local product is a full-shape
     partial sum, and Y lands row-sharded [m/D, n] — the matmul+reduce_scatter
@@ -315,7 +323,7 @@ def collective_matmul_rs_program(mesh: Mesh, overlap: bool = True,
     by an optimization_barrier (the baseline leg).
     """
     d = mesh.shape["x"]
-    mm = matmul_2d(impl)
+    mm = matmul_2d(impl, blocks)
 
     def body(x_local, w_local):  # [m, k/d], [k/d, n]
         m = x_local.shape[0]
@@ -346,8 +354,10 @@ def collective_matmul_rs_mode(config: BenchConfig, mesh: Mesh, size: int,
                               benchmark: str = "overlap") -> ModeSetup:
     return _vs_baseline_mode(
         config, mesh, size, "collective_matmul_rs",
-        collective_matmul_rs_program(mesh, overlap=False, impl=config.matmul_impl),
-        collective_matmul_rs_program(mesh, overlap=True, impl=config.matmul_impl),
+        collective_matmul_rs_program(mesh, overlap=False, impl=config.matmul_impl,
+                                     blocks=config.blocks),
+        collective_matmul_rs_program(mesh, overlap=True, impl=config.matmul_impl,
+                                     blocks=config.blocks),
         "matmul-then-psum_scatter",
         {"matmul_impl": config.matmul_impl}, benchmark,
         x_spec=P(None, "x"), w_spec=P("x", None),
@@ -388,7 +398,8 @@ def pallas_ring_mode(config: BenchConfig, mesh: Mesh, size: int,
 
     return _vs_baseline_mode(
         config, mesh, size, "pallas_ring",
-        collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl),
+        collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl,
+                                  blocks=config.blocks),
         ring_allgather_matmul(mesh),
         "all_gather-then-matmul",
         {"kernel": "pallas ring RDMA all-gather matmul"}, benchmark,

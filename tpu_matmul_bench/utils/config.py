@@ -49,10 +49,27 @@ class BenchConfig:
     matmul_impl: str
     seed: int
     profile_dir: str | None = None
+    # Pallas kernel block override (None → kernel defaults); ignored by --matmul-impl xla
+    block_m: int | None = None
+    block_n: int | None = None
+    block_k: int | None = None
 
     @property
     def dtype(self) -> Any:
         return parse_dtype(self.dtype_name)
+
+    @property
+    def blocks(self) -> tuple[int, int, int] | None:
+        """(bm, bn, bk) when any block flag is set; unset dims fall back to
+        the Pallas kernel's own default."""
+        given = (self.block_m, self.block_n, self.block_k)
+        if all(v is None for v in given):
+            return None
+        if any(v is not None and v <= 0 for v in given):
+            raise ValueError(f"block sizes must be positive, got {given}")
+        from tpu_matmul_bench.ops.pallas_matmul import DEFAULT_BLOCK
+
+        return tuple(DEFAULT_BLOCK if v is None else v for v in given)
 
 
 def build_parser(
@@ -100,6 +117,13 @@ def build_parser(
         help="Matmul implementation: XLA jnp.matmul or the Pallas kernel",
     )
     p.add_argument("--seed", type=int, default=0, help="PRNG seed for operand data")
+    for dim in "mnk":
+        p.add_argument(
+            f"--block-{dim}", type=int, default=None,
+            help=f"Pallas kernel block size along {dim} (default: kernel's "
+                 "512; ignored for --matmul-impl xla). Tune with the "
+                 "'tune' program.",
+        )
     p.add_argument(
         "--profile-dir", type=str, default=None,
         help="Write a jax.profiler trace of the benchmark here (view with "
@@ -123,6 +147,9 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         matmul_impl=args.matmul_impl,
         seed=args.seed,
         profile_dir=getattr(args, "profile_dir", None),
+        block_m=getattr(args, "block_m", None),
+        block_n=getattr(args, "block_n", None),
+        block_k=getattr(args, "block_k", None),
     )
 
 

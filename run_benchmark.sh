@@ -21,4 +21,4 @@ done
 
 echo "Running matmul benchmark on ${NUM_DEVICES} device(s), dtype=${DTYPE}"
 exec python3 -m tpu_matmul_bench.benchmarks.matmul_benchmark \
-  --num-devices "${NUM_DEVICES}" --dtype "${DTYPE}" "${DEVICE_FLAG[@]}" "${EXTRA[@]}"
+  --num-devices "${NUM_DEVICES}" --dtype "${DTYPE}" ${DEVICE_FLAG[@]+"${DEVICE_FLAG[@]}"} ${EXTRA[@]+"${EXTRA[@]}"}

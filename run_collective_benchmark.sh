@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Collective bandwidth benchmark launcher (no reference analogue — the
+# reference only measures its interconnect through the matmul modes' comm
+# leg; this drives the dedicated nccl-tests-style ICI benchmark).
+# Usage: ./run_collective_benchmark.sh [NUM_DEVICES] [OP] [DTYPE] [--device=tpu]
+#   OP ∈ {psum, all_gather, reduce_scatter, ppermute, all_to_all}
+set -euo pipefail
+
+NUM_DEVICES=${1:-2}
+OP=${2:-psum}
+DTYPE=${3:-bfloat16}
+DEVICE_FLAG=()
+EXTRA=()
+for arg in "${@:4}"; do
+  case "$arg" in
+    --device=*) DEVICE_FLAG=(--device "${arg#--device=}") ;;
+    *) EXTRA+=("$arg") ;;  # forwarded verbatim (e.g. --sizes 256 512)
+  esac
+done
+
+echo "Running collective benchmark: ${NUM_DEVICES} device(s), op=${OP}, dtype=${DTYPE}"
+exec python3 -m tpu_matmul_bench.benchmarks.collective_benchmark \
+  --num-devices "${NUM_DEVICES}" --mode "${OP}" --dtype "${DTYPE}" ${DEVICE_FLAG[@]+"${DEVICE_FLAG[@]}"} ${EXTRA[@]+"${EXTRA[@]}"}

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Overlap benchmark launcher ≙ reference `backup/run_overlap_benchmark.sh`.
 # Usage: ./run_overlap_benchmark.sh [NUM_DEVICES] [MODE] [DTYPE] [--device=tpu]
-#   MODE ∈ {no_overlap, overlap, pipeline, collective_matmul, pallas_ring}
+#   MODE ∈ {no_overlap, overlap, pipeline, collective_matmul, collective_matmul_rs, pallas_ring}
 set -euo pipefail
 
 NUM_DEVICES=${1:-1}
@@ -18,4 +18,4 @@ done
 
 echo "Running overlap benchmark: ${NUM_DEVICES} device(s), mode=${MODE}, dtype=${DTYPE}"
 exec python3 -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \
-  --num-devices "${NUM_DEVICES}" --mode "${MODE}" --dtype "${DTYPE}" "${DEVICE_FLAG[@]}" "${EXTRA[@]}"
+  --num-devices "${NUM_DEVICES}" --mode "${MODE}" --dtype "${DTYPE}" ${DEVICE_FLAG[@]+"${DEVICE_FLAG[@]}"} ${EXTRA[@]+"${EXTRA[@]}"}

@@ -19,4 +19,4 @@ done
 
 echo "Running scaling benchmark: ${NUM_DEVICES} device(s), mode=${MODE}, dtype=${DTYPE}"
 exec python3 -m tpu_matmul_bench.benchmarks.matmul_scaling_benchmark \
-  --num-devices "${NUM_DEVICES}" --mode "${MODE}" --dtype "${DTYPE}" "${DEVICE_FLAG[@]}" "${EXTRA[@]}"
+  --num-devices "${NUM_DEVICES}" --mode "${MODE}" --dtype "${DTYPE}" ${DEVICE_FLAG[@]+"${DEVICE_FLAG[@]}"} ${EXTRA[@]+"${EXTRA[@]}"}

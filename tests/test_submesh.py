@@ -1,0 +1,64 @@
+"""Ring/mode correctness on a 4-device sub-mesh — the world size must not be
+baked into any program (rings, chunk indexing, scatter factors)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_matmul_bench.ops.pallas_ring import ring_allgather_matmul
+from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
+from tpu_matmul_bench.parallel.modes import model_parallel, run_mode_benchmark
+from tpu_matmul_bench.parallel.overlap import (
+    collective_matmul_program,
+    collective_matmul_rs_program,
+)
+from tpu_matmul_bench.utils.config import parse_config
+from jax.sharding import PartitionSpec as P
+
+SIZE = 64
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    import jax
+
+    return make_mesh(jax.devices()[:4])
+
+
+def _xw(mesh4, x_spec, w_spec):
+    (x,) = sharded_normal(0, (SIZE, SIZE), jnp.float32, mesh4, x_spec, count=1)
+    (w,) = sharded_normal(1, (SIZE, SIZE), jnp.float32, mesh4, w_spec, count=1)
+    want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+    return x, w, want
+
+
+def test_collective_matmul_world4(mesh4):
+    x, w, want = _xw(mesh4, P("x", None), P(None, "x"))
+    got = np.asarray(collective_matmul_program(mesh4, overlap=True)(x, w))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_collective_matmul_rs_world4(mesh4):
+    x, w, want = _xw(mesh4, P(None, "x"), P("x", None))
+    got = np.asarray(collective_matmul_rs_program(mesh4, overlap=True)(x, w))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_ring_world4(mesh4):
+    x, w, want = _xw(mesh4, P("x", None), P(None, "x"))
+    got = np.asarray(ring_allgather_matmul(mesh4)(x, w))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_model_parallel_world4(mesh4):
+    cfg = parse_config(["--sizes", str(SIZE), "--iterations", "2",
+                        "--warmup", "1", "--dtype", "float32"], "t")
+    setup = model_parallel(cfg, mesh4, SIZE)
+    rec = run_mode_benchmark(setup, cfg)
+    assert rec.world == 4 and rec.tflops_total > 0
+
+
+def test_verify_collectives_world4(mesh4):
+    from tpu_matmul_bench.parallel.collectives import verify_collectives
+
+    assert verify_collectives(mesh4, verbose=False)

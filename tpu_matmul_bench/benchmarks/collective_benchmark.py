@@ -68,8 +68,7 @@ def run(config: BenchConfig) -> list[BenchmarkRecord]:
 
     d = len(devices)
     sizes = list(config.sizes)
-    if config.mode in ("reduce_scatter", "all_to_all"):
-        # these split the per-device payload's leading dim across devices
+    if COLLECTIVES[config.mode].needs_divisible_size:
         for s in [s for s in sizes if s % d]:
             report(f"\nSkipping size {s}: {config.mode} needs the size "
                    f"divisible by the {d}-device world")

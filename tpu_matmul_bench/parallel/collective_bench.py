@@ -50,6 +50,8 @@ class CollectiveSpec:
     conv_size: Callable[[int, int], float]
     bus_factor: Callable[[int], float]
     mem_factor: Callable[[int], float]
+    # op splits the payload's leading dim across devices → size % world == 0
+    needs_divisible_size: bool = False
 
 
 COLLECTIVES: dict[str, CollectiveSpec] = {
@@ -75,6 +77,7 @@ COLLECTIVES: dict[str, CollectiveSpec] = {
         lambda d, s: s,
         lambda d: (d - 1) / d,
         lambda d: 3.0,
+        needs_divisible_size=True,
     ),
     "ppermute": CollectiveSpec(
         "ppermute",
@@ -90,6 +93,7 @@ COLLECTIVES: dict[str, CollectiveSpec] = {
         lambda d, s: s,
         lambda d: (d - 1) / d,
         lambda d: 3.0,
+        needs_divisible_size=True,
     ),
 }
 

@@ -22,6 +22,39 @@ def test_compare_small(tmp_path):
     assert all(l["tflops_total"] > 0 for l in lines)
 
 
+def test_render_markdown_reference_table_shape():
+    from tpu_matmul_bench.benchmarks.compare_benchmarks import render_markdown
+    from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+
+    def rec(mode, total, per_dev, scaling=None, t=0.01):
+        r = BenchmarkRecord(
+            benchmark="x", mode=mode, size=16384, dtype="bfloat16", world=8,
+            iterations=5, warmup=1, avg_time_s=t, tflops_per_device=per_dev,
+            tflops_total=total,
+        )
+        r.scaling_efficiency_pct = scaling
+        return r
+
+    ring = rec("pallas_ring", 90.0, 11.3)
+    ring.size = 4096  # rerun at its VMEM-limited size, not the headline 16384
+    ring.extras["note"] = "run at 4096 (VMEM-resident kernel), not 16384"
+    md = render_markdown({
+        "single": rec("single", 190.0, 190.0),
+        "independent": rec("independent", 1500.0, 187.5, scaling=99.0),
+        "matrix_parallel": rec("matrix_parallel", 180.0, 22.5),
+        "pallas_ring": ring,
+        "single_bfloat16": rec("single", 190.0, 190.0, t=0.01),
+        "single_float32": rec("single", 40.0, 40.0, t=0.05),
+    })
+    assert "| independent | 1500.0 | 187.5 | 99% |" in md
+    assert "| matrix_parallel | 180.0 | 22.5 | N/A |" in md
+    # off-headline-size rows are labeled and their caveat surfaces
+    assert "| pallas_ring (at 4096x4096) | 90.0 | 11.3 | N/A |" in md
+    assert "VMEM-resident kernel" in md
+    assert "single_bfloat16" not in md  # dtype rows fold into the speedup line
+    assert "bf16 vs fp32 speedup: 5.00x" in md
+
+
 def test_summarize_table():
     from tpu_matmul_bench.utils.reporting import BenchmarkRecord
 

@@ -88,6 +88,17 @@ def compare(size: int, dtype: str, num_devices: int | None,
     return results
 
 
+def bf16_vs_fp32_line(results: dict[str, BenchmarkRecord]) -> str | None:
+    """The dtype key-insight line ≙ reference README.md:50 (~5x on the RTX
+    6000 Ada) — one definition shared by the summary and the markdown table."""
+    f32 = results.get("single_float32")
+    bf16 = results.get("single_bfloat16")
+    if not (f32 and bf16 and f32.avg_time_s > 0 and bf16.avg_time_s > 0):
+        return None
+    return (f"bf16 vs fp32 speedup: {f32.avg_time_s / bf16.avg_time_s:.2f}x "
+            f"(reference observed ~5x on the RTX 6000 Ada, README.md:50)")
+
+
 def summarize(results: dict[str, BenchmarkRecord]) -> str:
     """Build the comparison summary ≙ reference `compare_benchmarks.py:51-63`,
     but computed from data."""
@@ -122,14 +133,44 @@ def summarize(results: dict[str, BenchmarkRecord]) -> str:
         sp = results["collective_matmul"].extras.get("overlap_speedup_x")
         if sp:
             lines.append(f"ppermute collective matmul: {sp}x vs gather-then-matmul")
-    if "single_bfloat16" in results and "single_float32" in results:
-        f32, bf16 = results["single_float32"], results["single_bfloat16"]
-        if f32.avg_time_s > 0 and bf16.avg_time_s > 0:
-            lines.append(
-                f"bf16 vs fp32 speedup: {f32.avg_time_s / bf16.avg_time_s:.2f}x "
-                f"(reference observed ~5x on the RTX 6000 Ada, README.md:50)"
-            )
+    dtype_line = bf16_vs_fp32_line(results)
+    if dtype_line:
+        lines.append(dtype_line)
     lines.append("=" * 70)
+    return "\n".join(lines)
+
+
+def render_markdown(results: dict[str, BenchmarkRecord]) -> str:
+    """README-style results table ≙ the reference's published table shape
+    (`README.md:39-47`; BASELINE.json names reproducing it as the target):
+    per mode — total TFLOPS, per-device TFLOPS, scaling efficiency."""
+    size = next(iter(results.values())).size if results else 0
+    lines = [
+        f"| Mode | Total TFLOPS ({size}x{size}) | TFLOPS/device | Scaling |",
+        "|---|---|---|---|",
+    ]
+    notes = []
+    for name, rec in results.items():
+        if name.startswith("single_"):
+            continue  # dtype-sweep rows have their own story
+        scaling = (f"{rec.scaling_efficiency_pct:.0f}%"
+                   if rec.scaling_efficiency_pct is not None else "N/A")
+        label = name
+        if rec.size != size:
+            # e.g. pallas_ring rerun at its VMEM-limited size — the row must
+            # not claim the headline size (the caveat lives in extras['note'])
+            label = f"{name} (at {rec.size}x{rec.size})"
+        if rec.extras.get("note"):
+            notes.append(f"{name}: {rec.extras['note']}")
+        lines.append(
+            f"| {label} | {rec.tflops_total:.1f} | "
+            f"{rec.tflops_per_device:.1f} | {scaling} |"
+        )
+    dtype_line = bf16_vs_fp32_line(results)
+    extra_lines = notes + ([dtype_line] if dtype_line else [])
+    if extra_lines:
+        lines.append("")
+        lines.extend(extra_lines)
     return "\n".join(lines)
 
 
@@ -143,11 +184,17 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
     p.add_argument("--warmup", type=int, default=10)
     p.add_argument("--json-out", type=str, default=None,
                    help="write the comparison table as JSON lines")
+    p.add_argument("--markdown-out", type=str, default=None,
+                   help="write the README-style results table here "
+                        "(the reference table shape, README.md:39-47)")
     args = p.parse_args(argv)
 
     results = compare(args.size, args.dtype, args.num_devices,
                       args.iterations, args.warmup)
     report(summarize(results))
+    if args.markdown_out:
+        with open(args.markdown_out, "w") as fh:
+            fh.write(render_markdown(results) + "\n")
     if args.json_out:
         with open(args.json_out, "w") as fh:
             for name, rec in results.items():

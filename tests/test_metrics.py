@@ -57,6 +57,46 @@ def test_theoretical_peaks():
     assert theoretical_peak_tflops("TPU v5 lite", jnp.float32) is None
 
 
+def test_matmul_roofline():
+    from tpu_matmul_bench.utils.metrics import hbm_bandwidth_gbps, matmul_roofline_s
+
+    assert hbm_bandwidth_gbps("TPU v5 lite") == 819.0
+    assert hbm_bandwidth_gbps("mystery chip") is None
+    bounds = matmul_roofline_s(16384, "bfloat16", "TPU v5 lite")
+    t_flops, t_hbm = bounds
+    # 2·16384³ / 197e12 ≈ 44.7 ms; 3·16384²·2 / 819e9 ≈ 1.97 ms
+    assert t_flops == pytest.approx(2 * 16384**3 / 197e12)
+    assert t_hbm == pytest.approx(3 * 16384**2 * 2 / 819e9)
+    assert t_flops > 20 * t_hbm  # 16k bf16 is deep in the compute-bound regime
+    assert matmul_roofline_s(16384, "bfloat16", "unknown") is None
+
+
+def test_record_roofline_pct():
+    from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+
+    def rec(size, world=1, comm=None, t=None):
+        from tpu_matmul_bench.utils.metrics import matmul_roofline_s
+
+        bounds = matmul_roofline_s(size, "bfloat16", "TPU v5 lite")
+        return BenchmarkRecord(
+            benchmark="matmul", mode="single", size=size, dtype="bfloat16",
+            world=world, iterations=50, warmup=10,
+            avg_time_s=t if t is not None else 2 * max(bounds),
+            tflops_per_device=1.0, tflops_total=world,
+            device_kind="TPU v5 lite", comm_time_s=comm,
+        ).finalize()
+
+    # 256 bf16 is HBM-bound on v5e (t_hbm > t_flops) → roofline reported,
+    # at 50% since we ran at 2× the bound; applies on multi-chip comm-free
+    # records too (independent-style, one matmul per chip)
+    assert rec(256).roofline_pct == pytest.approx(50.0, rel=1e-3)
+    assert rec(256, world=8).roofline_pct == pytest.approx(50.0, rel=1e-3)
+    # compute-bound size → peak_efficiency_pct already tells the story
+    assert rec(16384).roofline_pct is None
+    # a communication leg voids the per-chip bound
+    assert rec(256, world=8, comm=0.001).roofline_pct is None
+
+
 def test_scaling_efficiency():
     # total == single·world → 100% ≙ matmul_scaling_benchmark.py:315
     assert scaling_efficiency(200.0, 100.0, 2) == pytest.approx(100.0)

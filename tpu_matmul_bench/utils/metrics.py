@@ -84,6 +84,50 @@ def theoretical_peak_tflops(device_kind: str, dtype: Any) -> float | None:
     return None
 
 
+# Per-chip HBM bandwidth (GB/s) from Google's published per-chip specs —
+# the memory leg of the roofline. Approximate; keyed like the peak table.
+_HBM_GBPS: dict[str, float] = {
+    "v6 lite": 1640.0,
+    "v6e": 1640.0,
+    "v5p": 2765.0,
+    "v5 lite": 819.0,
+    "v5e": 819.0,
+    "v4": 1228.0,
+    "v3": 900.0,
+    "v2": 700.0,
+}
+
+
+def hbm_bandwidth_gbps(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, bw in _HBM_GBPS.items():
+        if key in kind:
+            return bw
+    return None
+
+
+def matmul_roofline_s(
+    size: int, dtype: Any, device_kind: str
+) -> tuple[float, float] | None:
+    """Roofline lower bound for one square matmul: (compute-bound seconds,
+    HBM-bound seconds). Actual time ≥ max of the two; measured/bound is the
+    roofline % reported on records. The memory leg counts one read of A and
+    B and one write of C (perfect reuse — the bound, not a prediction).
+
+    The scaling-book mental model: a dense matmul leaves the memory-bound
+    regime once 2n³/peak exceeds 3n²·bytes/bw; at 16k bf16 on v5e the
+    compute leg dominates by ~100×, which is why the benchmark is a clean
+    MXU measurement.
+    """
+    peak = theoretical_peak_tflops(device_kind, dtype)
+    bw = hbm_bandwidth_gbps(device_kind)
+    if not peak or not bw:
+        return None
+    t_flops = matmul_flops(size) / (peak * 1e12)
+    t_hbm = 3 * size * size * bytes_per_element(dtype) / (bw * 1e9)
+    return t_flops, t_hbm
+
+
 def scaling_efficiency(total_tflops: float, single_tflops: float, world: int) -> float | None:
     """Scaling efficiency % = total / (single·world) · 100 ≙ reference
     `matmul_scaling_benchmark.py:315`. None when the single-device figure is

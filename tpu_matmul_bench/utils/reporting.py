@@ -19,6 +19,7 @@ import jax
 
 from tpu_matmul_bench.utils.metrics import (
     matmul_flops,
+    matmul_roofline_s,
     matrix_memory_gib,
     scaling_efficiency,
     theoretical_peak_tflops,
@@ -55,6 +56,9 @@ class BenchmarkRecord:
     comm_overhead_pct: float | None = None
     scaling_efficiency_pct: float | None = None
     peak_efficiency_pct: float | None = None
+    # measured vs the HBM roofline, set only for comm-free records at sizes
+    # where the memory leg binds (peak_efficiency_pct covers the MXU leg)
+    roofline_pct: float | None = None
     extras: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def finalize(self) -> "BenchmarkRecord":
@@ -72,6 +76,18 @@ class BenchmarkRecord:
             peak = theoretical_peak_tflops(self.device_kind, self.dtype)
             if peak:
                 self.peak_efficiency_pct = 100.0 * self.tflops_per_device / peak
+        if (
+            self.roofline_pct is None
+            and self.device_kind
+            and self.algbw_gbps is None  # FLOP benchmarks only
+            and self.avg_time_s > 0
+            and not self.comm_time_s  # comm-free: per-chip bound applies
+        ):
+            bounds = matmul_roofline_s(self.size, self.dtype, self.device_kind)
+            if bounds and bounds[1] > bounds[0]:
+                # only when the HBM leg binds — in the compute-bound regime
+                # the roofline equals peak efficiency and adds nothing
+                self.roofline_pct = 100.0 * bounds[1] / self.avg_time_s
         return self
 
     def to_json(self) -> str:
@@ -140,6 +156,11 @@ def format_record(rec: BenchmarkRecord) -> str:
         lines.append(
             f"  - Device efficiency: {rec.peak_efficiency_pct:.1f}% of "
             f"{rec.device_kind} theoretical peak"
+        )
+    if rec.roofline_pct is not None:
+        lines.append(
+            f"  - Roofline: {rec.roofline_pct:.1f}% of the HBM-bandwidth "
+            f"bound (memory-bound size; device efficiency understates it)"
         )
     for k, v in rec.extras.items():
         lines.append(f"  - {k}: {v}")

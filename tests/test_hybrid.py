@@ -1,0 +1,84 @@
+"""Tests for the hybrid 2-D (dp×tp) mesh mode and its CLI program."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_matmul_bench.parallel.hybrid import (
+    hybrid_mode,
+    hybrid_programs,
+    make_hybrid_mesh,
+)
+from tpu_matmul_bench.parallel.mesh import sharded_normal
+from tpu_matmul_bench.parallel.modes import run_mode_benchmark
+from tpu_matmul_bench.utils.config import parse_config
+from jax.sharding import PartitionSpec as P
+
+SIZE = 64
+
+
+def _cfg():
+    return parse_config(["--sizes", str(SIZE), "--iterations", "2",
+                         "--warmup", "1", "--dtype", "float32"], "t")
+
+
+@pytest.fixture(scope="module")
+def mesh2x4(devices):
+    return make_hybrid_mesh(devices, dp=2)
+
+
+def test_make_hybrid_mesh_validates(devices):
+    m = make_hybrid_mesh(devices, 4)
+    assert m.shape == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError, match="must divide"):
+        make_hybrid_mesh(devices, 3)
+
+
+def test_hybrid_compute_matches_dense(mesh2x4):
+    (x,) = sharded_normal(0, (4, SIZE, SIZE), jnp.float32, mesh2x4,
+                          P("dp"), count=1)
+    (w,) = sharded_normal(1, (SIZE, SIZE), jnp.float32, mesh2x4,
+                          P(None, "tp"), count=1)
+    compute, full = hybrid_programs(mesh2x4)
+    got = np.asarray(compute(x, w))
+    want = np.einsum("bij,jk->bik", np.asarray(x, np.float32),
+                     np.asarray(w, np.float32))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # full leg: every device ends with psum_dp(sum_b all_gather_tp(y));
+    # its stacked global view is [world·n, n] of identical [n, n] chunks
+    g = np.asarray(full(x, w))
+    assert g.shape == (8 * SIZE, SIZE)
+    want_g = want.reshape(2, 2, SIZE, SIZE).sum(axis=(0, 1))
+    for chunk in g.reshape(8, SIZE, SIZE):
+        np.testing.assert_allclose(chunk, want_g, rtol=1e-3, atol=1e-3)
+
+
+def test_hybrid_mode_record(mesh2x4):
+    cfg = _cfg()
+    rec = run_mode_benchmark(hybrid_mode(cfg, mesh2x4, SIZE), cfg)
+    assert rec.mode == "hybrid" and rec.world == 8
+    assert rec.extras["dp"] == 2 and rec.extras["tp"] == 4
+    assert rec.tflops_total > 0 and rec.comm_time_s is not None
+
+
+def test_hybrid_memory_estimate_is_pure_and_counts_full_program():
+    from tpu_matmul_bench.parallel.modes import estimate_memory_gib
+
+    cfg = _cfg()
+    n = 1024
+    # dp=2, tp=4, batch=4 → lb=2: 2·(2+0.25) + 0.25 + 1 = 5.75 matrices
+    want = 5.75 * n * n * 4 / 2**30  # float32
+    assert estimate_memory_gib("hybrid", cfg, 8, n, batch=4, dp=2) == \
+        pytest.approx(want)
+
+
+def test_hybrid_cli(capsys):
+    from tpu_matmul_bench.benchmarks.matmul_hybrid_benchmark import main
+
+    records = main(["--sizes", str(SIZE), "--iterations", "2", "--warmup", "1",
+                    "--dtype", "float32", "--dp", "4"])
+    out = capsys.readouterr().out
+    assert "dp=4 x tp=2" in out
+    assert len(records) == 1 and records[0].extras["dp"] == 4

@@ -1,0 +1,83 @@
+"""Hybrid 2-D mesh benchmark — dp×tp composed sharding in one program.
+
+No 1-D reference analogue (the reference composes nothing across process
+groups); this is the pod-mesh form of BASELINE.json's north star. `--dp`
+picks the data-parallel axis length; tensor parallelism gets the rest of
+the devices. Compute/comm split timing follows the same program-variant
+methodology as the 1-D modes (DESIGN.md §3).
+
+Run: python -m tpu_matmul_bench hybrid --dp 2 --num-devices 8 --sizes 4096
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from tpu_matmul_bench.benchmarks.runner import run_sizes
+from tpu_matmul_bench.parallel.collectives import verify_collectives
+from tpu_matmul_bench.parallel.hybrid import hybrid_mode, make_hybrid_mesh
+from tpu_matmul_bench.parallel.mesh import make_mesh
+from tpu_matmul_bench.parallel.modes import estimate_memory_gib, run_mode_benchmark
+from tpu_matmul_bench.utils.config import BenchConfig, build_parser, config_from_args
+from tpu_matmul_bench.utils.device import (
+    collect_device_info,
+    device_banner,
+    maybe_init_multihost,
+    resolve_devices,
+)
+from tpu_matmul_bench.utils.profiling import maybe_trace
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord, header, report
+
+
+def run(config: BenchConfig, dp: int, batch: int) -> list[BenchmarkRecord]:
+    maybe_init_multihost()
+    devices = resolve_devices(config.device, config.num_devices)
+    info = collect_device_info(devices)
+    mesh = make_hybrid_mesh(devices, dp)
+    report(device_banner(info))
+    report(header(
+        "Hybrid 2-D Mesh Benchmark (dp x tp, TPU-native)",
+        {
+            "Mesh": f"dp={mesh.shape['dp']} x tp={mesh.shape['tp']}",
+            "Global batch": batch,
+            "Data type": config.dtype_name,
+            "Iterations per test": config.iterations,
+            "Warmup iterations": config.warmup,
+        },
+    ))
+
+    # collective gate on the flat world (axes are checked composed below)
+    if len(devices) > 1:
+        report("\nVerifying collectives:")
+        if not verify_collectives(make_mesh(devices)):
+            report("\nERROR: collective verification failed — aborting")
+            raise SystemExit(1)
+
+    def bench_one(size: int) -> BenchmarkRecord:
+        setup = hybrid_mode(config, mesh, size, batch=batch)
+        return run_mode_benchmark(setup, config)
+
+    with maybe_trace(config.profile_dir):
+        records = run_sizes(
+            config, bench_one,
+            # pure estimator — the guard must never touch the allocator
+            memory_gib=lambda s: estimate_memory_gib(
+                "hybrid", config, len(devices), s, batch=batch, dp=dp),
+            memory_limit_gib=info.memory_gib,
+        )
+    report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)
+    return records
+
+
+def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
+    parser = build_parser(__doc__ or "hybrid benchmark")
+    parser.add_argument("--dp", type=int, default=2,
+                        help="data-parallel axis length (tp = devices/dp)")
+    parser.add_argument("--batch", type=int, default=4,
+                        help="global batch (≙ the scaling benchmark's 4)")
+    args = parser.parse_args(argv)
+    return run(config_from_args(args), args.dp, args.batch)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Hybrid 2-D mesh mode — data parallelism × tensor parallelism composed.
+
+The reference's modes are all 1-D (one process group over all ranks); its
+nearest composition is running batch_parallel and matrix_parallel as
+separate experiments. On TPU the natural object is a 2-D mesh ('dp', 'tp')
+where both shardings compose in ONE program — the pod-mesh form
+(BASELINE.json: "pjit shardings over a TPU pod mesh"): the per-device batch
+shard multiplies the local weight columns (tp leg), the output columns are
+all-gathered over 'tp', and the gradient-sync-style psum rides 'dp'. The
+two collectives use disjoint mesh axes, so on hardware they ride disjoint
+ICI rings concurrently.
+
+Layout: X [batch, n, n] sharded P('dp'); W [n, n] sharded P(None, 'tp');
+per-device compute is (batch/dp) matmuls of [n, n]·[n, n/tp].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_matmul_bench.ops.matmul import matmul_2d
+from tpu_matmul_bench.parallel.mesh import sharded_normal, smap
+from tpu_matmul_bench.parallel.modes import ModeSetup, estimate_memory_gib
+from tpu_matmul_bench.utils.config import BenchConfig
+from tpu_matmul_bench.utils.metrics import calculate_tflops
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+from tpu_matmul_bench.utils.timing import Timing
+
+
+def make_hybrid_mesh(devices, dp: int) -> Mesh:
+    """(dp, tp) mesh over the devices; tp = len(devices) // dp."""
+    n = len(devices)
+    if dp <= 0 or n % dp:
+        raise ValueError(f"--dp {dp} must divide the {n}-device world")
+    import numpy as np
+
+    return Mesh(np.asarray(devices).reshape(dp, n // dp), ("dp", "tp"))
+
+
+def hybrid_programs(mesh: Mesh, impl: str = "xla",
+                    blocks: tuple[int, int, int] | None = None):
+    """(compute, full) shard_map programs for the composed dp×tp step."""
+    mm = matmul_2d(impl, blocks)
+
+    def compute_body(x, w):  # x: [batch/dp, n, n], w: [n, n/tp]
+        return jnp.stack([mm(x[i], w) for i in range(x.shape[0])])
+
+    def full_body(x, w):
+        y = jax.lax.optimization_barrier(compute_body(x, w))
+        # tp leg: assemble full output columns on every tp rank
+        y = jax.lax.all_gather(y, "tp", axis=2, tiled=True)
+        # dp leg: gradient-sync-style reduction of the batch shard sum
+        g = jax.lax.psum(jnp.sum(y, axis=0), "dp")
+        return jax.lax.pcast(g, ("dp", "tp"), to="varying")
+
+    compute = smap(compute_body, mesh,
+                   in_specs=(P("dp"), P(None, "tp")),
+                   out_specs=P("dp", None, "tp"), check_vma=False)
+    full = smap(full_body, mesh,
+                in_specs=(P("dp"), P(None, "tp")),
+                out_specs=P(("dp", "tp")), check_vma=False)
+    return compute, full
+
+
+def hybrid_mode(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
+                benchmark: str = "hybrid") -> ModeSetup:
+    dp, tp = mesh.shape["dp"], mesh.shape["tp"]
+    world = dp * tp
+    local_batch = max(batch // dp, 1)
+    g = local_batch * dp
+
+    x, = sharded_normal(config.seed, (g, size, size), config.dtype, mesh,
+                        P("dp"), count=1)
+    w, = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
+                        P(None, "tp"), count=1)
+    compute, full = hybrid_programs(mesh, config.matmul_impl, config.blocks)
+
+    def build(t_compute: Timing, t_full: Timing | None, comm_s: float) -> BenchmarkRecord:
+        total_s = t_full.avg_s if t_full else t_compute.avg_s
+        # g full-size logical matmuls per step, split over the whole mesh
+        total = calculate_tflops(size, total_s, num_ops=g)
+        extras = {"dp": dp, "tp": tp, "global_batch": g,
+                  "local_batch": local_batch}
+        if g != batch:
+            extras["note"] = f"global batch grown from {batch} to {g} to cover dp={dp}"
+        return BenchmarkRecord(
+            benchmark=benchmark, mode="hybrid", size=size,
+            dtype=config.dtype_name, world=world,
+            iterations=(t_full or t_compute).iterations, warmup=config.warmup,
+            avg_time_s=total_s,
+            tflops_per_device=total / world,
+            tflops_total=total,
+            compute_time_s=t_compute.avg_s,
+            comm_time_s=comm_s,
+            extras=extras,
+        )
+
+    return ModeSetup("hybrid", (x, w), compute, full, build,
+                     memory_gib_per_device=estimate_memory_gib(
+                         "hybrid", config, world, size, batch=batch, dp=dp))

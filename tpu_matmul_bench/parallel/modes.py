@@ -73,13 +73,21 @@ def _gib(size: int, dtype: Any, count: float) -> float:
 
 
 def estimate_memory_gib(
-    mode: str, config: BenchConfig, world: int, size: int, batch: int = 4
+    mode: str, config: BenchConfig, world: int, size: int, batch: int = 4,
+    dp: int | None = None,
 ) -> float:
     """Per-device HBM footprint of a mode's operands + outputs — the single
     source for both ModeSetup.memory_gib_per_device and the pre-flight OOM
-    guard. Counts the *full* program's buffers (the all_gather / psum output
-    is a complete matrix on every device)."""
+    guard (pure: must never touch the allocator). Counts the *full*
+    program's buffers (the all_gather / psum output is a complete matrix on
+    every device)."""
     d = world
+    if mode == "hybrid":
+        # x shard (lb) + gathered output (lb) + compute output (lb/tp)
+        # + w shard (1/tp) + psum result (1)
+        tp = d // (dp or 1)
+        lb = max(batch // (dp or 1), 1)
+        return _gib(size, config.dtype, lb * (2 + 1.0 / tp) + 1.0 / tp + 1)
     if mode == "batch_parallel":
         return _gib(size, config.dtype, 3 * max(batch // d, 1))
     if mode in ("matrix_parallel", "model_parallel", "collective_matmul") and d > 1:

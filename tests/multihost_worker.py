@@ -1,0 +1,58 @@
+"""Worker for the multi-host test: joins a 2-process JAX cluster over
+localhost (the TPU-native analogue of a torchrun multi-node rendezvous,
+reference `run_scaling_benchmark.sh:23-31`) and runs a cross-process psum.
+
+Invoked by tests/test_multihost.py as:
+    python tests/multihost_worker.py <coordinator> <num_procs> <proc_id>
+Prints 'MULTIHOST_OK <process_count> <psum_value>' on success.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the standard cluster env vars our maybe_init_multihost() keys on
+coordinator, num_procs, proc_id = sys.argv[1], sys.argv[2], sys.argv[3]
+os.environ["JAX_COORDINATOR_ADDRESS"] = coordinator
+os.environ["JAX_NUM_PROCESSES"] = num_procs
+os.environ["JAX_PROCESS_ID"] = proc_id
+
+
+def main() -> None:
+    import jax
+
+    from tpu_matmul_bench.utils.device import maybe_init_multihost
+
+    maybe_init_multihost()
+    assert jax.process_count() == int(num_procs), (
+        f"multihost init failed: process_count {jax.process_count()}"
+    )
+
+    import jax.numpy as jnp
+
+    from tpu_matmul_bench.parallel.collectives import psum_over
+    from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
+    from tpu_matmul_bench.utils.reporting import is_reporting_process
+
+    world = jax.device_count()  # 2 local × num_procs
+    mesh = make_mesh(jax.devices())
+    (x,) = sharded_normal(0, (world, 4), jnp.float32, mesh,
+                          jax.sharding.PartitionSpec("x"), count=1)
+    ones = jax.tree_util.tree_map(lambda a: a * 0 + 1.0, x)
+    y = psum_over(mesh)(ones)
+    # every local shard must hold the world-wide sum
+    import numpy as np
+
+    for shard in y.addressable_shards:
+        np.testing.assert_allclose(np.asarray(shard.data), float(world))
+
+    # rank-0-style gate: exactly one process reports
+    tag = "MULTIHOST_OK" if is_reporting_process() else "MULTIHOST_WORKER"
+    print(f"{tag} {jax.process_count()} {float(world)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

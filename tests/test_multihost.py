@@ -1,0 +1,53 @@
+"""Multi-host distributed backend test: 2 coordinated processes on localhost.
+
+The reference's multi-process story is torchrun's NCCL rendezvous
+(`run_scaling_benchmark.sh:23-31`, single-node only). The TPU-native
+equivalent is `jax.distributed.initialize` joining processes into one
+cluster whose devices form a global mesh; this test spawns two real
+processes, runs a cross-process psum through the framework's own mesh +
+collective wrappers, and checks the rank-0 reporting gate.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+WORKER = Path(__file__).parent / "multihost_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_psum():
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = str(WORKER.parent.parent)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), coordinator, "2", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(WORKER.parent.parent),
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    combined = "\n".join(outs)
+    # both workers saw a 2-process cluster and a world-4 psum...
+    assert combined.count("2 4.0") == 2, combined
+    # ...and exactly one of them is the reporting process
+    assert combined.count("MULTIHOST_OK") == 1, combined
+    assert combined.count("MULTIHOST_WORKER") == 1, combined

@@ -132,23 +132,38 @@ def maybe_init_multihost() -> None:
     the standard cluster env vars are present, keeping single-host runs
     untouched.
     """
-    in_cluster = any(
-        v in os.environ
-        for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS")
-    )
     # Must run before any backend-initializing call (jax.devices(),
     # process_count(), ...), so gate on env vars only.
-    if in_cluster:
-        try:
-            jax.distributed.initialize()
-        except Exception as e:
-            msg = str(e).lower()
-            if "already" in msg or "initialized" in msg:
-                return  # benign: called twice in one process
-            import sys
-
-            print(
-                f"WARNING: multi-host init failed ({e}); continuing single-host "
-                f"— world size will only cover local devices",
-                file=sys.stderr,
+    explicit = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    managed = any(v in os.environ for v in
+                  ("COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS"))
+    if explicit is None and not managed:
+        return
+    num_procs = os.environ.get("JAX_NUM_PROCESSES")
+    proc_id = os.environ.get("JAX_PROCESS_ID")
+    try:
+        if explicit is not None and num_procs is not None and proc_id is not None:
+            # generic env-var contract (≙ torchrun's RANK/WORLD_SIZE,
+            # reference matmul_benchmark.py:10-12): argless initialize()
+            # does NOT consume these, so pass them explicitly
+            jax.distributed.initialize(
+                coordinator_address=explicit,
+                num_processes=int(num_procs),
+                process_id=int(proc_id),
             )
+        else:
+            # managed clusters (SLURM / MPI / Cloud-TPU multislice): the
+            # argless form's autodetect rewrites coordinator ports etc. —
+            # e.g. MEGASCALE_COORDINATOR_ADDRESS must NOT be passed verbatim
+            jax.distributed.initialize()
+    except Exception as e:
+        msg = str(e).lower()
+        if "already" in msg or "initialized" in msg:
+            return  # benign: called twice in one process
+        import sys
+
+        print(
+            f"WARNING: multi-host init failed ({e}); continuing single-host "
+            f"— world size will only cover local devices",
+            file=sys.stderr,
+        )

@@ -1,0 +1,79 @@
+#!/usr/bin/env bash
+# Multi-process launcher — the torchrun analogue for JAX's multi-controller
+# SPMD mode (reference `run_scaling_benchmark.sh:23-31` spawns one process
+# per GPU via torch.distributed.run; here each process is one HOST of a
+# multi-host cluster and sees all its local devices).
+#
+# Usage: ./run_multihost_benchmark.sh [NPROCS] [MODE] [DTYPE] [--device=cpu] [extra flags...]
+#
+# Local demo mode (default): spawns NPROCS processes on this machine joined
+# through a localhost coordinator. With --device=cpu each process simulates
+# a 2-device host (virtual CPU mesh), so world = 2*NPROCS.
+# Real pod mode: run this once per host with MULTIHOST_PROC_ID=<host index>
+# and MULTIHOST_COORDINATOR=<host0>:<port> exported; the script then execs a
+# single process that joins the existing cluster.
+set -euo pipefail
+
+NPROCS=${1:-2}
+MODE=${2:-independent}
+DTYPE=${3:-bfloat16}
+EXTRA=()
+CPU=0
+for arg in "${@:4}"; do
+  case "$arg" in
+    --device=cpu) CPU=1 ;;
+    --device=*) ;;  # device selection is implied by the cluster's backend
+    *) EXTRA+=("$arg") ;;
+  esac
+done
+
+# pick a verified-free port for the local demo (an occupied port would make
+# the cluster rendezvous hang until the distributed-init timeout)
+free_port() {
+  python3 - <<'EOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+EOF
+}
+if [[ -n "${MULTIHOST_PROC_ID:-}" && -z "${MULTIHOST_COORDINATOR:-}" ]]; then
+  echo "ERROR: MULTIHOST_PROC_ID is set but MULTIHOST_COORDINATOR is not —" >&2
+  echo "every host must rendezvous at the same <host0>:<port> address" >&2
+  exit 2
+fi
+COORD=${MULTIHOST_COORDINATOR:-127.0.0.1:$(free_port)}
+export JAX_COORDINATOR_ADDRESS="$COORD"
+export JAX_NUM_PROCESSES="$NPROCS"
+if [[ $CPU -eq 1 ]]; then
+  export JAX_PLATFORMS=cpu
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=2"
+  unset PALLAS_AXON_POOL_IPS || true
+fi
+
+CMD=(python3 -m tpu_matmul_bench.benchmarks.matmul_scaling_benchmark
+     --mode "${MODE}" --dtype "${DTYPE}" ${EXTRA[@]+"${EXTRA[@]}"})
+
+if [[ -n "${MULTIHOST_PROC_ID:-}" ]]; then
+  export JAX_PROCESS_ID="$MULTIHOST_PROC_ID"
+  echo "Joining cluster $COORD as process $JAX_PROCESS_ID/$NPROCS"
+  exec "${CMD[@]}"
+fi
+
+echo "Running multi-process benchmark: $NPROCS processes, mode=${MODE}, dtype=${DTYPE}, coordinator=$COORD"
+WORKER_LOG_DIR=$(mktemp -d)
+PIDS=()
+# if rank 0 fails, don't orphan workers blocked in collectives
+trap 'kill ${PIDS[@]+"${PIDS[@]}"} 2>/dev/null || true' EXIT
+for ((i=1; i<NPROCS; i++)); do
+  JAX_PROCESS_ID=$i "${CMD[@]}" >"$WORKER_LOG_DIR/worker$i.log" 2>&1 &
+  PIDS+=($!)
+done
+if ! JAX_PROCESS_ID=0 "${CMD[@]}"; then
+  echo "rank 0 failed; worker logs in $WORKER_LOG_DIR" >&2
+  exit 1
+fi
+for pid in ${PIDS[@]+"${PIDS[@]}"}; do wait "$pid"; done
+trap - EXIT
+rm -rf "$WORKER_LOG_DIR"

@@ -23,6 +23,25 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def test_multihost_launcher_runs_scaling_benchmark():
+    """The torchrun-analogue launcher: 2 coordinated processes running the
+    real scaling benchmark over a 4-device (2 hosts × 2) global mesh."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")}
+    out = subprocess.run(
+        ["./run_multihost_benchmark.sh", "2", "independent", "bfloat16",
+         "--device=cpu", "--sizes", "64", "--iterations", "2", "--warmup", "1"],
+        cwd=str(WORKER.parent.parent), env=env, text=True,
+        capture_output=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Number of devices: 4" in out.stdout
+    assert "Processes: 2 (this is process 0)" in out.stdout
+    assert "Results for 64x64 [independent]" in out.stdout
+    # worker process output is suppressed → exactly one results block
+    assert out.stdout.count("Results for 64x64") == 1
+
+
 def test_two_process_psum():
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items()

@@ -85,7 +85,18 @@ def run(
         # split one op across devices: same total work, scaling N/A
         # (reference README.md:46).
         if d > 1 and rec.mode in ("independent", "batch_parallel", "data_parallel"):
-            attach_scaling_efficiency(rec, _single_device_tflops(config, devices[0], size))
+            import jax
+
+            # the first process-LOCAL device of the *resolved* list: respects
+            # --device, and under multi-process SPMD every process measures
+            # its own chip (devices[0] may be another host's)
+            local = next(
+                (dev for dev in devices
+                 if dev.process_index == jax.process_index()),
+                devices[0],
+            )
+            attach_scaling_efficiency(
+                rec, _single_device_tflops(config, local, size))
         return rec
 
     with maybe_trace(config.profile_dir):

@@ -71,42 +71,74 @@ def verify_collectives(mesh: Mesh, axis: str = "x", *, verbose: bool = True) -> 
     n = mesh.shape[axis]
     ok = True
 
-    def check(name: str, got: np.ndarray, want: np.ndarray, tol: float = 1e-3) -> bool:
-        good = bool(np.allclose(got, want, rtol=tol, atol=tol))
+    def report_check(name: str, good: bool, detail: str = "") -> bool:
         if verbose and jax.process_index() == 0:
-            status = "PASSED" if good else "FAILED"
-            print(f"  - {name}: {status}")
-            if not good:
-                print(f"      got {got!r}, want {want!r}")
+            print(f"  - {name}: {'PASSED' if good else 'FAILED'}")
+            if not good and detail:
+                print(f"      {detail}")
         return good
 
+    def check_shards(name: str, y: jax.Array, expect, tol: float = 1e-3) -> bool:
+        """Compare each *addressable* shard against expect(device_index) —
+        multi-process-safe: a process never fetches remote shards (global
+        np.asarray would raise on a non-replicated multi-host array).
+        `expect(d)` may return a scalar or the shard's full expected array."""
+        good, detail = True, ""
+        for shard in y.addressable_shards:
+            d = shard.index[0].start or 0
+            got = np.asarray(shard.data)
+            want = np.broadcast_to(np.asarray(expect(d), got.dtype), got.shape)
+            if not np.allclose(got, want, rtol=tol, atol=tol):
+                good, detail = False, f"device {d}: got {got!r}, want {want!r}"
+        return report_check(name, good, detail)
+
+    def run(body):
+        """smap a no-input body producing one value per device ([1]-shaped),
+        stacked over the axis. Inputs come from axis_index *inside* the
+        program, so no host-side global array is ever constructed."""
+        return _smap(body, mesh, in_specs=(), out_specs=P(axis),
+                     check_vma=False)()
+
+    def rank_plus_one():
+        return (jax.lax.axis_index(axis) + 1).astype(jnp.float32)[None]
+
     # all_reduce(SUM) of (rank+1) == n(n+1)/2 ≙ reference :33-37
-    ranks_plus_one = jnp.arange(1, n + 1, dtype=jnp.float32)
-    summed = np.asarray(psum_over(mesh, axis)(ranks_plus_one))
-    ok &= check("psum (all_reduce SUM)", summed, np.full(n, n * (n + 1) / 2.0))
+    summed = run(lambda: jax.lax.psum(rank_plus_one(), axis))
+    ok &= check_shards("psum (all_reduce SUM)", summed,
+                       lambda d: n * (n + 1) / 2.0)
 
     # all_reduce(AVG) == mean of (rank+1)
-    avged = np.asarray(pmean_over(mesh, axis)(ranks_plus_one))
-    ok &= check("pmean (all_reduce AVG)", avged, np.full(n, (n + 1) / 2.0))
+    avged = run(lambda: jax.lax.pmean(rank_plus_one(), axis))
+    ok &= check_shards("pmean (all_reduce AVG)", avged,
+                       lambda d: (n + 1) / 2.0)
 
     # all_gather of (rank*2) == [0, 2, 4, ...] everywhere ≙ reference :41-47
-    gathered = np.asarray(all_gather_over(mesh, axis)(jnp.arange(n, dtype=jnp.float32) * 2))
-    ok &= check("all_gather", gathered, np.arange(n, dtype=np.float32) * 2)
+    gathered = run(lambda: jax.lax.all_gather(
+        2.0 * jax.lax.axis_index(axis).astype(jnp.float32), axis))
+    ok &= check_shards("all_gather", gathered,
+                       lambda d: 2.0 * np.arange(n, dtype=np.float32))
 
     # ppermute ring shift: device d receives from d-1 (the primitive the
     # overlap suite's ring collectives are built on; no reference analogue —
     # NCCL send/recv is not used there, CUDA streams are; SURVEY P8).
-    def ring(x):
-        return jax.lax.ppermute(x, axis, ring_perm(n))
-
-    shifted = np.asarray(
-        _smap(ring, mesh, in_specs=P(axis), out_specs=P(axis))(
-            jnp.arange(n, dtype=jnp.float32)
-        )
-    )
-    ok &= check("ppermute (ring shift)", shifted, np.roll(np.arange(n, dtype=np.float32), 1))
+    shifted = run(lambda: jax.lax.ppermute(
+        jax.lax.axis_index(axis).astype(jnp.float32)[None], axis,
+        ring_perm(n)))
+    ok &= check_shards("ppermute (ring shift)", shifted,
+                       lambda d: (d - 1) % n)
 
     # barrier ≙ reference :50 — under single-controller JAX a barrier is
     # implicit in blocking on any collective's result, which the checks above
     # already did; nothing separate to test.
+
+    # Multi-process: verdicts are shard-local, so combine them — otherwise a
+    # failure on another host is invisible here and the cluster diverges
+    # (that host aborts while this one proceeds into a hanging collective).
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        all_ok = multihost_utils.process_allgather(np.array([bool(ok)]))
+        if ok and not all_ok.all():
+            report_check("collectives on a remote process", False)
+        ok = bool(all_ok.all())
     return bool(ok)

@@ -86,6 +86,21 @@ def _measure_sync_overhead(out: Any, samples: int = 3) -> float:
     return best
 
 
+def _agree(value: float) -> float:
+    """Under multi-controller SPMD every process must take IDENTICAL
+    control-flow decisions about how many collective programs to dispatch —
+    a process-local wall-clock reading driving the auto-scale loop would
+    deadlock the cluster (processes disagree on the factor and dispatch
+    different numbers of programs). Broadcast process 0's reading so the
+    loop is bit-identical everywhere; single-process runs pass through.
+    """
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return float(multihost_utils.broadcast_one_to_all(np.float32(value)))
+
+
 def time_jitted(
     fn: Callable[..., Any],
     args: Sequence[Any],
@@ -103,7 +118,7 @@ def time_jitted(
     for _ in range(max(warmup, 1)):  # at least once, to absorb compilation
         out = fn(*args)
     sync(out)
-    overhead = _measure_sync_overhead(out)
+    overhead = _agree(_measure_sync_overhead(out))
 
     # Auto-scale the iteration count until device time dominates the barrier
     # round-trip, else short loops on high-latency backends measure only the
@@ -116,7 +131,7 @@ def time_jitted(
         for _ in range(n):
             out = fn(*args)
         sync(out)
-        raw = time.perf_counter() - start
+        raw = _agree(time.perf_counter() - start)
         device_total = raw - overhead
         if device_total >= 5 * overhead or factor >= 256:
             break

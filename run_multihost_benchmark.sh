@@ -5,6 +5,8 @@
 # multi-host cluster and sees all its local devices).
 #
 # Usage: ./run_multihost_benchmark.sh [NPROCS] [MODE] [DTYPE] [--device=cpu] [extra flags...]
+# MULTIHOST_PROGRAM selects the benchmark module (scaling | distributed |
+# overlap | collectives; default scaling).
 #
 # Local demo mode (default): spawns NPROCS processes on this machine joined
 # through a localhost coordinator. With --device=cpu each process simulates
@@ -15,7 +17,13 @@
 set -euo pipefail
 
 NPROCS=${1:-2}
-MODE=${2:-independent}
+case "${MULTIHOST_PROGRAM:-scaling}" in
+  distributed) DEFAULT_MODE=data_parallel ;;
+  overlap) DEFAULT_MODE=overlap ;;
+  collectives) DEFAULT_MODE=psum ;;
+  *) DEFAULT_MODE=independent ;;
+esac
+MODE=${2:-$DEFAULT_MODE}
 DTYPE=${3:-bfloat16}
 EXTRA=()
 CPU=0
@@ -52,7 +60,14 @@ if [[ $CPU -eq 1 ]]; then
   unset PALLAS_AXON_POOL_IPS || true
 fi
 
-CMD=(python3 -m tpu_matmul_bench.benchmarks.matmul_scaling_benchmark
+case "${MULTIHOST_PROGRAM:-scaling}" in
+  scaling) MODULE=tpu_matmul_bench.benchmarks.matmul_scaling_benchmark ;;
+  distributed) MODULE=tpu_matmul_bench.benchmarks.matmul_distributed_benchmark ;;
+  overlap) MODULE=tpu_matmul_bench.benchmarks.matmul_overlap_benchmark ;;
+  collectives) MODULE=tpu_matmul_bench.benchmarks.collective_benchmark ;;
+  *) echo "ERROR: unknown MULTIHOST_PROGRAM '${MULTIHOST_PROGRAM}'" >&2; exit 2 ;;
+esac
+CMD=(python3 -m "$MODULE"
      --mode "${MODE}" --dtype "${DTYPE}" ${EXTRA[@]+"${EXTRA[@]}"})
 
 if [[ -n "${MULTIHOST_PROC_ID:-}" ]]; then
@@ -74,6 +89,13 @@ if ! JAX_PROCESS_ID=0 "${CMD[@]}"; then
   echo "rank 0 failed; worker logs in $WORKER_LOG_DIR" >&2
   exit 1
 fi
-for pid in ${PIDS[@]+"${PIDS[@]}"}; do wait "$pid"; done
+FAILED=0
+for pid in ${PIDS[@]+"${PIDS[@]}"}; do
+  wait "$pid" || FAILED=1
+done
 trap - EXIT
+if [[ $FAILED -ne 0 ]]; then
+  echo "a worker process failed; logs kept in $WORKER_LOG_DIR" >&2
+  exit 1
+fi
 rm -rf "$WORKER_LOG_DIR"

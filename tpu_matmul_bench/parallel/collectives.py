@@ -85,8 +85,9 @@ def verify_collectives(mesh: Mesh, axis: str = "x", *, verbose: bool = True) -> 
         `expect(d)` may return a scalar or the shard's full expected array."""
         good, detail = True, ""
         for shard in y.addressable_shards:
-            d = shard.index[0].start or 0
             got = np.asarray(shard.data)
+            # index is in elements; one device owns got.shape[0] of them
+            d = (shard.index[0].start or 0) // max(got.shape[0], 1)
             want = np.broadcast_to(np.asarray(expect(d), got.dtype), got.shape)
             if not np.allclose(got, want, rtol=tol, atol=tol):
                 good, detail = False, f"device {d}: got {got!r}, want {want!r}"

@@ -9,9 +9,14 @@ PyTorch/CUDA reference `Rajakoduri-Mihira/pytorch-distributed-matmul-benchmark`
   data_parallel, model_parallel) expressed as `shard_map`/`pjit` shardings over
   a `jax.sharding.Mesh`, with XLA collectives over ICI
 - an overlap suite (no_overlap, overlap, pipeline) built on XLA's async
-  collectives and a ppermute-overlapped collective matmul, plus Pallas kernels
+  collectives, ppermute-ring collective matmuls (all-gather and
+  reduce-scatter duals), and an in-kernel Pallas ring-RDMA matmul
+- a hybrid dp×tp 2-D mesh benchmark, nccl-tests-style collective bandwidth
+  benchmarks, a Pallas block tuner, and multi-process (multi-host) SPMD
+  execution via jax.distributed
 - compute-vs-communication split timing, TFLOPS / scaling-efficiency /
-  memory reporting, collective verification, structured JSON results
+  roofline / memory reporting, collective verification, structured JSON
+  results
 
 The reference is 100% Python over torch/NCCL (SURVEY.md §2: no native
 components); the native layer here is XLA-compiled jnp/Pallas kernels and XLA

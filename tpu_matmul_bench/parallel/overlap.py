@@ -26,6 +26,11 @@ collectives + latency-hiding scheduler inside ONE compiled program:
   latency-hiding collective-matmul pattern; BASELINE.json's north-star names
   this form). No reference analogue — this is what the stream tricks become
   when re-designed for ICI.
+- ``collective_matmul_rs``: its reduce-scatter dual — chunked partial
+  products picked up by an accumulator ring (the "matmul then gradient
+  sync" shape).
+- ``pallas_ring``: the all-gather ring hand-scheduled inside one Pallas
+  kernel (`ops/pallas_ring.py`), RDMA double-buffered against the MXU.
 
 Every variant times ONE jitted scan program of `steps_per_call` steps, so the
 host never intervenes mid-pipeline (the scan is the stream). The ring-buffer

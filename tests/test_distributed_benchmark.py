@@ -1,0 +1,29 @@
+"""CLI e2e tests for the distributed benchmark program (SURVEY P5/P6)."""
+
+import json
+
+import pytest
+
+
+@pytest.mark.parametrize("mode", ["independent", "data_parallel", "model_parallel"])
+def test_distributed_cli(mode, tmp_path, capsys):
+    from tpu_matmul_bench.benchmarks.matmul_distributed_benchmark import main
+
+    out_path = tmp_path / "out.jsonl"
+    records = main(["--mode", mode, "--sizes", "64", "--iterations", "2",
+                    "--warmup", "1", "--dtype", "float32",
+                    "--json-out", str(out_path)])
+    out = capsys.readouterr().out
+    assert f"Results for 64x64 [{mode}]" in out
+    assert len(records) == 1 and records[0].mode == mode
+    rec = json.loads(out_path.read_text())
+    assert rec["benchmark"] == "distributed" and rec["world"] == 8
+
+
+def test_distributed_default_mode_matches_reference():
+    # ≙ reference backup/matmul_distributed_benchmark.py:283-285
+    from tpu_matmul_bench.benchmarks.matmul_distributed_benchmark import main
+
+    records = main(["--sizes", "64", "--iterations", "2", "--warmup", "1",
+                    "--dtype", "float32"])
+    assert records[0].mode == "data_parallel"

@@ -1,0 +1,45 @@
+"""Tests for --percentiles latency-distribution reporting."""
+
+import jax.numpy as jnp
+import pytest
+
+from tpu_matmul_bench.utils.timing import time_percentiles
+
+
+def test_time_percentiles_ordering():
+    fn = lambda x: x @ x
+    x = jnp.ones((64, 64), jnp.float32)
+    pct = time_percentiles(fn, (x,), iterations=10, warmup=2)
+    assert set(pct) == {"p50_s", "p90_s", "p99_s", "min_s", "max_s"}
+    assert pct["min_s"] <= pct["p50_s"] <= pct["p90_s"] <= pct["p99_s"] <= pct["max_s"]
+    assert pct["min_s"] > 0
+
+
+def test_matmul_cli_percentiles(capsys):
+    from tpu_matmul_bench.benchmarks.matmul_benchmark import main
+
+    records = main(["--sizes", "64", "--iterations", "3", "--warmup", "1",
+                    "--num-devices", "1", "--percentiles"])
+    lat = records[0].extras["latency_ms"]
+    assert set(lat) == {"p50", "p90", "p99", "min", "max"}
+    assert "latency_ms" in capsys.readouterr().out
+
+
+def test_matmul_cli_percentiles_all_devices():
+    from tpu_matmul_bench.benchmarks.matmul_benchmark import main
+
+    records = main(["--sizes", "64", "--iterations", "3", "--warmup", "1",
+                    "--percentiles"])  # 8-device path
+    assert records[0].world == 8
+    assert "latency_ms" in records[0].extras
+
+
+@pytest.mark.parametrize("cli", ["scaling", "overlap"])
+def test_mode_cli_percentiles(cli):
+    import importlib
+
+    main = importlib.import_module(
+        f"tpu_matmul_bench.benchmarks.matmul_{cli}_benchmark").main
+    records = main(["--sizes", "64", "--iterations", "2", "--warmup", "1",
+                    "--dtype", "float32", "--percentiles"])
+    assert "latency_ms" in records[0].extras

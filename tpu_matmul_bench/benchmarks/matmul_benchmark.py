@@ -33,7 +33,7 @@ from tpu_matmul_bench.utils.device import (
 from tpu_matmul_bench.utils.metrics import calculate_tflops
 from tpu_matmul_bench.utils.profiling import maybe_trace
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord, header, report
-from tpu_matmul_bench.utils.timing import time_jitted
+from tpu_matmul_bench.utils.timing import latency_percentiles_ms, time_jitted
 
 
 def _bench_single(
@@ -46,6 +46,9 @@ def _bench_single(
         a, b = wl.operands()
         mm = make_matmul(config.matmul_impl, config.blocks)
         t = time_jitted(mm, (a, b), iterations=config.iterations, warmup=config.warmup)
+        extras: dict = {} if t.reliable else {"timing_reliable": False}
+        if config.percentiles:
+            extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
     tflops = calculate_tflops(size, t.avg_s)
     return BenchmarkRecord(
         benchmark="matmul",
@@ -59,7 +62,7 @@ def _bench_single(
         tflops_per_device=tflops,
         tflops_total=tflops,
         device_kind=device_kind,
-        extras={} if t.reliable else {"timing_reliable": False},
+        extras=extras,
     )
 
 
@@ -84,6 +87,9 @@ def _bench_all_devices(
         )
     )
     t = time_jitted(mm, (a, b), iterations=config.iterations, warmup=config.warmup)
+    extras: dict = {} if t.reliable else {"timing_reliable": False}
+    if config.percentiles:
+        extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
     per_device = calculate_tflops(size, t.avg_s)  # each device did one matmul/iter
     return BenchmarkRecord(
         benchmark="matmul",
@@ -97,7 +103,7 @@ def _bench_all_devices(
         tflops_per_device=per_device,
         tflops_total=per_device * d,  # ≙ all_reduce SUM of TFLOPS (:114)
         device_kind=device_kind,
-        extras={} if t.reliable else {"timing_reliable": False},
+        extras=extras,
     )
 
 

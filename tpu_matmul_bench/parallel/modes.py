@@ -32,7 +32,12 @@ from tpu_matmul_bench.parallel.mesh import sharded_normal, smap as _smap, world_
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.metrics import calculate_tflops
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
-from tpu_matmul_bench.utils.timing import Timing, time_jitted, time_variants
+from tpu_matmul_bench.utils.timing import (
+    Timing,
+    latency_percentiles_ms,
+    time_jitted,
+    time_variants,
+)
 
 
 @dataclasses.dataclass
@@ -373,6 +378,9 @@ def run_mode_benchmark(setup: ModeSetup, config: BenchConfig) -> BenchmarkRecord
         rec = setup.build_record(t_compute, None, 0.0)
         if not t_compute.reliable:
             rec.extras["timing_reliable"] = False
+        if config.percentiles:
+            rec.extras["latency_ms"] = latency_percentiles_ms(
+                setup.compute, setup.operands, config)
         return rec
     t_compute, t_full, comm_s = time_variants(
         setup.compute, setup.full, setup.operands,
@@ -381,4 +389,7 @@ def run_mode_benchmark(setup: ModeSetup, config: BenchConfig) -> BenchmarkRecord
     rec = setup.build_record(t_compute, t_full, comm_s)
     if not (t_compute.reliable and t_full.reliable):
         rec.extras["timing_reliable"] = False
+    if config.percentiles:
+        rec.extras["latency_ms"] = latency_percentiles_ms(
+            setup.full, setup.operands, config)
     return rec

@@ -49,6 +49,7 @@ class BenchConfig:
     matmul_impl: str
     seed: int
     profile_dir: str | None = None
+    percentiles: bool = False
     # Pallas kernel block override (None → kernel defaults); ignored by --matmul-impl xla
     block_m: int | None = None
     block_n: int | None = None
@@ -117,6 +118,11 @@ def build_parser(
         help="Matmul implementation: XLA jnp.matmul or the Pallas kernel",
     )
     p.add_argument("--seed", type=int, default=0, help="PRNG seed for operand data")
+    p.add_argument(
+        "--percentiles", action="store_true",
+        help="Also measure per-iteration latency percentiles (p50/p90/p99) — "
+             "exposes jitter that the whole-loop mean hides",
+    )
     for dim in "mnk":
         p.add_argument(
             f"--block-{dim}", type=int, default=None,
@@ -147,6 +153,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         matmul_impl=args.matmul_impl,
         seed=args.seed,
         profile_dir=getattr(args, "profile_dir", None),
+        percentiles=getattr(args, "percentiles", False),
         block_m=getattr(args, "block_m", None),
         block_n=getattr(args, "block_n", None),
         block_k=getattr(args, "block_k", None),

@@ -76,6 +76,16 @@ class Timing:
         return self.avg_s * 1e3
 
 
+def _warm(call: Callable[[], Any], warmup: int) -> tuple[Any, float]:
+    """Shared timed-loop preamble: run warmup (≥1, to absorb compilation),
+    sync, and measure the fixed barrier round-trip to subtract later."""
+    out = None
+    for _ in range(max(warmup, 1)):
+        out = call()
+    sync(out)
+    return out, _measure_sync_overhead(out)
+
+
 def _measure_sync_overhead(out: Any, samples: int = 3) -> float:
     """Fixed cost of `sync` on already-finished work (round-trip latency)."""
     best = float("inf")
@@ -114,11 +124,8 @@ def time_jitted(
     compile on first call) runs first and is excluded, and the barrier's fixed
     round-trip latency is measured and subtracted.
     """
-    out = None
-    for _ in range(max(warmup, 1)):  # at least once, to absorb compilation
-        out = fn(*args)
-    sync(out)
-    overhead = _agree(_measure_sync_overhead(out))
+    out, overhead = _warm(lambda: fn(*args), warmup)
+    overhead = _agree(overhead)
 
     # Auto-scale the iteration count until device time dominates the barrier
     # round-trip, else short loops on high-latency backends measure only the
@@ -168,6 +175,47 @@ def time_variants(
     return t_compute, t_full, comm_s
 
 
+def time_percentiles(
+    fn: Callable[..., Any],
+    args: Sequence[Any],
+    *,
+    iterations: int = 50,
+    warmup: int = 10,
+) -> dict[str, float]:
+    """Per-iteration latency distribution (seconds): p50/p90/p99/min/max.
+
+    Each iteration is individually synced, so the distribution exposes
+    jitter (ICI contention, host scheduling) that whole-loop means hide.
+    The fixed sync round-trip is measured and subtracted per iteration;
+    on high-round-trip backends the distribution is of (device + residual
+    barrier noise), so read percentiles relative to each other.
+    """
+    out, overhead = _warm(lambda: fn(*args), warmup)
+
+    samples = []
+    for _ in range(iterations):
+        start = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        samples.append(max(time.perf_counter() - start - overhead, 1e-9))
+    arr = np.asarray(samples)
+    return {
+        "p50_s": float(np.percentile(arr, 50)),
+        "p90_s": float(np.percentile(arr, 90)),
+        "p99_s": float(np.percentile(arr, 99)),
+        "min_s": float(arr.min()),
+        "max_s": float(arr.max()),
+    }
+
+
+def latency_percentiles_ms(fn, operands, config) -> dict[str, float]:
+    """--percentiles extras: per-iteration latency distribution in ms (the
+    program is already compiled by the main timing loop, so warmup=1)."""
+    pct = time_percentiles(fn, operands, iterations=config.iterations,
+                           warmup=1)
+    return {k.removesuffix("_s"): round(v * 1e3, 3) for k, v in pct.items()}
+
+
 def time_legs(
     legs: Sequence[Callable[..., Any]],
     args: Sequence[Any],
@@ -194,11 +242,7 @@ def time_legs(
             x = leg(x)
         return x
 
-    out = None
-    for _ in range(max(warmup, 1)):
-        out = run_chain()
-    sync(out)
-    overhead = _measure_sync_overhead(out)
+    _, overhead = _warm(run_chain, warmup)
 
     totals = [0.0] * len(legs)
     for _ in range(iterations):

@@ -23,6 +23,9 @@ _DTYPE_MAP = {
     "float32": jnp.float32,
     "float16": jnp.float16,
     "bfloat16": jnp.bfloat16,
+    # beyond the reference's float trio: the MXU's int8 mode (v5e: 394 TOPS);
+    # offered where a program opts in via build_parser(extra_dtypes=...)
+    "int8": jnp.int8,
 }
 
 
@@ -77,6 +80,7 @@ def build_parser(
     description: str,
     modes: Sequence[str] | None = None,
     default_mode: str | None = None,
+    extra_dtypes: Sequence[str] = (),
 ) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=description)
     p.add_argument(
@@ -92,7 +96,8 @@ def build_parser(
         help="Warmup iterations (absorbs jit compile/autotune; default: 10)",
     )
     p.add_argument(
-        "--dtype", type=str, default="bfloat16", choices=DTYPE_CHOICES,
+        "--dtype", type=str, default="bfloat16",
+        choices=list(DTYPE_CHOICES) + list(extra_dtypes),
         help="Matrix dtype (default: bfloat16)",
     )
     if modes:
@@ -165,6 +170,8 @@ def parse_config(
     description: str,
     modes: Sequence[str] | None = None,
     default_mode: str | None = None,
+    extra_dtypes: Sequence[str] = (),
 ) -> BenchConfig:
-    parser = build_parser(description, modes=modes, default_mode=default_mode)
+    parser = build_parser(description, modes=modes, default_mode=default_mode,
+                          extra_dtypes=extra_dtypes)
     return config_from_args(parser.parse_args(argv))

@@ -1,0 +1,171 @@
+"""int8 MXU mode (ROADMAP item: beyond the reference's float trio).
+
+The reference benchmarks {float32, float16, bfloat16} only
+(`matmul_benchmark.py:164`); the MXU additionally runs int8×int8→int32 at
+2× the bf16 rate (v5e: 394 TOPS). These tests pin the integer contract
+end to end: exact products (integer math has no tolerance), int32
+accumulation/output everywhere, TOPS reporting semantics, and memory
+accounting that counts the int32 C.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_matmul_bench.models.workloads import MatmulWorkload
+from tpu_matmul_bench.ops.matmul import (
+    INT_OPERAND_BOUND,
+    make_bmm,
+    matmul_2d,
+    random_operands,
+)
+from tpu_matmul_bench.ops.pallas_matmul import pallas_matmul
+from tpu_matmul_bench.parallel.modes import (
+    SCALING_MODES,
+    batch_parallel,
+    estimate_memory_gib,
+    independent,
+    matrix_parallel,
+    model_parallel,
+    run_mode_benchmark,
+)
+from tpu_matmul_bench.parallel.overlap import OVERLAP_MODES
+from tpu_matmul_bench.utils.config import parse_config
+from tpu_matmul_bench.utils.metrics import (
+    matmul_out_dtype,
+    theoretical_peak_tflops,
+    throughput_unit,
+)
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord, format_record
+
+SIZE = 64
+
+
+def _cfg(extra=()):
+    return parse_config(
+        ["--sizes", str(SIZE), "--iterations", "2", "--warmup", "1",
+         "--dtype", "int8", *extra],
+        "test",
+        modes=list(SCALING_MODES),
+        extra_dtypes=("int8",),
+    )
+
+
+def _int_operands(size=SIZE, seed=0):
+    a, b = random_operands(seed, (size, size), jnp.int8)
+    return a, b
+
+
+def _want(a, b):
+    return np.asarray(a, dtype=np.int32) @ np.asarray(b, dtype=np.int32)
+
+
+def test_random_operands_int8_bounds_and_coverage():
+    a, b = _int_operands()
+    for x in (a, b):
+        assert x.dtype == jnp.int8
+        xs = np.asarray(x)
+        assert xs.min() >= -INT_OPERAND_BOUND and xs.max() < INT_OPERAND_BOUND
+        # actually random, not degenerate
+        assert len(np.unique(xs)) > 4
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_matmul_out_dtype_contract():
+    assert matmul_out_dtype(jnp.int8) == jnp.int32
+    assert matmul_out_dtype(jnp.bfloat16) == jnp.bfloat16
+    assert throughput_unit(jnp.int8) == "TOPS"
+    assert throughput_unit(jnp.bfloat16) == "TFLOPS"
+
+
+def test_xla_matmul_int8_exact():
+    a, b = _int_operands()
+    c = matmul_2d("xla")(a, b)
+    assert c.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(c), _want(a, b))
+
+
+def test_pallas_matmul_int8_exact():
+    a, b = _int_operands(size=256)
+    c = pallas_matmul(a, b, block_m=128, block_n=128, block_k=128)
+    assert c.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(c), _want(a, b))
+
+
+def test_bmm_int8_exact():
+    a, b = random_operands(1, (3, SIZE, SIZE), jnp.int8)
+    c = make_bmm()(a, b)
+    assert c.dtype == jnp.int32
+    want = np.einsum(
+        "bij,bjk->bik",
+        np.asarray(a, dtype=np.int64),
+        np.asarray(b, dtype=np.int64),
+    )
+    np.testing.assert_array_equal(np.asarray(c, dtype=np.int64), want)
+
+
+@pytest.mark.parametrize("mode_fn", [independent, batch_parallel,
+                                     matrix_parallel, model_parallel])
+def test_sharded_modes_int8_exact(mesh, mode_fn):
+    setup = mode_fn(_cfg(), mesh, SIZE)
+    a, b = setup.operands
+    program = setup.full if setup.full is not None else setup.compute
+    got = np.asarray(program(a, b), dtype=np.int64)
+    an, bn = np.asarray(a, np.int64), np.asarray(b, np.int64)
+    if setup.mode == "independent":
+        want = np.einsum("dij,djk->dik", an, bn)
+    elif setup.mode == "batch_parallel":
+        want = np.broadcast_to(
+            np.einsum("bij,bjk->bik", an, bn).sum(axis=0), got.shape
+        )
+    else:  # matrix_parallel / model_parallel both produce the dense product
+        want = an @ bn
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("variant", ["no_overlap", "overlap",
+                                     "collective_matmul",
+                                     "collective_matmul_rs", "pallas_ring"])
+def test_overlap_suite_int8_runs(mesh, variant):
+    cfg = _cfg()
+    setup = OVERLAP_MODES[variant](cfg, mesh, SIZE)
+    rec = run_mode_benchmark(setup, cfg).finalize()
+    assert rec.dtype == "int8"
+    assert rec.extras.get("throughput_unit") == "TOPS"
+    assert rec.tflops_total > 0
+
+
+def test_collective_matmul_int8_exact(mesh):
+    from tpu_matmul_bench.parallel.overlap import collective_matmul_program
+
+    cfg = _cfg()
+    setup = OVERLAP_MODES["collective_matmul"](cfg, mesh, SIZE)
+    x, w = setup.operands
+    got = np.asarray(collective_matmul_program(mesh, overlap=True)(x, w),
+                     dtype=np.int64)
+    want = np.asarray(x, np.int64) @ np.asarray(w, np.int64)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_int8_memory_counts_int32_output():
+    wl8 = MatmulWorkload(1024, jnp.int8)
+    # A+B at 1 byte each, C at 4 bytes → 6 bytes/element total
+    assert wl8.memory_gib == pytest.approx(6 * 1024 * 1024 / 1024**3)
+    cfg = _cfg()
+    est = estimate_memory_gib("independent", cfg, 8, 1024)
+    assert est == pytest.approx(6 * 1024 * 1024 / 1024**3)
+
+
+def test_int8_peak_and_report_labels():
+    assert theoretical_peak_tflops("TPU v5 lite", jnp.int8) == 394.0
+    assert theoretical_peak_tflops("TPU v4", jnp.int8) is None
+    rec = BenchmarkRecord(
+        benchmark="matmul", mode="single", size=SIZE, dtype="int8", world=1,
+        iterations=2, warmup=1, avg_time_s=1e-3,
+        tflops_per_device=1.0, tflops_total=1.0, device_kind="TPU v5 lite",
+    )
+    text = format_record(rec)
+    assert "TOPS per device" in text and "TFLOPS" not in text
+    assert rec.extras["throughput_unit"] == "TOPS"
+    # efficiency computed against the 394 TOPS int8 row
+    assert rec.peak_efficiency_pct == pytest.approx(100.0 / 394.0)

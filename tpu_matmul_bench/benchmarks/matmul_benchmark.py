@@ -143,7 +143,8 @@ def run(config: BenchConfig) -> list[BenchmarkRecord]:
 
 
 def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
-    config = parse_config(argv, description=__doc__ or "matmul benchmark")
+    config = parse_config(argv, description=__doc__ or "matmul benchmark",
+                          extra_dtypes=("int8",))
     return run(config)
 
 

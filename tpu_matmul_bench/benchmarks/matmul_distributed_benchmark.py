@@ -27,6 +27,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         description=__doc__ or "distributed benchmark",
         modes=list(DISTRIBUTED_MODES),
         default_mode="data_parallel",
+        extra_dtypes=("int8",),
     )
     return run(
         config,

@@ -29,6 +29,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         description=__doc__ or "overlap benchmark",
         modes=list(OVERLAP_MODES),
         default_mode="overlap",
+        extra_dtypes=("int8",),
     )
     return run(
         config,

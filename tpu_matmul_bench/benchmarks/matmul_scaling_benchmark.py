@@ -130,6 +130,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         description=__doc__ or "scaling benchmark",
         modes=list(SCALING_MODES),
         default_mode="independent",  # ≙ reference :360-362
+        extra_dtypes=("int8",),
     )
     return run(config)
 

@@ -13,7 +13,11 @@ from typing import Any
 import jax
 
 from tpu_matmul_bench.ops.matmul import random_operands
-from tpu_matmul_bench.utils.metrics import matmul_flops, matrix_memory_gib
+from tpu_matmul_bench.utils.metrics import (
+    matmul_flops,
+    matmul_out_dtype,
+    matrix_memory_gib,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -30,8 +34,9 @@ class MatmulWorkload:
 
     @property
     def memory_gib(self) -> float:
-        # A, B and the produced C
-        return matrix_memory_gib(self.size, self.dtype, count=3)
+        # A, B and the produced C (int8 operands produce an int32 C)
+        return matrix_memory_gib(self.size, self.dtype, count=2) + \
+            matrix_memory_gib(self.size, matmul_out_dtype(self.dtype))
 
     def operands(self, seed_offset: int = 0) -> tuple[jax.Array, jax.Array]:
         a, b = random_operands(

@@ -15,6 +15,17 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from tpu_matmul_bench.utils.metrics import is_integer_dtype, matmul_out_dtype
+
+
+def _dot(a: jax.Array, b: jax.Array) -> jax.Array:
+    """jnp.dot with the dtype contract of `matmul_out_dtype`: floats keep
+    their dtype (fp32 MXU accumulation, downcast on store); int8 runs the
+    MXU's integer mode with an int32 result."""
+    if is_integer_dtype(a.dtype):
+        return jnp.dot(a, b, preferred_element_type=jnp.int32)
+    return jnp.dot(a, b)
+
 
 def make_matmul(
     impl: str = "xla", blocks: tuple[int, int, int] | None = None
@@ -41,7 +52,7 @@ def matmul_2d(
                                           block_k=bk)
     if impl != "xla":
         raise ValueError(f"unknown matmul impl {impl!r}")
-    return lambda a, b: jnp.dot(a, b)
+    return _dot
 
 
 def make_bmm() -> Callable[[jax.Array, jax.Array], jax.Array]:
@@ -49,23 +60,42 @@ def make_bmm() -> Callable[[jax.Array, jax.Array], jax.Array]:
 
     @jax.jit
     def bmm(a: jax.Array, b: jax.Array) -> jax.Array:
+        if is_integer_dtype(a.dtype):
+            return jnp.einsum("bij,bjk->bik", a, b,
+                              preferred_element_type=jnp.int32)
         return jnp.einsum("bij,bjk->bik", a, b)
 
     return bmm
 
 
-@partial(jax.jit, static_argnames=("shape", "dtype"))
-def _normal(key: jax.Array, shape: tuple[int, ...], dtype: Any) -> jax.Array:
+# Integer operands draw uniformly from [-INT_OPERAND_BOUND, INT_OPERAND_BOUND).
+# Small magnitudes keep int32 accumulation exact at any benchmark size
+# (|sum| ≤ 64·16384 ≪ 2³¹) while still exercising the full int8 MXU rate.
+INT_OPERAND_BOUND = 8
+
+
+def random_array(key: jax.Array, shape: tuple[int, ...], dtype: Any) -> jax.Array:
+    """Standard-normal for float dtypes ≙ `torch.randn` (reference
+    `matmul_benchmark.py:41-42`); small uniform integers for int dtypes."""
+    if is_integer_dtype(dtype):
+        return jax.random.randint(
+            key, shape, -INT_OPERAND_BOUND, INT_OPERAND_BOUND, dtype=dtype
+        )
     return jax.random.normal(key, shape, dtype=dtype)
+
+
+@partial(jax.jit, static_argnames=("shape", "dtype"))
+def _random(key: jax.Array, shape: tuple[int, ...], dtype: Any) -> jax.Array:
+    return random_array(key, shape, dtype)
 
 
 def random_operands(
     seed: int, shape: tuple[int, ...], dtype: Any, *, count: int = 2
 ) -> tuple[jax.Array, ...]:
-    """Standard-normal operands ≙ `torch.randn` (reference
-    `matmul_benchmark.py:41-42`). Distinct keys per operand; callers that need
-    per-device distinct data fold the device index into the seed, the
+    """Random operands ≙ `torch.randn` (reference `matmul_benchmark.py:41-42`;
+    integers for the int8 MXU mode). Distinct keys per operand; callers that
+    need per-device distinct data fold the device index into the seed, the
     JAX-native analogue of `torch.manual_seed(rank)`
     (`matmul_scaling_benchmark.py:73`)."""
     keys = jax.random.split(jax.random.key(seed), count)
-    return tuple(_normal(k, shape, jnp.dtype(dtype)) for k in keys)
+    return tuple(_random(k, shape, jnp.dtype(dtype)) for k in keys)

@@ -26,14 +26,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_matmul_bench.utils.metrics import matmul_out_dtype
+
 
 def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref):
     @pl.when(pl.program_id(2) == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    # accumulator dtype (f32 for floats, i32 for the int8 MXU mode) is fixed
+    # by the scratch allocation below
     acc_ref[:] += jnp.dot(
-        a_ref[:], b_ref[:], preferred_element_type=jnp.float32
+        a_ref[:], b_ref[:], preferred_element_type=acc_ref.dtype
     )
 
     @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
@@ -106,7 +110,8 @@ def pallas_matmul(
     bm = _pick_block(m, block_m)
     bn = _pick_block(n, block_n)
     bk = _pick_block(k, block_k)
-    out_dtype = jnp.promote_types(a.dtype, b.dtype)
+    out_dtype = matmul_out_dtype(jnp.promote_types(a.dtype, b.dtype))
+    acc_dtype = jnp.int32 if jnp.issubdtype(out_dtype, jnp.integer) else jnp.float32
 
     grid = (m // bm, n // bn, k // bk)
     return pl.pallas_call(
@@ -118,7 +123,7 @@ def pallas_matmul(
             pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bm, bn), acc_dtype)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),

@@ -31,6 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from tpu_matmul_bench.parallel.mesh import smap
+from tpu_matmul_bench.utils.metrics import matmul_out_dtype
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -89,8 +90,10 @@ def _ring_kernel(d: int, axis: str, use_barrier: bool, x_ref, w_ref, o_ref,
 
         # chunk resident at step t originated at device (my - t) mod d
         src = jax.lax.rem(my + d - t, d) if t else my
+        acc_dtype = (jnp.int32 if jnp.issubdtype(o_ref.dtype, jnp.integer)
+                     else jnp.float32)
         block = jnp.dot(comm_buf[cur], w_ref[:],
-                        preferred_element_type=jnp.float32)
+                        preferred_element_type=acc_dtype)
         o_ref[pl.ds(src * mshard, mshard), :] = block.astype(o_ref.dtype)
 
         if t <= d - 3 and use_barrier:
@@ -124,7 +127,8 @@ def ring_allgather_matmul(mesh: Mesh, axis: str = "x",
         kernel = functools.partial(_ring_kernel, d, axis, not interpret)
         return pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((m, nshard), x_local.dtype),
+            out_shape=jax.ShapeDtypeStruct(
+                (m, nshard), matmul_out_dtype(x_local.dtype)),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.VMEM),
                 pl.BlockSpec(memory_space=pltpu.VMEM),

@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_matmul_bench.ops.matmul import random_array
+
 
 def make_mesh(
     devices: Sequence[jax.Device] | None = None,
@@ -52,8 +54,8 @@ def sharded_normal(
     *,
     count: int = 2,
 ) -> tuple[jax.Array, ...]:
-    """Generate `count` standard-normal arrays directly with the given
-    sharding — each device materializes only its shard (no host-side global
+    """Generate `count` random arrays (standard-normal; small uniform ints
+    for integer dtypes) directly with the given sharding — each device materializes only its shard (no host-side global
     array, no transfer), the JAX-native analogue of every rank calling
     `torch.randn(..., device=rank)` (reference `matmul_scaling_benchmark.py:
     73-75`). Distinct shards get distinct values by construction since the
@@ -62,7 +64,7 @@ def sharded_normal(
 
     @partial(jax.jit, static_argnums=(1, 2), out_shardings=sharding)
     def gen(key: jax.Array, shape: tuple[int, ...], dtype: Any) -> jax.Array:
-        return jax.random.normal(key, shape, dtype=dtype)
+        return random_array(key, shape, dtype)
 
     keys = jax.random.split(jax.random.key(seed), count)
     return tuple(gen(k, tuple(shape), jnp.dtype(dtype)) for k in keys)

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpu_matmul_bench.ops.matmul import matmul_2d
 from tpu_matmul_bench.parallel.mesh import sharded_normal, smap as _smap, world_size
 from tpu_matmul_bench.utils.config import BenchConfig
-from tpu_matmul_bench.utils.metrics import calculate_tflops
+from tpu_matmul_bench.utils.metrics import calculate_tflops, matmul_out_dtype
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
 from tpu_matmul_bench.utils.timing import (
     Timing,
@@ -87,23 +87,29 @@ def estimate_memory_gib(
     program's buffers (the all_gather / psum output is a complete matrix on
     every device)."""
     d = world
+    out_dtype = matmul_out_dtype(config.dtype)  # int8 products are int32
+
+    def gib(in_count: float, out_count: float) -> float:
+        return _gib(size, config.dtype, in_count) + _gib(size, out_dtype, out_count)
+
     if mode == "hybrid":
-        # x shard (lb) + gathered output (lb) + compute output (lb/tp)
-        # + w shard (1/tp) + psum result (1)
+        # operands: x shard (lb) + w shard (1/tp); products: gathered output
+        # (lb) + compute output (lb/tp) + psum result (1)
         tp = d // (dp or 1)
         lb = max(batch // (dp or 1), 1)
-        return _gib(size, config.dtype, lb * (2 + 1.0 / tp) + 1.0 / tp + 1)
+        return gib(lb + 1.0 / tp, lb + lb / tp + 1)
     if mode == "batch_parallel":
-        return _gib(size, config.dtype, 3 * max(batch // d, 1))
+        lb = max(batch // d, 1)
+        return gib(2 * lb, lb)
     if mode in ("matrix_parallel", "model_parallel", "collective_matmul") and d > 1:
         # sharded operands (2/d) + full-size combined C + one temp
-        return _gib(size, config.dtype, 2 + 2.0 / d)
+        return gib(2.0 / d, 2)
     if mode in ("no_overlap", "overlap", "pipeline"):
         # nbuf A/B pairs + in-flight product ring + reduce temp
         nbuf = {"no_overlap": 1, "overlap": 2, "pipeline": 3}[mode]
-        return _gib(size, config.dtype, 3 * nbuf + 2)
+        return gib(2 * nbuf, nbuf + 2)
     # independent / data_parallel / world-1 fallbacks: full A, B, C per device
-    return _gib(size, config.dtype, 3)
+    return gib(2, 1)
 
 
 # ---------------------------------------------------------------------------

@@ -57,7 +57,7 @@ from tpu_matmul_bench.parallel.mesh import (
 )
 from tpu_matmul_bench.parallel.modes import ModeSetup, estimate_memory_gib
 from tpu_matmul_bench.utils.config import BenchConfig
-from tpu_matmul_bench.utils.metrics import calculate_tflops
+from tpu_matmul_bench.utils.metrics import calculate_tflops, matmul_out_dtype
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
 from tpu_matmul_bench.utils.timing import Timing
 
@@ -83,7 +83,9 @@ def _steps_program(mesh: Mesh, variant: str, steps: int, impl: str = "xla",
             def step(a_cur, i):
                 c = mm(a_cur[0], b[0])
                 # next step's input depends on this product → steps ordered
-                a_dep = jax.lax.optimization_barrier(a_cur + 0 * c[0, 0])
+                # (cast keeps the carry dtype stable when C is int32)
+                dep = (0 * c[0, 0]).astype(a_cur.dtype)
+                a_dep = jax.lax.optimization_barrier(a_cur + dep)
                 return a_dep, c[0, 0]
 
             _, outs = jax.lax.scan(step, a, jnp.arange(steps))
@@ -99,7 +101,8 @@ def _steps_program(mesh: Mesh, variant: str, steps: int, impl: str = "xla",
                 c = jax.lax.optimization_barrier(c)
                 r = jax.lax.psum(c, "x")  # ≙ all_reduce + sync (:56-68)
                 # next matmul's input depends on r → full serialization
-                a_dep = jax.lax.optimization_barrier(a_cur + 0 * r[0, 0])
+                dep = (0 * r[0, 0]).astype(a_cur.dtype)
+                a_dep = jax.lax.optimization_barrier(a_cur + dep)
                 return a_dep, r[0, 0]
 
             _, outs = jax.lax.scan(step, a, jnp.arange(steps))
@@ -235,7 +238,8 @@ def collective_matmul_program(mesh: Mesh, overlap: bool = True,
 
         my = jax.lax.axis_index("x")
         m = mshard * d
-        y = jnp.zeros((m, w_local.shape[1]), dtype=x_local.dtype)
+        y = jnp.zeros((m, w_local.shape[1]),
+                      dtype=matmul_out_dtype(x_local.dtype))
         x_cur = x_local
         for t in range(d):
             # chunk held at step t originated at device (my - t) mod d
@@ -341,7 +345,8 @@ def collective_matmul_rs_program(mesh: Mesh, overlap: bool = True,
                                         tiled=True)
 
         my = jax.lax.axis_index("x")
-        acc = jnp.zeros((mshard, w_local.shape[1]), dtype=x_local.dtype)
+        acc = jnp.zeros((mshard, w_local.shape[1]),
+                        dtype=matmul_out_dtype(x_local.dtype))
         for t in range(d):
             # accumulator resident here at step t belongs to row chunk c
             c = jax.lax.rem(my - 1 - t + 2 * d, d)
@@ -371,11 +376,13 @@ def collective_matmul_rs_mode(config: BenchConfig, mesh: Mesh, size: int,
 
 def pallas_ring_max_size(world: int, dtype) -> int:
     """Largest lane-aligned size whose pallas_ring VMEM footprint fits the
-    ~14 MiB/core budget: x shard + 2 ring buffers + w shard + y shard
-    ≈ 5·size²/world elements."""
+    ~14 MiB/core budget: x shard + 2 ring buffers + w shard (operand dtype)
+    + y shard (output dtype — int32 for int8 operands), each size²/world
+    elements."""
     item = jnp.dtype(dtype).itemsize
+    out_item = jnp.dtype(matmul_out_dtype(dtype)).itemsize
     budget = 14 * 1024 * 1024
-    s = int((budget * world / (5 * item)) ** 0.5)
+    s = int((budget * world / (4 * item + out_item)) ** 0.5)
     step = 128 * world  # keep shards lane-aligned and divisible by world
     return max((s // step) * step, step)
 

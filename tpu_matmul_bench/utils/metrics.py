@@ -41,6 +41,28 @@ def bytes_per_element(dtype: Any) -> int:
     return jnp.dtype(dtype).itemsize
 
 
+def is_integer_dtype(dtype: Any) -> bool:
+    """True for the MXU's integer mode (int8) — beyond the reference's float
+    trio (`matmul_benchmark.py:164`)."""
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def matmul_out_dtype(dtype: Any) -> Any:
+    """Output dtype of C = A·B for operand dtype: floats keep their dtype
+    (the accumulate-high/store-low contract, like cuBLAS bf16); integer
+    inputs accumulate and store int32 — downcasting sums of products back to
+    int8 would overflow, so int8 matmul is int8×int8→int32, the MXU's native
+    integer contract."""
+    d = jnp.dtype(dtype)
+    return jnp.dtype(jnp.int32) if jnp.issubdtype(d, jnp.integer) else d
+
+
+def throughput_unit(dtype: Any) -> str:
+    """'TFLOPS' for float dtypes, 'TOPS' for integer — same 2n³ operation
+    count, different name (int8 MACs are not floating-point ops)."""
+    return "TOPS" if is_integer_dtype(dtype) else "TFLOPS"
+
+
 def matrix_memory_gib(size: int, dtype: Any, count: int = 1) -> float:
     """Memory of `count` size×size matrices in GiB ≙ `matmul_benchmark.py:99-103`."""
     return count * size * size * bytes_per_element(dtype) / (1024**3)
@@ -54,12 +76,17 @@ def matrix_memory_gib(size: int, dtype: Any, count: int = 1) -> float:
 # constants the reference hardcodes (`matmul_benchmark.py:133-139`) so runs on
 # those GPUs report identical efficiency percentages.
 _PEAKS: dict[str, dict[str, float | None]] = {
-    # key: lowercase substring of jax Device.device_kind
-    "v6 lite": {"bfloat16": 918.0, "float16": 918.0, "float32": None},
-    "v6e": {"bfloat16": 918.0, "float16": 918.0, "float32": None},
-    "v5p": {"bfloat16": 459.0, "float16": 459.0, "float32": None},
-    "v5 lite": {"bfloat16": 197.0, "float16": 197.0, "float32": None},
-    "v5e": {"bfloat16": 197.0, "float16": 197.0, "float32": None},
+    # key: lowercase substring of jax Device.device_kind. int8 rows are TOPS
+    # (the MXU's 2×-rate integer mode); chips without a published int8 spec
+    # carry no row and report no efficiency %.
+    "v6 lite": {"bfloat16": 918.0, "float16": 918.0, "float32": None,
+                "int8": 1836.0},
+    "v6e": {"bfloat16": 918.0, "float16": 918.0, "float32": None,
+            "int8": 1836.0},
+    "v5p": {"bfloat16": 459.0, "float16": 459.0, "float32": None, "int8": 918.0},
+    "v5 lite": {"bfloat16": 197.0, "float16": 197.0, "float32": None,
+                "int8": 394.0},
+    "v5e": {"bfloat16": 197.0, "float16": 197.0, "float32": None, "int8": 394.0},
     "v4": {"bfloat16": 275.0, "float16": 275.0, "float32": None},
     "v3": {"bfloat16": 123.0, "float16": 123.0, "float32": None},
     "v2": {"bfloat16": 45.0, "float16": 45.0, "float32": None},
@@ -124,7 +151,8 @@ def matmul_roofline_s(
     if not peak or not bw:
         return None
     t_flops = matmul_flops(size) / (peak * 1e12)
-    t_hbm = 3 * size * size * bytes_per_element(dtype) / (bw * 1e9)
+    c_bytes = bytes_per_element(matmul_out_dtype(dtype))  # int8 writes int32 C
+    t_hbm = size * size * (2 * bytes_per_element(dtype) + c_bytes) / (bw * 1e9)
     return t_flops, t_hbm
 
 

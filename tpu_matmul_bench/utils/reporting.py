@@ -19,10 +19,12 @@ import jax
 
 from tpu_matmul_bench.utils.metrics import (
     matmul_flops,
+    matmul_out_dtype,
     matmul_roofline_s,
     matrix_memory_gib,
     scaling_efficiency,
     theoretical_peak_tflops,
+    throughput_unit,
 )
 
 
@@ -72,6 +74,9 @@ class BenchmarkRecord:
             self.comm_overhead_pct = (
                 100.0 * self.comm_time_s / (self.compute_time_s + self.comm_time_s)
             )
+        if throughput_unit(self.dtype) != "TFLOPS":
+            # flag integer records so JSON consumers read tflops_* as TOPS
+            self.extras.setdefault("throughput_unit", throughput_unit(self.dtype))
         if self.peak_efficiency_pct is None and self.device_kind:
             peak = theoretical_peak_tflops(self.device_kind, self.dtype)
             if peak:
@@ -115,12 +120,14 @@ def header(title: str, config: dict[str, Any]) -> str:
 
 
 def size_preamble(size: int, dtype: str) -> str:
-    """Per-size memory preamble ≙ reference `matmul_benchmark.py:99-103`."""
+    """Per-size memory preamble ≙ reference `matmul_benchmark.py:99-103`.
+    C is counted at its own dtype (int8 operands produce an int32 C)."""
     per = matrix_memory_gib(size, dtype)
+    c = matrix_memory_gib(size, matmul_out_dtype(dtype))
     return (
         f"\nBenchmarking {size}x{size} matrix multiplication:\n"
         f"  - Memory per matrix: {per:.2f} GiB ({dtype})\n"
-        f"  - Total memory for A, B, C: {3 * per:.2f} GiB"
+        f"  - Total memory for A, B, C: {2 * per + c:.2f} GiB"
     )
 
 
@@ -132,10 +139,15 @@ def format_record(rec: BenchmarkRecord) -> str:
         f"  - Average time per operation: {rec.avg_time_s * 1e3:.3f} ms",
     ]
     if rec.algbw_gbps is None:  # FLOP benchmark; collectives do no matmul
+        unit = throughput_unit(rec.dtype)  # TFLOPS, or TOPS for int8
+        ops_name, ops_unit = (
+            ("FLOPs", "TFLOPs") if unit == "TFLOPS" else ("ops", "Tops")
+        )
         lines += [
-            f"  - TFLOPS per device: {rec.tflops_per_device:.2f}",
-            f"  - Total TFLOPS ({rec.world} device(s)): {rec.tflops_total:.2f}",
-            f"  - FLOPs per operation: {matmul_flops(rec.size) / 1e12:.2f} TFLOPs",
+            f"  - {unit} per device: {rec.tflops_per_device:.2f}",
+            f"  - Total {unit} ({rec.world} device(s)): {rec.tflops_total:.2f}",
+            f"  - {ops_name} per operation: "
+            f"{matmul_flops(rec.size) / 1e12:.2f} {ops_unit}",
         ]
     if rec.algbw_gbps is not None:
         bus = f", bus {rec.busbw_gbps:.2f} GB/s" if rec.busbw_gbps is not None else ""

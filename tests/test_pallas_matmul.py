@@ -51,3 +51,24 @@ def test_bad_shapes():
     a = jnp.ones((4, 8))
     with pytest.raises(ValueError):
         pallas_matmul(a, jnp.ones((4, 8)))
+
+
+def test_tuned_blocks_table():
+    from tpu_matmul_bench.ops.pallas_matmul import tuned_blocks
+
+    # measured winners on the v5e chip (tune CLI, RESULTS_TPU.md)
+    assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite") == (512, 2048, 512)
+    assert tuned_blocks(8192, 8192, 8192, "TPU v5 lite") == (1024, 1024, 512)
+    assert tuned_blocks(4096, 4096, 4096, "TPU v5 lite") == (512, 2048, 512)
+    # between tuned rows: the largest row ≤ min dim applies
+    assert tuned_blocks(12288, 12288, 12288, "TPU v5 lite") == (1024, 1024, 512)
+    # unknown chip / interpreter and sub-table sizes fall back to the baseline
+    assert tuned_blocks(16384, 16384, 16384, "cpu") == (512, 512, 512)
+    assert tuned_blocks(512, 512, 512, "TPU v5 lite") == (512, 512, 512)
+    # the table was measured at 2-byte operands; 4-byte tiles would blow VMEM
+    import jax.numpy as jnp
+
+    assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
+                        jnp.float32) == (512, 512, 512)
+    assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
+                        jnp.int8) == (512, 2048, 512)

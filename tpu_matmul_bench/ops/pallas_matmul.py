@@ -20,13 +20,14 @@ Design (per the Pallas TPU playbook):
 from __future__ import annotations
 
 import functools
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpu_matmul_bench.utils.metrics import matmul_out_dtype
+from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
 
 
 def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref):
@@ -46,6 +47,48 @@ def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref):
 
 
 DEFAULT_BLOCK = 512  # the kernel's baseline (bm, bn, bk); see module docstring
+
+# Per-device-kind tuned blockings, measured on real hardware with the `tune`
+# CLI (10 timed iterations per candidate; winners recorded in RESULTS_TPU.md).
+# Keyed by lowercase substring of jax Device.device_kind; rows are
+# (min problem dim, (bm, bn, bk)) — the largest row ≤ min(m, n, k) applies.
+# Larger-N blocks win on v5e (fewer accumulator spills per output tile);
+# ≥2 MB-tile configs like (1024, 2048, 512) exceed VMEM and fail to compile.
+_TUNED_BLOCKS: dict[str, list[tuple[int, tuple[int, int, int]]]] = {
+    "v5 lite": [
+        (4096, (512, 2048, 512)),
+        (8192, (1024, 1024, 512)),
+        (16384, (512, 2048, 512)),
+    ],
+    "v5e": [
+        (4096, (512, 2048, 512)),
+        (8192, (1024, 1024, 512)),
+        (16384, (512, 2048, 512)),
+    ],
+}
+
+
+def tuned_blocks(
+    m: int, n: int, k: int, device_kind: str, dtype: Any = jnp.bfloat16
+) -> tuple[int, int, int]:
+    """The measured-best (bm, bn, bk) for this problem on this chip, falling
+    back to the 512³ baseline for unknown chips (including the CPU
+    interpreter), problems smaller than any tuned row, or operands wider
+    than the 2 bytes the table was measured at — a (512, 2048) float32 tile
+    set exceeds the VMEM budget that already kills the 2 MB bf16 configs."""
+    if jnp.dtype(dtype).itemsize > 2:
+        return (DEFAULT_BLOCK, DEFAULT_BLOCK, DEFAULT_BLOCK)
+    kind = device_kind.lower()
+    for key, rows in _TUNED_BLOCKS.items():
+        if key in kind:
+            dim = min(m, n, k)
+            best: tuple[int, int, int] | None = None
+            for min_dim, blocks in sorted(rows):
+                if dim >= min_dim:
+                    best = blocks
+            if best is not None:
+                return best
+    return (DEFAULT_BLOCK, DEFAULT_BLOCK, DEFAULT_BLOCK)
 
 
 def _pick_block(dim: int, preferred: int) -> int:
@@ -72,13 +115,15 @@ def pallas_matmul(
     a: jax.Array,
     b: jax.Array,
     *,
-    block_m: int = DEFAULT_BLOCK,
-    block_n: int = DEFAULT_BLOCK,
-    block_k: int = DEFAULT_BLOCK,
+    block_m: int | None = None,
+    block_n: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """C = A @ B with a blocked Pallas kernel.
 
+    Block sizes default to the per-device tuned table (`tuned_blocks`);
+    pass explicit values (the --block-m/n/k flags) to override.
     `interpret=None` auto-selects interpreter mode off-TPU so the kernel is
     testable on the virtual CPU mesh (SURVEY §4 testing strategy).
     """
@@ -88,6 +133,10 @@ def pallas_matmul(
     _, n = b.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_m is None or block_n is None or block_k is None:
+        kind = "" if interpret else jax.devices()[0].device_kind
+        tm, tn, tk = tuned_blocks(m, n, k, kind, a.dtype)
+        block_m, block_n, block_k = block_m or tm, block_n or tn, block_k or tk
 
     # Pad awkward (e.g. prime) dims up to a 128 multiple so a hardware-aligned
     # block always divides; zero padding does not change the product block.
@@ -111,7 +160,7 @@ def pallas_matmul(
     bn = _pick_block(n, block_n)
     bk = _pick_block(k, block_k)
     out_dtype = matmul_out_dtype(jnp.promote_types(a.dtype, b.dtype))
-    acc_dtype = jnp.int32 if jnp.issubdtype(out_dtype, jnp.integer) else jnp.float32
+    acc_dtype = matmul_acc_dtype(out_dtype)
 
     grid = (m // bm, n // bn, k // bk)
     return pl.pallas_call(

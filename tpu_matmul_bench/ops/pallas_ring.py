@@ -31,7 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from tpu_matmul_bench.parallel.mesh import smap
-from tpu_matmul_bench.utils.metrics import matmul_out_dtype
+from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -90,10 +90,8 @@ def _ring_kernel(d: int, axis: str, use_barrier: bool, x_ref, w_ref, o_ref,
 
         # chunk resident at step t originated at device (my - t) mod d
         src = jax.lax.rem(my + d - t, d) if t else my
-        acc_dtype = (jnp.int32 if jnp.issubdtype(o_ref.dtype, jnp.integer)
-                     else jnp.float32)
         block = jnp.dot(comm_buf[cur], w_ref[:],
-                        preferred_element_type=acc_dtype)
+                        preferred_element_type=matmul_acc_dtype(o_ref.dtype))
         o_ref[pl.ds(src * mshard, mshard), :] = block.astype(o_ref.dtype)
 
         if t <= d - 3 and use_barrier:

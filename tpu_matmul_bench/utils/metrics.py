@@ -57,6 +57,15 @@ def matmul_out_dtype(dtype: Any) -> Any:
     return jnp.dtype(jnp.int32) if jnp.issubdtype(d, jnp.integer) else d
 
 
+def matmul_acc_dtype(dtype: Any) -> Any:
+    """Accumulator dtype for a matmul over `dtype` operands: int32 for the
+    MXU's integer mode, fp32 otherwise — the single rule both Pallas kernels
+    allocate their scratch with."""
+    d = jnp.dtype(dtype)
+    return jnp.dtype(jnp.int32) if jnp.issubdtype(d, jnp.integer) \
+        else jnp.dtype(jnp.float32)
+
+
 def throughput_unit(dtype: Any) -> str:
     """'TFLOPS' for float dtypes, 'TOPS' for integer — same 2n³ operation
     count, different name (int8 MACs are not floating-point ops)."""

@@ -1,0 +1,108 @@
+"""HBM-blocked Pallas ring all-gather matmul (`ops/pallas_ring_hbm.py`):
+ring + blocked-addressing semantics exercised in interpreter mode on the
+8-device CPU mesh. The VMEM variant's tests (`test_pallas_ring.py`) cover
+the shared flow-control design; these pin what the HBM variant adds — the
+nested blocked matmul over the rotating HBM buffer, output row placement
+through dynamically-sliced refs, and freedom from the VMEM size cap."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+from tpu_matmul_bench.ops.pallas_ring_hbm import ring_allgather_matmul_hbm
+from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
+from tpu_matmul_bench.parallel.modes import run_mode_benchmark
+from tpu_matmul_bench.parallel.overlap import OVERLAP_MODES, pallas_ring_max_size
+from tpu_matmul_bench.utils.config import parse_config
+
+
+@pytest.mark.parametrize("m,k,n,blocks", [
+    (64, 32, 64, (8, 8, 8)),        # several blocks per chunk in every dim
+    (128, 128, 128, (16, 64, 32)),  # uneven blocking, m/d=16 rows per chunk
+])
+def test_matches_dense(mesh, m, k, n, blocks):
+    (x,) = sharded_normal(0, (m, k), jnp.float32, mesh, P("x", None), count=1)
+    (w,) = sharded_normal(1, (k, n), jnp.float32, mesh, P(None, "x"), count=1)
+    bm, bn, bk = blocks
+    fn = ring_allgather_matmul_hbm(mesh, block_m=bm, block_n=bn, block_k=bk)
+    got = np.asarray(fn(x, w))
+    want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_chunk_placement(mesh):
+    # distinct per-device X chunks + identity W → output rows must land in
+    # origin order through the dynamically-sliced o_ref writes
+    d = 8
+    m, k = 64, 64
+    x = jnp.repeat(jnp.arange(d, dtype=jnp.float32), m // d)[:, None] * jnp.ones((1, k))
+    w = jnp.eye(k, dtype=jnp.float32)
+    fn = ring_allgather_matmul_hbm(mesh, block_m=8, block_n=32, block_k=16)
+    got = np.asarray(fn(x, w))
+    np.testing.assert_allclose(got, np.asarray(x), rtol=1e-5, atol=1e-5)
+
+
+def test_int8_exact(mesh):
+    size = 64
+    xi = jnp.arange(size * size, dtype=jnp.int32).reshape(size, size) % 13 - 6
+    wi = (jnp.arange(size * size, dtype=jnp.int32).reshape(size, size) % 7 - 3)
+    xi, wi = xi.astype(jnp.int8), wi.astype(jnp.int8)
+    y = ring_allgather_matmul_hbm(mesh, block_m=8, block_n=8, block_k=8)(xi, wi)
+    assert y.dtype == jnp.int32
+    np.testing.assert_array_equal(
+        np.asarray(y), np.asarray(xi, np.int32) @ np.asarray(wi, np.int32))
+
+
+def test_no_vmem_size_cap(mesh):
+    # a size past the VMEM-resident kernel's residency bound must be
+    # accepted by the HBM mode's setup (programs built, operands sharded;
+    # actually *running* `big` on the interpreter would take hours, and the
+    # timed run is covered at small sizes by test_mode_runs_and_reports)
+    d = 8
+    big = pallas_ring_max_size(d, jnp.bfloat16) * 2
+    assert big % d == 0
+    cfg = parse_config(
+        ["--sizes", str(big), "--iterations", "1", "--warmup", "0"],
+        "t", modes=list(OVERLAP_MODES))
+    assert big > pallas_ring_max_size(d, cfg.dtype)  # past the VMEM cap
+    setup = OVERLAP_MODES["pallas_ring_hbm"](cfg, mesh, big)
+    assert setup.full is not None
+    assert setup.operands[0].shape == (big, big)
+
+
+def test_mode_runs_and_reports(mesh):
+    cfg = parse_config(
+        ["--sizes", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32"],
+        "t", modes=list(OVERLAP_MODES))
+    setup = OVERLAP_MODES["pallas_ring_hbm"](cfg, mesh, 64)
+    rec = run_mode_benchmark(setup, cfg).finalize()
+    assert rec.mode == "pallas_ring_hbm"
+    assert rec.tflops_total > 0
+    assert rec.extras["kernel"].startswith("pallas HBM ring")
+    assert "overlap_speedup_x" in rec.extras
+
+
+def test_mode_block_overrides(mesh):
+    cfg = parse_config(
+        ["--sizes", "64", "--iterations", "1", "--warmup", "0",
+         "--dtype", "float32", "--block-m", "8", "--block-n", "8",
+         "--block-k", "8"],
+        "t", modes=list(OVERLAP_MODES))
+    setup = OVERLAP_MODES["pallas_ring_hbm"](cfg, mesh, 64)
+    x, w = setup.operands
+    got = np.asarray(setup.full(x, w))
+    want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_four_device_submesh(devices):
+    mesh4 = make_mesh(devices[:4])
+    (x,) = sharded_normal(0, (64, 64), jnp.float32, mesh4, P("x", None), count=1)
+    (w,) = sharded_normal(1, (64, 64), jnp.float32, mesh4, P(None, "x"), count=1)
+    got = np.asarray(ring_allgather_matmul_hbm(
+        mesh4, block_m=16, block_n=16, block_k=16)(x, w))
+    want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,210 @@
+"""HBM-blocked Pallas ring all-gather matmul — in-kernel RDMA at any size.
+
+`ops/pallas_ring.py` keeps every operand VMEM-resident, which caps the
+per-device problem at ~3k (v5e-8 bf16). This variant lifts the cap: operands
+and the rotating comm buffer live in HBM (`pl.ANY`), and each ring step runs
+a nested `emit_pipeline` that streams (bm, bk)/(bk, bn) tiles of the resident
+X chunk and W into VMEM around the MXU — the same blocked matmul as
+`ops/pallas_matmul.py` (the inner body IS `_matmul_kernel`) — while
+`make_async_remote_copy` streams the whole chunk to the right neighbor over
+ICI. The inter-chip transfer of chunk t+1 hides behind the O(mshard·k·n/D)
+MXU work on chunk t, exactly the latency-hiding the reference approximates
+with CUDA streams (`backup/matmul_overlap_benchmark.py:124-157`), but
+expressed as one kernel with explicit DMA scheduling at full HBM capacity.
+
+Same contract as `ring_allgather_matmul`: Y = X·W, X row-sharded
+P(axis, None), W column-sharded P(None, axis), Y out P(None, axis).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_matmul_bench.ops.pallas_matmul import (
+    _matmul_kernel,
+    effective_blocks,
+)
+from tpu_matmul_bench.parallel.mesh import smap
+from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
+                     blocks: tuple[int, int, int],
+                     x_hbm, w_hbm, o_hbm, comm_buf,
+                     seed_sem, send_sem, recv_sem, free_sem,
+                     acc_ref):
+    """One device's program: ring-rotate HBM-resident X chunks; per step, a
+    nested VMEM pipeline multiplies the resident chunk into its Y row block.
+
+    Ring flow control is identical to `pallas_ring._ring_kernel` (2 comm
+    slots, ack-your-writer `free_sem` handshake, balanced counts); see that
+    docstring for the WAR-hazard argument.
+    """
+    mshard, k = x_hbm.shape
+    nshard = w_hbm.shape[1]
+    bm, bn, bk = blocks
+    my = jax.lax.axis_index(axis)
+    right = jax.lax.rem(my + 1, d)
+    left = jax.lax.rem(my + d - 1, d)
+
+    if use_barrier:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    # own chunk seeds slot 0 (HBM→HBM local DMA)
+    seed = pltpu.make_async_copy(x_hbm, comm_buf.at[0], seed_sem)
+    seed.start()
+    seed.wait()
+
+    if use_barrier:  # compiled TPU: the nested VMEM pipeline
+        # the blocked matmul over one resident chunk: grid (M, N, K), K
+        # innermost; body is the SAME kernel as ops/pallas_matmul.py, its
+        # accumulator passed through `scratches`
+        pipeline = pltpu.emit_pipeline(
+            _matmul_kernel,
+            grid=(mshard // bm, nshard // bn, k // bk),
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+                pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        )
+
+        def chunk_matmul(cur, o_rows):
+            pipeline(comm_buf.at[cur], w_hbm, o_rows, scratches=(acc_ref,))
+    else:
+        # interpreter path (emit_pipeline requires real TPU device info):
+        # the same blocked accumulation, addressed directly — validates the
+        # ring/addressing semantics the CPU-mesh tests check
+        acc_dtype = matmul_acc_dtype(o_hbm.dtype)
+
+        def chunk_matmul(cur, o_rows):
+            for i in range(mshard // bm):
+                for j in range(nshard // bn):
+                    acc = jnp.zeros((bm, bn), acc_dtype)
+                    for kk in range(k // bk):
+                        acc += jnp.dot(
+                            comm_buf[cur, i * bm:(i + 1) * bm,
+                                     kk * bk:(kk + 1) * bk],
+                            w_hbm[kk * bk:(kk + 1) * bk,
+                                  j * bn:(j + 1) * bn],
+                            preferred_element_type=acc_dtype,
+                        )
+                    o_rows[i * bm:(i + 1) * bm, j * bn:(j + 1) * bn] = \
+                        acc.astype(o_hbm.dtype)
+
+    for t in range(d):
+        cur, nxt = t % 2, (t + 1) % 2
+        if t + 1 < d:
+            if t >= 1 and use_barrier:
+                pltpu.semaphore_wait(free_sem.at[nxt], 1)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[cur],
+                dst_ref=comm_buf.at[nxt],
+                send_sem=send_sem.at[cur],
+                recv_sem=recv_sem.at[nxt],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+
+        # chunk resident at step t originated at device (my - t) mod d;
+        # its product lands in Y rows [src·mshard, (src+1)·mshard)
+        src = jax.lax.rem(my + d - t, d) if t else my
+        chunk_matmul(cur, o_hbm.at[pl.ds(src * mshard, mshard), :])
+
+        if t <= d - 3 and use_barrier:
+            pltpu.semaphore_signal(free_sem.at[cur], inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        if t + 1 < d:
+            rdma.wait()
+
+
+# Measured on the v5e (8k bf16 sweep via utils.timing, 2026-07-29): the
+# nested pipeline matches the implicit pallas_call pipeline — 184-185 TFLOPS
+# for every ≥(512, 1024) blocking, 144 at 512³. (1024, 1024, 512) matches
+# the chip's tuned table in ops/pallas_matmul.py; buffer sets ≥16 MB
+# (e.g. 1024×1024×1024) fail to compile.
+HBM_RING_BLOCK = (1024, 1024, 512)
+
+
+def ring_allgather_matmul_hbm(
+    mesh: Mesh, axis: str = "x",
+    block_m: int | None = None,
+    block_n: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """Build the jitted shard_map'd HBM ring kernel for `mesh`.
+
+    fn(x, w) with x sharded P(axis, None), w P(None, axis) → y P(None, axis).
+    Per-device VMEM footprint is the inner pipeline's tile set (double-
+    buffered bm×bk + bk×bn + out bm×bn, plus the accumulator) — independent
+    of the problem size, so any HBM-sized operands work.
+    """
+    d = mesh.shape[axis]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def per_device(x_local, w_local):
+        mshard, k = x_local.shape
+        nshard = w_local.shape[1]
+        m = mshard * d
+        # default blocks by operand width: the measured table is for ≤2-byte
+        # dtypes; a (1024, 1024) float32 tile set exceeds the VMEM budget
+        # (same rule as pallas_matmul.tuned_blocks)
+        defaults = HBM_RING_BLOCK if jnp.dtype(x_local.dtype).itemsize <= 2 \
+            else (512, 512, 512)
+        bm, bn, bk = (v if v is not None else dflt for v, dflt in
+                      zip((block_m, block_n, block_k), defaults))
+        blocks = effective_blocks(mshard, nshard, k, bm, bn, bk)
+        out_dtype = matmul_out_dtype(x_local.dtype)
+        kernel = functools.partial(_hbm_ring_kernel, d, axis, not interpret,
+                                   blocks)
+        y, _ = pl.pallas_call(
+            kernel,
+            out_shape=[
+                jax.ShapeDtypeStruct((m, nshard), out_dtype),
+                # the rotating comm buffer rides as a second (discarded)
+                # output: Mosaic forbids HBM *scratch*, but outputs live in
+                # HBM and are writable — the same trick as jax's pallas
+                # all_gather example, which RDMAs through its output
+                jax.ShapeDtypeStruct((2, mshard, k), x_local.dtype),
+            ],
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR((2,)),
+                pltpu.VMEM((blocks[0], blocks[1]),
+                           matmul_acc_dtype(out_dtype)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=1,  # distinct from pallas_ring's barrier
+            ),
+            interpret=interpret,
+        )(x_local, w_local)
+        return y
+
+    return smap(per_device, mesh, in_specs=(P(axis, None), P(None, axis)),
+                out_specs=P(None, axis), check_vma=False)

@@ -298,7 +298,7 @@ def _vs_baseline_mode(config: BenchConfig, mesh: Mesh, size: int,
     return ModeSetup(mode_name, (x, w), baseline_program, overlapped_program,
                      build,
                      memory_gib_per_device=estimate_memory_gib(
-                         "collective_matmul", config, d, size))
+                         mode_name, config, d, size))
 
 
 def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
@@ -418,6 +418,29 @@ def pallas_ring_mode(config: BenchConfig, mesh: Mesh, size: int,
     )
 
 
+def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
+                         benchmark: str = "overlap") -> ModeSetup:
+    """The HBM-blocked in-kernel ring (`ops/pallas_ring_hbm.py`): same
+    RDMA-overlapped all-gather matmul as `pallas_ring`, with operands in HBM
+    and a nested VMEM pipeline feeding the MXU — no VMEM residency cap, so
+    the full benchmark size sweep runs in-kernel. Baseline leg = XLA
+    gather-then-matmul. `--block-m/n/k` overrides the inner pipeline tiles
+    (defaults are the kernel's measured table)."""
+    from tpu_matmul_bench.ops.pallas_ring_hbm import ring_allgather_matmul_hbm
+
+    kw = {}
+    if config.blocks is not None:
+        kw = dict(zip(("block_m", "block_n", "block_k"), config.blocks))
+    return _vs_baseline_mode(
+        config, mesh, size, "pallas_ring_hbm",
+        collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl,
+                                  blocks=config.blocks),
+        ring_allgather_matmul_hbm(mesh, **kw),
+        "all_gather-then-matmul",
+        {"kernel": "pallas HBM ring RDMA all-gather matmul"}, benchmark,
+    )
+
+
 OVERLAP_MODES = {
     "no_overlap": functools.partial(overlap_mode, variant="no_overlap"),
     "overlap": functools.partial(overlap_mode, variant="overlap"),
@@ -425,4 +448,5 @@ OVERLAP_MODES = {
     "collective_matmul": collective_matmul_mode,
     "collective_matmul_rs": collective_matmul_rs_mode,
     "pallas_ring": pallas_ring_mode,
+    "pallas_ring_hbm": pallas_ring_hbm_mode,
 }

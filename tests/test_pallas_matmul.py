@@ -65,10 +65,14 @@ def test_tuned_blocks_table():
     # unknown chip / interpreter and sub-table sizes fall back to the baseline
     assert tuned_blocks(16384, 16384, 16384, "cpu") == (512, 512, 512)
     assert tuned_blocks(512, 512, 512, "TPU v5 lite") == (512, 512, 512)
-    # the table was measured at 2-byte operands; 4-byte tiles would blow VMEM
+    # per-dtype rows: float32 has no table (4-byte tiles would blow VMEM),
+    # float16 shares the bf16 rows, int8 has its own measured winners
     import jax.numpy as jnp
 
     assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
                         jnp.float32) == (512, 512, 512)
     assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
-                        jnp.int8) == (512, 2048, 512)
+                        jnp.float16) == (512, 2048, 512)
+    for size in (4096, 8192, 16384):
+        assert tuned_blocks(size, size, size, "TPU v5 lite",
+                            jnp.int8) == (1024, 1024, 512)

@@ -71,6 +71,12 @@ def compare(size: int, dtype: str, num_devices: int | None,
             rec.extras["note"] = f"run at {ring_size} (VMEM-resident kernel), not {size}"
         results["pallas_ring"] = rec
 
+    # the HBM-blocked in-kernel ring has no VMEM cap — runs the full size
+    report(f"\n### overlap: pallas_ring_hbm " + "#" * 36)
+    for rec in _run(matmul_overlap_benchmark.main,
+                    base + ["--mode", "pallas_ring_hbm"]):
+        results["pallas_ring_hbm"] = rec
+
     # dtype sweep on one device ≙ the reference README's bf16-vs-fp32
     # key insight (README.md:50, ~5× on the RTX 6000 Ada)
     for dt in ("float32", "float16", "bfloat16"):

@@ -5,8 +5,10 @@ Modes {no_overlap, overlap, pipeline} re-designed for XLA's async collectives
 and latency-hiding scheduler (no user streams on TPU), plus the TPU-native
 collective-matmul modes — `collective_matmul` (ppermute-ring all-gather
 matmul, the form BASELINE.json's north star names), `collective_matmul_rs`
-(its reduce-scatter dual), and `pallas_ring` (in-kernel ring RDMA) — where
-ICI transfers hide behind MXU work.
+(its reduce-scatter dual), `pallas_ring` (in-kernel ring RDMA,
+VMEM-resident), and `pallas_ring_hbm` (in-kernel ring RDMA with HBM
+operands + a nested VMEM pipeline — no size cap) — where ICI transfers
+hide behind MXU work.
 Default mode `overlap` ≙ reference `backup/matmul_overlap_benchmark.py:369-371`.
 
 Run: python -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \

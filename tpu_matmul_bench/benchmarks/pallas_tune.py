@@ -29,7 +29,7 @@ from tpu_matmul_bench.utils.device import (
     device_banner,
     resolve_devices,
 )
-from tpu_matmul_bench.utils.metrics import calculate_tflops
+from tpu_matmul_bench.utils.metrics import calculate_tflops, throughput_unit
 from tpu_matmul_bench.utils.reporting import (
     BenchmarkRecord,
     JsonWriter,
@@ -61,7 +61,8 @@ def _parse_candidate(text: str) -> tuple[int, int, int]:
 
 
 def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
-    parser = build_parser(__doc__ or "pallas block tuner")
+    parser = build_parser(__doc__ or "pallas block tuner",
+                          extra_dtypes=("int8",))
     parser.add_argument(
         "--candidates", type=_parse_candidate, nargs="+",
         default=list(DEFAULT_CANDIDATES),
@@ -122,7 +123,8 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                         continue
                     tflops = calculate_tflops(size, t.avg_s)
                     results.append((eff, tflops))
-                    report(f"  {tflops:.2f} TFLOPS ({t.avg_ms:.3f} ms)")
+                    unit = throughput_unit(config.dtype)
+                    report(f"  {tflops:.2f} {unit} ({t.avg_ms:.3f} ms)")
                     rec = BenchmarkRecord(
                         benchmark="tune", mode="pallas_tune", size=size,
                         dtype=config.dtype_name, world=1,
@@ -137,7 +139,8 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                 results.sort(key=lambda r: -r[1])
                 (bm, bn, bk), best = results[0]
                 report(f"\n[{size}] BEST: --block-m {bm} --block-n {bn} "
-                       f"--block-k {bk}  ({best:.2f} TFLOPS)")
+                       f"--block-k {bk}  ({best:.2f} "
+                       f"{throughput_unit(config.dtype)})")
     return records
 
 

@@ -54,36 +54,40 @@ DEFAULT_BLOCK = 512  # the kernel's baseline (bm, bn, bk); see module docstring
 # (min problem dim, (bm, bn, bk)) — the largest row ≤ min(m, n, k) applies.
 # Larger-N blocks win on v5e (fewer accumulator spills per output tile);
 # ≥2 MB-tile configs like (1024, 2048, 512) exceed VMEM and fail to compile.
-_TUNED_BLOCKS: dict[str, list[tuple[int, tuple[int, int, int]]]] = {
-    "v5 lite": [
+_V5E_ROWS: dict[str, list[tuple[int, tuple[int, int, int]]]] = {
+    # bf16 sweep (winners over 14 candidates, 2 rounds)
+    "bfloat16": [
         (4096, (512, 2048, 512)),
         (8192, (1024, 1024, 512)),
         (16384, (512, 2048, 512)),
     ],
-    "v5e": [
-        (4096, (512, 2048, 512)),
-        (8192, (1024, 1024, 512)),
-        (16384, (512, 2048, 512)),
-    ],
+    # int8 sweep: (1024, 1024, 512) wins at 4k/8k/16k (283/330/349 TOPS)
+    "int8": [(4096, (1024, 1024, 512))],
+}
+_TUNED_BLOCKS: dict[str, dict[str, list[tuple[int, tuple[int, int, int]]]]] = {
+    "v5 lite": _V5E_ROWS,
+    "v5e": _V5E_ROWS,
 }
 
 
 def tuned_blocks(
     m: int, n: int, k: int, device_kind: str, dtype: Any = jnp.bfloat16
 ) -> tuple[int, int, int]:
-    """The measured-best (bm, bn, bk) for this problem on this chip, falling
-    back to the 512³ baseline for unknown chips (including the CPU
-    interpreter), problems smaller than any tuned row, or operands wider
-    than the 2 bytes the table was measured at — a (512, 2048) float32 tile
-    set exceeds the VMEM budget that already kills the 2 MB bf16 configs."""
-    if jnp.dtype(dtype).itemsize > 2:
-        return (DEFAULT_BLOCK, DEFAULT_BLOCK, DEFAULT_BLOCK)
+    """The measured-best (bm, bn, bk) for this problem/dtype on this chip,
+    falling back to the 512³ baseline for unknown chips (including the CPU
+    interpreter), problems smaller than any tuned row, or dtypes without a
+    table — float16 shares the bfloat16 rows (same operand width); float32
+    has none, since a (512, 2048) float32 tile set exceeds the VMEM budget
+    that already kills the 2 MB bf16 configs."""
+    name = jnp.dtype(dtype).name
+    if name == "float16":
+        name = "bfloat16"
     kind = device_kind.lower()
-    for key, rows in _TUNED_BLOCKS.items():
+    for key, by_dtype in _TUNED_BLOCKS.items():
         if key in kind:
             dim = min(m, n, k)
             best: tuple[int, int, int] | None = None
-            for min_dim, blocks in sorted(rows):
+            for min_dim, blocks in sorted(by_dtype.get(name, [])):
                 if dim >= min_dim:
                     best = blocks
             if best is not None:

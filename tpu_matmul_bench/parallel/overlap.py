@@ -428,9 +428,12 @@ def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
     (defaults are the kernel's measured table)."""
     from tpu_matmul_bench.ops.pallas_ring_hbm import ring_allgather_matmul_hbm
 
-    kw = {}
-    if config.blocks is not None:
-        kw = dict(zip(("block_m", "block_n", "block_k"), config.blocks))
+    # forward only the explicitly-set flags: config.blocks would fill unset
+    # dims with the generic 512 default, clobbering the kernel's measured
+    # per-dim defaults
+    kw = {f"block_{dim}": v for dim, v in
+          zip("mnk", (config.block_m, config.block_n, config.block_k))
+          if v is not None}
     return _vs_baseline_mode(
         config, mesh, size, "pallas_ring_hbm",
         collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl,

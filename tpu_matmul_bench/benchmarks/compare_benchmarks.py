@@ -71,11 +71,12 @@ def compare(size: int, dtype: str, num_devices: int | None,
             rec.extras["note"] = f"run at {ring_size} (VMEM-resident kernel), not {size}"
         results["pallas_ring"] = rec
 
-    # the HBM-blocked in-kernel ring has no VMEM cap — runs the full size
-    report(f"\n### overlap: pallas_ring_hbm " + "#" * 36)
-    for rec in _run(matmul_overlap_benchmark.main,
-                    base + ["--mode", "pallas_ring_hbm"]):
-        results["pallas_ring_hbm"] = rec
+    # the HBM-blocked in-kernel rings have no VMEM cap — run the full size
+    for hbm_mode in ("pallas_ring_hbm", "pallas_ring_rs_hbm"):
+        report(f"\n### overlap: {hbm_mode} " + "#" * 36)
+        for rec in _run(matmul_overlap_benchmark.main,
+                        base + ["--mode", hbm_mode]):
+            results[hbm_mode] = rec
 
     # dtype sweep on one device ≙ the reference README's bf16-vs-fp32
     # key insight (README.md:50, ~5× on the RTX 6000 Ada)

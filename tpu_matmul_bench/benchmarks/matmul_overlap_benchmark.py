@@ -6,9 +6,9 @@ and latency-hiding scheduler (no user streams on TPU), plus the TPU-native
 collective-matmul modes — `collective_matmul` (ppermute-ring all-gather
 matmul, the form BASELINE.json's north star names), `collective_matmul_rs`
 (its reduce-scatter dual), `pallas_ring` (in-kernel ring RDMA,
-VMEM-resident), and `pallas_ring_hbm` (in-kernel ring RDMA with HBM
-operands + a nested VMEM pipeline — no size cap) — where ICI transfers
-hide behind MXU work.
+VMEM-resident), and `pallas_ring_hbm` / `pallas_ring_rs_hbm` (in-kernel
+gather/reduce-scatter rings with HBM operands + a nested VMEM pipeline —
+no size cap) — where ICI transfers hide behind MXU work.
 Default mode `overlap` ≙ reference `backup/matmul_overlap_benchmark.py:369-371`.
 
 Run: python -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \

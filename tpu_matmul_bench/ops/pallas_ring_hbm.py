@@ -139,6 +139,14 @@ def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
 HBM_RING_BLOCK = (1024, 1024, 512)
 
 
+def default_hbm_blocks(dtype) -> tuple[int, int, int]:
+    """Inner-pipeline block defaults by operand width: the measured table
+    is for ≤2-byte dtypes; a (1024, 1024) float32 tile set exceeds the VMEM
+    budget (same rule as pallas_matmul.tuned_blocks). Shared by the AG and
+    RS HBM ring kernels."""
+    return HBM_RING_BLOCK if jnp.dtype(dtype).itemsize <= 2 else (512, 512, 512)
+
+
 def ring_allgather_matmul_hbm(
     mesh: Mesh, axis: str = "x",
     block_m: int | None = None,
@@ -161,13 +169,9 @@ def ring_allgather_matmul_hbm(
         mshard, k = x_local.shape
         nshard = w_local.shape[1]
         m = mshard * d
-        # default blocks by operand width: the measured table is for ≤2-byte
-        # dtypes; a (1024, 1024) float32 tile set exceeds the VMEM budget
-        # (same rule as pallas_matmul.tuned_blocks)
-        defaults = HBM_RING_BLOCK if jnp.dtype(x_local.dtype).itemsize <= 2 \
-            else (512, 512, 512)
         bm, bn, bk = (v if v is not None else dflt for v, dflt in
-                      zip((block_m, block_n, block_k), defaults))
+                      zip((block_m, block_n, block_k),
+                          default_hbm_blocks(x_local.dtype)))
         blocks = effective_blocks(mshard, nshard, k, bm, bn, bk)
         out_dtype = matmul_out_dtype(x_local.dtype)
         kernel = functools.partial(_hbm_ring_kernel, d, axis, not interpret,

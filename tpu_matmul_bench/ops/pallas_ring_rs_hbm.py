@@ -1,0 +1,243 @@
+"""HBM-blocked Pallas ring reduce-scatter matmul — the dual of
+`ops/pallas_ring_hbm.py`.
+
+Y = X·W with the contraction dim sharded: X [m, k/D] column-sharded, W
+[k/D, n] row-sharded, Y [m/D, n] row-sharded — the matmul+reduce_scatter
+shape (a TP layer's "matmul then gradient/activation sync"). The lax-level
+XLA-scheduled form lives in `parallel/overlap.py
+collective_matmul_rs_program`; this kernel hand-schedules it: the
+accumulator for row chunk c starts at device c+1 and hops right, and each
+ring step runs a nested `emit_pipeline` blocked matmul that FUSES the
+accumulator pickup — the inner kernel adds the arrived partial sum to its
+own chunk product on the last K step (`_rs_acc_kernel`), so the ring add
+costs no extra pass over HBM. The RDMA of step t's result rides the ICI
+under step t+1's MXU work, per-chunk ring flow control identical to the
+all-gather variant (ack-your-writer `free_sem`; see `pallas_ring.py`).
+
+After D−1 hops every accumulator arrives home fully summed; the final step
+writes straight to the output instead of the staging slot. Operands, the
+2-slot recv ring, and the staging slot all live in HBM (outputs-as-buffers,
+as in the all-gather variant), so any HBM-sized problem fits.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_matmul_bench.ops.pallas_matmul import _matmul_kernel, effective_blocks
+from tpu_matmul_bench.ops.pallas_ring_hbm import default_hbm_blocks
+from tpu_matmul_bench.parallel.mesh import smap
+from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _rs_acc_kernel(x_ref, b_ref, accin_ref, o_ref, acc_ref):
+    """`_matmul_kernel` + ring pickup: on the last K step, add the partial
+    sum that arrived over the ring before storing."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        x_ref[:], b_ref[:], preferred_element_type=acc_ref.dtype
+    )
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _store():
+        o_ref[:] = (acc_ref[:] + accin_ref[:].astype(acc_ref.dtype)) \
+            .astype(o_ref.dtype)
+
+
+def _hbm_ring_rs_kernel(d: int, axis: str, use_barrier: bool,
+                        blocks: tuple[int, int, int],
+                        x_hbm, w_hbm, o_hbm, comm_buf,
+                        send_sem, recv_sem, free_sem,
+                        acc_ref):
+    """One device's program. comm_buf slots: [0]/[1] alternate as the recv
+    ring (written only by the LEFT neighbor's RDMA); [2]/[3] alternate as
+    the staging double buffer this device computes into before sending
+    right.
+
+    Overlap structure: the RDMA started at the end of step t is NOT waited
+    there — step t+1 first waits only the *recv* half (its accin must have
+    arrived), runs its pipeline (the outgoing send drains under this MXU
+    work — that is the latency hiding), and the *send* half is waited two
+    steps later when its staging slot comes up for reuse (the last sends
+    drain after the final pipeline).
+
+    WAR flow control on the recv ring: a slot is overwritten every 2 steps
+    and read (as the inner pipeline's accin) in between, so a writer
+    targeting the right neighbor's slot at step t ≥ 2 first waits for the
+    ack the neighbor sent after its step t−1 read. Signals at 1 ≤ t ≤ d−3
+    match waits at 2 ≤ t ≤ d−2 — balanced, so semaphores drain to zero at
+    exit.
+    """
+    m, klocal = x_hbm.shape
+    n = w_hbm.shape[1]
+    mshard = m // d
+    bm, bn, bk = blocks
+    my = jax.lax.axis_index(axis)
+    right = jax.lax.rem(my + 1, d)
+    left = jax.lax.rem(my + d - 1, d)
+
+    if use_barrier:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    grid = (mshard // bm, n // bn, klocal // bk)
+    x_specs = pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk))
+    w_specs = pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j))
+    o_specs = pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))
+
+    if use_barrier:  # compiled TPU: nested VMEM pipelines
+        pipe_first = pltpu.emit_pipeline(  # t=0: no accumulator to pick up
+            _matmul_kernel, grid=grid,
+            in_specs=[x_specs, w_specs], out_specs=o_specs)
+        pipe_acc = pltpu.emit_pipeline(
+            _rs_acc_kernel, grid=grid,
+            in_specs=[x_specs, w_specs, o_specs], out_specs=o_specs)
+
+        def chunk_matmul(t, rows, accin, dest):
+            if t == 0:
+                pipe_first(rows, w_hbm, dest, scratches=(acc_ref,))
+            else:
+                pipe_acc(rows, w_hbm, accin, dest, scratches=(acc_ref,))
+    else:
+        # interpreter path (emit_pipeline needs real TPU device info): the
+        # identical blocked accumulation, addressed directly
+        acc_dtype = matmul_acc_dtype(o_hbm.dtype)
+
+        def chunk_matmul(t, rows, accin, dest):
+            for i in range(mshard // bm):
+                for j in range(n // bn):
+                    acc = jnp.zeros((bm, bn), acc_dtype)
+                    for kk in range(klocal // bk):
+                        acc += jnp.dot(
+                            rows[i * bm:(i + 1) * bm, kk * bk:(kk + 1) * bk],
+                            w_hbm[kk * bk:(kk + 1) * bk, j * bn:(j + 1) * bn],
+                            preferred_element_type=acc_dtype,
+                        )
+                    if t > 0:
+                        acc += accin[i * bm:(i + 1) * bm,
+                                     j * bn:(j + 1) * bn].astype(acc_dtype)
+                    dest[i * bm:(i + 1) * bm, j * bn:(j + 1) * bn] = \
+                        acc.astype(o_hbm.dtype)
+
+    rdma_prev = rdma_prev2 = None
+    for t in range(d):
+        cur, nxt = t % 2, (t + 1) % 2
+        stage = 2 + t % 2
+        # accumulator resident here at step t belongs to row chunk
+        # (my − 1 − t) mod d; after d−1 hops chunk `my` is home
+        c = jax.lax.rem(my + 2 * d - 1 - t, d)
+        rows = x_hbm.at[pl.ds(c * mshard, mshard), :]
+        last = t + 1 == d
+
+        if rdma_prev is not None:
+            rdma_prev.wait_recv()  # this step's accin arrived in `cur`
+        if rdma_prev2 is not None:
+            rdma_prev2.wait_send()  # staging slot `stage` drained, reusable
+
+        dest = o_hbm if last else comm_buf.at[stage]
+        # the pipeline runs while rdma_prev's send is still draining — the
+        # ICI transfer of step t−1's result hides under this MXU work
+        chunk_matmul(t, rows, comm_buf.at[cur], dest)
+
+        if 1 <= t <= d - 3 and use_barrier:
+            # done reading slot `cur` — the left neighbor may overwrite it
+            # (its RDMA at step t+1 targets exactly this slot)
+            pltpu.semaphore_signal(free_sem.at[cur], inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        if not last:
+            if t >= 2 and use_barrier:
+                # right neighbor read slot `nxt` during step t−1; wait for
+                # its ack before overwriting (WAR hazard, see docstring)
+                pltpu.semaphore_wait(free_sem.at[nxt], 1)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[stage],
+                dst_ref=comm_buf.at[nxt],
+                send_sem=send_sem.at[cur],
+                recv_sem=recv_sem.at[nxt],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdma_prev2, rdma_prev = rdma_prev, rdma
+        elif rdma_prev is not None:
+            rdma_prev.wait_send()  # drain the final outstanding send
+
+
+def ring_reduce_scatter_matmul_hbm(
+    mesh: Mesh, axis: str = "x",
+    block_m: int | None = None,
+    block_n: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """Build the jitted shard_map'd HBM ring reduce-scatter matmul.
+
+    fn(x, w) with x sharded P(None, axis), w P(axis, None) → y P(axis, None)
+    — same contract as `collective_matmul_rs_program`. Per-hop rounding
+    matches the lax form: intermediate sums are carried at the matmul
+    output dtype (int8 operands carry exact int32 partials).
+    """
+    d = mesh.shape[axis]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def per_device(x_local, w_local):
+        m, klocal = x_local.shape
+        n = w_local.shape[1]
+        mshard = m // d
+        out_dtype = matmul_out_dtype(x_local.dtype)
+        bm, bn, bk = (v if v is not None else dflt for v, dflt in
+                      zip((block_m, block_n, block_k),
+                          default_hbm_blocks(x_local.dtype)))
+        blocks = effective_blocks(mshard, n, klocal, bm, bn, bk)
+        kernel = functools.partial(_hbm_ring_rs_kernel, d, axis,
+                                   not interpret, blocks)
+        y, _ = pl.pallas_call(
+            kernel,
+            out_shape=[
+                jax.ShapeDtypeStruct((mshard, n), out_dtype),
+                # recv ring slots [0]/[1] + staging double buffer [2]/[3],
+                # in HBM as a discarded output (Mosaic forbids HBM
+                # scratch); carried at the matmul OUTPUT dtype — these
+                # hold partial sums
+                jax.ShapeDtypeStruct((4, mshard, n), out_dtype),
+            ],
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR((2,)),
+                pltpu.VMEM((blocks[0], blocks[1]),
+                           matmul_acc_dtype(out_dtype)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=2,  # distinct from the AG rings' barriers
+            ),
+            interpret=interpret,
+        )(x_local, w_local)
+        return y
+
+    return smap(per_device, mesh, in_specs=(P(None, axis), P(axis, None)),
+                out_specs=P(axis, None), check_vma=False)

@@ -102,11 +102,16 @@ def estimate_memory_gib(
         lb = max(batch // d, 1)
         return gib(2 * lb, lb)
     if mode == "pallas_ring_hbm":
-        # sharded operands (2/d) + the 2-slot HBM comm buffer (2/d) +
-        # full-size combined C + one temp (the baseline leg's gathered X);
-        # applies at every d — the d=1 sanity config still allocates the
-        # comm buffer
+        # sharded operands (2/d) + the 2-slot HBM comm buffer (2/d, operand
+        # dtype) + full-size combined C + one temp (the baseline leg's
+        # gathered X); applies at every d — the d=1 sanity config still
+        # allocates the comm buffer
         return gib(4.0 / d, 2)
+    if mode == "pallas_ring_rs_hbm":
+        # sharded operands (2/d) + full partial product and scatter temp
+        # (the baseline leg, out dtype) + the 3 comm slots (3/d, out dtype
+        # — they carry partial sums)
+        return gib(2.0 / d, 2 + 3.0 / d)
     if mode in ("matrix_parallel", "model_parallel", "collective_matmul",
                 "collective_matmul_rs", "pallas_ring") and d > 1:
         # sharded operands (2/d) + full-size combined C + one temp

@@ -418,6 +418,15 @@ def pallas_ring_mode(config: BenchConfig, mesh: Mesh, size: int,
     )
 
 
+def _explicit_blocks(config: BenchConfig) -> dict:
+    """Only the explicitly-set --block-m/n/k flags, as kernel kwargs:
+    config.blocks would fill unset dims with the generic 512 default,
+    clobbering the HBM ring kernels' measured per-dim defaults."""
+    return {f"block_{dim}": v for dim, v in
+            zip("mnk", (config.block_m, config.block_n, config.block_k))
+            if v is not None}
+
+
 def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
                          benchmark: str = "overlap") -> ModeSetup:
     """The HBM-blocked in-kernel ring (`ops/pallas_ring_hbm.py`): same
@@ -428,12 +437,7 @@ def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
     (defaults are the kernel's measured table)."""
     from tpu_matmul_bench.ops.pallas_ring_hbm import ring_allgather_matmul_hbm
 
-    # forward only the explicitly-set flags: config.blocks would fill unset
-    # dims with the generic 512 default, clobbering the kernel's measured
-    # per-dim defaults
-    kw = {f"block_{dim}": v for dim, v in
-          zip("mnk", (config.block_m, config.block_n, config.block_k))
-          if v is not None}
+    kw = _explicit_blocks(config)
     return _vs_baseline_mode(
         config, mesh, size, "pallas_ring_hbm",
         collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl,
@@ -441,6 +445,29 @@ def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
         ring_allgather_matmul_hbm(mesh, **kw),
         "all_gather-then-matmul",
         {"kernel": "pallas HBM ring RDMA all-gather matmul"}, benchmark,
+    )
+
+
+def pallas_ring_rs_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
+                            benchmark: str = "overlap") -> ModeSetup:
+    """The reduce-scatter dual of `pallas_ring_hbm`
+    (`ops/pallas_ring_rs_hbm.py`): in-kernel accumulator ring with the
+    pickup fused into the blocked matmul's last K step. Baseline leg = XLA
+    matmul-then-psum_scatter."""
+    from tpu_matmul_bench.ops.pallas_ring_rs_hbm import (
+        ring_reduce_scatter_matmul_hbm,
+    )
+
+    kw = _explicit_blocks(config)
+    return _vs_baseline_mode(
+        config, mesh, size, "pallas_ring_rs_hbm",
+        collective_matmul_rs_program(mesh, overlap=False,
+                                     impl=config.matmul_impl,
+                                     blocks=config.blocks),
+        ring_reduce_scatter_matmul_hbm(mesh, **kw),
+        "matmul-then-psum_scatter",
+        {"kernel": "pallas HBM ring RDMA reduce-scatter matmul"}, benchmark,
+        x_spec=P(None, "x"), w_spec=P("x", None),
     )
 
 
@@ -452,4 +479,5 @@ OVERLAP_MODES = {
     "collective_matmul_rs": collective_matmul_rs_mode,
     "pallas_ring": pallas_ring_mode,
     "pallas_ring_hbm": pallas_ring_hbm_mode,
+    "pallas_ring_rs_hbm": pallas_ring_rs_hbm_mode,
 }

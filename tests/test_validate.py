@@ -1,0 +1,109 @@
+"""--validate: the live form of the reference's never-called
+`validate_result` (`matmul_scaling_benchmark.py:240-249`, SURVEY I8) —
+every mode corner-checks its result against a recomputed reference and
+reports the verdict in record extras."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_matmul_bench.parallel.modes import (
+    DISTRIBUTED_MODES,
+    SCALING_MODES,
+    corner_validation,
+    expected_corner,
+    run_mode_benchmark,
+    validation_tolerance,
+)
+from tpu_matmul_bench.parallel.overlap import OVERLAP_MODES
+from tpu_matmul_bench.utils.config import parse_config
+
+SIZE = 64
+
+
+def _cfg(dtype="float32", extra=()):
+    return parse_config(
+        ["--sizes", str(SIZE), "--iterations", "1", "--warmup", "0",
+         "--dtype", dtype, "--validate", *extra],
+        "t", modes=list(OVERLAP_MODES), extra_dtypes=("int8",))
+
+
+def test_tolerances():
+    assert validation_tolerance(jnp.int8) == 0.0
+    assert validation_tolerance(jnp.float32) == 1e-3
+    assert validation_tolerance(jnp.bfloat16) == 3e-2
+
+
+def test_corner_validation_catches_wrong_result():
+    a = jnp.ones((SIZE, SIZE), jnp.float32)
+    b = jnp.ones((SIZE, SIZE), jnp.float32)
+    good = corner_validation(a @ b, expected_corner(a, b), jnp.float32)
+    assert good["validation"] == "ok"
+    bad = corner_validation(a @ b + 1.0, expected_corner(a, b), jnp.float32)
+    assert bad["validation"] == "FAILED"
+    assert bad["validation_max_rel_err"] > bad["validation_tolerance"]
+
+
+@pytest.mark.parametrize("table,mode", [
+    *(("scaling", m) for m in SCALING_MODES),
+    *(("distributed", m) for m in DISTRIBUTED_MODES),
+])
+def test_scaling_distributed_modes_validate(mesh, table, mode):
+    modes = SCALING_MODES if table == "scaling" else DISTRIBUTED_MODES
+    cfg = _cfg()
+    rec = run_mode_benchmark(modes[mode](cfg, mesh, SIZE), cfg)
+    assert rec.extras["validation"] == "ok", rec.extras
+
+
+@pytest.mark.parametrize("mode", ["collective_matmul", "collective_matmul_rs",
+                                  "pallas_ring", "pallas_ring_hbm",
+                                  "pallas_ring_rs_hbm"])
+def test_collective_matmul_modes_validate(mesh, mode):
+    cfg = _cfg(extra=["--block-m", "16", "--block-n", "16", "--block-k", "16"])
+    rec = run_mode_benchmark(OVERLAP_MODES[mode](cfg, mesh, SIZE), cfg)
+    assert rec.extras["validation"] == "ok", rec.extras
+
+
+def test_scan_modes_report_na(mesh):
+    cfg = _cfg()
+    rec = run_mode_benchmark(OVERLAP_MODES["overlap"](cfg, mesh, SIZE), cfg)
+    assert rec.extras["validation"].startswith("n/a")
+
+
+def test_int8_validation_exact(mesh):
+    cfg = _cfg(dtype="int8")
+    rec = run_mode_benchmark(SCALING_MODES["matrix_parallel"](cfg, mesh, SIZE),
+                             cfg)
+    assert rec.extras["validation"] == "ok"
+    assert rec.extras["validation_max_rel_err"] == 0.0
+
+
+def test_matmul_benchmark_cli_validates(mesh):
+    from tpu_matmul_bench.benchmarks import matmul_benchmark
+
+    recs = matmul_benchmark.main(
+        ["--sizes", str(SIZE), "--iterations", "1", "--warmup", "0",
+         "--dtype", "float32", "--validate"])
+    assert recs and recs[0].extras["validation"] == "ok"
+
+
+def test_batch_parallel_validates_with_local_batch_gt_1(devices):
+    # world=2, batch=4 → local_batch=2: the psum sums the stride-lb subset
+    # (regression: validating against the whole global batch reported
+    # FAILED with rel err ~0.75)
+    from tpu_matmul_bench.parallel.mesh import make_mesh
+    from tpu_matmul_bench.parallel.modes import batch_parallel
+
+    mesh2 = make_mesh(devices[:2])
+    cfg = _cfg()
+    rec = run_mode_benchmark(batch_parallel(cfg, mesh2, SIZE), cfg)
+    assert rec.extras["validation"] == "ok", rec.extras
+
+
+def test_hybrid_mode_validates(devices):
+    from tpu_matmul_bench.parallel.hybrid import hybrid_mode, make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(devices, dp=2)
+    cfg = _cfg()
+    rec = run_mode_benchmark(hybrid_mode(cfg, mesh, SIZE), cfg)
+    assert rec.extras["validation"] == "ok", rec.extras

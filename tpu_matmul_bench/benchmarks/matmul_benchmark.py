@@ -23,6 +23,11 @@ from tpu_matmul_bench.benchmarks.runner import run_sizes
 from tpu_matmul_bench.models.workloads import MatmulWorkload
 from tpu_matmul_bench.ops.matmul import make_matmul, matmul_2d
 from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
+from tpu_matmul_bench.parallel.modes import (
+    VALIDATION_CORNER,
+    corner_validation,
+    expected_corner,
+)
 from tpu_matmul_bench.utils.config import BenchConfig, parse_config
 from tpu_matmul_bench.utils.device import (
     collect_device_info,
@@ -49,6 +54,10 @@ def _bench_single(
         extras: dict = {} if t.reliable else {"timing_reliable": False}
         if config.percentiles:
             extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
+        if config.validate:
+            got = mm(a, b)[:VALIDATION_CORNER, :VALIDATION_CORNER]
+            extras.update(corner_validation(got, expected_corner(a, b),
+                                            config.dtype))
     tflops = calculate_tflops(size, t.avg_s)
     return BenchmarkRecord(
         benchmark="matmul",
@@ -90,6 +99,10 @@ def _bench_all_devices(
     extras: dict = {} if t.reliable else {"timing_reliable": False}
     if config.percentiles:
         extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
+    if config.validate:
+        got = mm(a, b)[0, :VALIDATION_CORNER, :VALIDATION_CORNER]
+        extras.update(corner_validation(got, expected_corner(a[0], b[0]),
+                                        config.dtype))
     per_device = calculate_tflops(size, t.avg_s)  # each device did one matmul/iter
     return BenchmarkRecord(
         benchmark="matmul",

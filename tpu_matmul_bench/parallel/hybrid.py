@@ -22,7 +22,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_matmul_bench.ops.matmul import matmul_2d
 from tpu_matmul_bench.parallel.mesh import sharded_normal, smap
-from tpu_matmul_bench.parallel.modes import ModeSetup, estimate_memory_gib
+from tpu_matmul_bench.parallel.modes import (
+    ModeSetup,
+    estimate_memory_gib,
+    expected_corner,
+    make_corner_validate,
+)
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.metrics import calculate_tflops
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
@@ -99,4 +104,13 @@ def hybrid_mode(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
 
     return ModeSetup("hybrid", (x, w), compute, full, build,
                      memory_gib_per_device=estimate_memory_gib(
-                         "hybrid", config, world, size, batch=batch, dp=dp))
+                         "hybrid", config, world, size, batch=batch, dp=dp),
+                     # full = psum over dp of the local-batch sum, and W
+                     # is shared across the batch → Σ_i x_i·W = (Σ_i x_i)·W.
+                     # The out spec P(('dp','tp')) concatenates every
+                     # device's (identical) copy along axis 0 — validate
+                     # the first logical [size, size] block
+                     validate=make_corner_validate(
+                         lambda xx, ww: full(xx, ww)[:size], (x, w),
+                         lambda: expected_corner(jnp.sum(x, axis=0), w),
+                         config.dtype))

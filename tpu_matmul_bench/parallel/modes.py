@@ -52,6 +52,82 @@ class ModeSetup:
     build_record: Callable[[Timing, Timing | None, float], BenchmarkRecord]
     # estimated per-device GiB for A, B and outputs (pre-flight OOM guard)
     memory_gib_per_device: float
+    # --validate: corner-check the mode's result against a recomputed
+    # reference (None → not applicable, e.g. scan programs whose outputs
+    # are per-step scalars)
+    validate: Callable[[], dict] | None = None
+
+
+# --validate corner size ≙ the reference's 10×10 spot check
+# (`matmul_scaling_benchmark.py:244`), widened to a lane-aligned block
+VALIDATION_CORNER = 128
+
+
+def validation_tolerance(dtype: Any) -> float:
+    """Integer matmuls are exact; fp32 keeps the reference's 1e-3
+    (`matmul_scaling_benchmark.py:247`); half dtypes get rounding headroom."""
+    d = jnp.dtype(dtype)
+    if jnp.issubdtype(d, jnp.integer):
+        return 0.0
+    return 1e-3 if d.itemsize >= 4 else 3e-2
+
+
+def expected_corner(a: jax.Array, b: jax.Array,
+                    corner: int = VALIDATION_CORNER) -> jax.Array:
+    """High-precision reference for C[:corner, :corner] = (A·B) corner —
+    full-K dot of A's first rows with B's first columns."""
+    c = min(corner, a.shape[0], b.shape[1])
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        return jnp.dot(a[:c].astype(jnp.int32), b[:, :c].astype(jnp.int32),
+                       preferred_element_type=jnp.int32)
+    return jnp.dot(a[:c].astype(jnp.float32), b[:, :c].astype(jnp.float32))
+
+
+def expected_corner_sum(a: jax.Array, b: jax.Array,
+                        corner: int = VALIDATION_CORNER) -> jax.Array:
+    """Reference corner for Σ_i A[i]·B[i] over a stacked leading dim (the
+    all_reduce-of-products modes)."""
+    c = min(corner, a.shape[1], b.shape[2])
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        return jnp.einsum("bik,bkj->ij", a[:, :c].astype(jnp.int32),
+                          b[:, :, :c].astype(jnp.int32),
+                          preferred_element_type=jnp.int32)
+    return jnp.einsum("bik,bkj->ij", a[:, :c].astype(jnp.float32),
+                      b[:, :, :c].astype(jnp.float32))
+
+
+def corner_validation(got: jax.Array, expected: jax.Array, dtype: Any) -> dict:
+    """Compare a result corner against the recomputed reference — the live
+    form of the reference's never-called `validate_result`
+    (`matmul_scaling_benchmark.py:240-249`)."""
+    import numpy as np
+
+    g = np.asarray(got, np.float64)
+    e = np.asarray(expected, np.float64)
+    denom = float(np.abs(e).max()) or 1.0
+    err = float(np.abs(g - e).max()) / denom
+    tol = validation_tolerance(dtype)
+    return {
+        "validation": "ok" if err <= tol else "FAILED",
+        "validation_max_rel_err": round(err, 8),
+        "validation_tolerance": tol,
+    }
+
+
+def make_corner_validate(program, operands, expected_fn, dtype,
+                         index: int | None = None) -> Callable[[], dict]:
+    """Build a ModeSetup.validate closure: run `program` over `operands`,
+    take `[index]` of the result when the output is stacked, and
+    corner-compare against `expected_fn()` — the one shape every mode's
+    validation takes."""
+    def validate() -> dict:
+        out = program(*operands)
+        if index is not None:
+            out = out[index]
+        got = out[:VALIDATION_CORNER, :VALIDATION_CORNER]
+        return corner_validation(got, expected_fn(), dtype)
+
+    return validate
 
 
 def _barrier(x):
@@ -109,9 +185,9 @@ def estimate_memory_gib(
         return gib(4.0 / d, 2)
     if mode == "pallas_ring_rs_hbm":
         # sharded operands (2/d) + full partial product and scatter temp
-        # (the baseline leg, out dtype) + the 3 comm slots (3/d, out dtype
-        # — they carry partial sums)
-        return gib(2.0 / d, 2 + 3.0 / d)
+        # (the baseline leg, out dtype) + the 4 comm slots (4/d, out dtype
+        # — 2-slot recv ring + double-buffered staging, all partial sums)
+        return gib(2.0 / d, 2 + 4.0 / d)
     if mode in ("matrix_parallel", "model_parallel", "collective_matmul",
                 "collective_matmul_rs", "pallas_ring") and d > 1:
         # sharded operands (2/d) + full-size combined C + one temp
@@ -157,7 +233,11 @@ def independent(config: BenchConfig, mesh: Mesh, size: int,
 
     return ModeSetup("independent", (a, b), compute, None, build,
                      memory_gib_per_device=estimate_memory_gib(
-                         "independent", config, d, size))
+                         "independent", config, d, size),
+                     validate=make_corner_validate(
+                         compute, (a, b),
+                         lambda: expected_corner(a[0], b[0]),
+                         config.dtype, index=0))
 
 
 # ---------------------------------------------------------------------------
@@ -211,7 +291,15 @@ def batch_parallel(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
 
     return ModeSetup("batch_parallel", (a, b), compute, full, build,
                      memory_gib_per_device=estimate_memory_gib(
-                         "batch_parallel", config, d, size, batch=batch))
+                         "batch_parallel", config, d, size, batch=batch),
+                     # the psum sums each SLOT across devices: global row 0
+                     # of the full output = Σ_j A[j·lb]·B[j·lb], the
+                     # stride-lb subset — not the whole global batch
+                     validate=make_corner_validate(
+                         full, (a, b),
+                         lambda: expected_corner_sum(a[::local_batch],
+                                                     b[::local_batch]),
+                         config.dtype, index=0))
 
 
 # ---------------------------------------------------------------------------
@@ -265,7 +353,10 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
 
     return ModeSetup("matrix_parallel", (a, b), compute, full, build,
                      memory_gib_per_device=estimate_memory_gib(
-                         "matrix_parallel", config, d, size))
+                         "matrix_parallel", config, d, size),
+                     validate=make_corner_validate(
+                         full, (a, b), lambda: expected_corner(a, b),
+                         config.dtype))
 
 
 # ---------------------------------------------------------------------------
@@ -309,7 +400,10 @@ def data_parallel(config: BenchConfig, mesh: Mesh, size: int,
 
     return ModeSetup("data_parallel", (a, b), compute, full, build,
                      memory_gib_per_device=estimate_memory_gib(
-                         "data_parallel", config, d, size))
+                         "data_parallel", config, d, size),
+                     validate=make_corner_validate(
+                         full, (a, b), lambda: expected_corner_sum(a, b),
+                         config.dtype, index=0))
 
 
 # ---------------------------------------------------------------------------
@@ -370,7 +464,10 @@ def model_parallel(config: BenchConfig, mesh: Mesh, size: int,
 
     return ModeSetup("model_parallel", (a, b), compute, full, build,
                      memory_gib_per_device=estimate_memory_gib(
-                         "model_parallel", config, d, size))
+                         "model_parallel", config, d, size),
+                     validate=make_corner_validate(
+                         full, (a, b), lambda: expected_corner(a, b),
+                         config.dtype))
 
 
 SCALING_MODES = {
@@ -386,6 +483,18 @@ DISTRIBUTED_MODES = {
 }
 
 
+def _maybe_validate(setup: ModeSetup, config: BenchConfig,
+                    rec: BenchmarkRecord) -> None:
+    """--validate: corner-check before the record ships (SURVEY I8 — the
+    reference defines `validate_result` and never calls it; here it runs)."""
+    if not config.validate:
+        return
+    if setup.validate is None:
+        rec.extras["validation"] = "n/a (program outputs per-step scalars)"
+        return
+    rec.extras.update(setup.validate())
+
+
 def run_mode_benchmark(setup: ModeSetup, config: BenchConfig) -> BenchmarkRecord:
     """Time a mode's programs and build its record (SURVEY I3 regimes)."""
     if setup.full is None:
@@ -399,6 +508,7 @@ def run_mode_benchmark(setup: ModeSetup, config: BenchConfig) -> BenchmarkRecord
         if config.percentiles:
             rec.extras["latency_ms"] = latency_percentiles_ms(
                 setup.compute, setup.operands, config)
+        _maybe_validate(setup, config, rec)
         return rec
     t_compute, t_full, comm_s = time_variants(
         setup.compute, setup.full, setup.operands,
@@ -410,4 +520,5 @@ def run_mode_benchmark(setup: ModeSetup, config: BenchConfig) -> BenchmarkRecord
     if config.percentiles:
         rec.extras["latency_ms"] = latency_percentiles_ms(
             setup.full, setup.operands, config)
+    _maybe_validate(setup, config, rec)
     return rec

@@ -31,6 +31,11 @@ collectives + latency-hiding scheduler inside ONE compiled program:
   sync" shape).
 - ``pallas_ring``: the all-gather ring hand-scheduled inside one Pallas
   kernel (`ops/pallas_ring.py`), RDMA double-buffered against the MXU.
+- ``pallas_ring_hbm`` / ``pallas_ring_rs_hbm``: the same in-kernel
+  all-gather ring, and its reduce-scatter dual, with HBM-resident operands
+  and a nested `emit_pipeline` blocked matmul per step
+  (`ops/pallas_ring_hbm.py`, `ops/pallas_ring_rs_hbm.py`) — no VMEM size
+  cap, so in-kernel RDMA overlap covers the full sweep.
 
 Every variant times ONE jitted scan program of `steps_per_call` steps, so the
 host never intervenes mid-pipeline (the scan is the stream). The ring-buffer
@@ -55,7 +60,12 @@ from tpu_matmul_bench.parallel.mesh import (
     smap,
     world_size,
 )
-from tpu_matmul_bench.parallel.modes import ModeSetup, estimate_memory_gib
+from tpu_matmul_bench.parallel.modes import (
+    ModeSetup,
+    estimate_memory_gib,
+    expected_corner,
+    make_corner_validate,
+)
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.metrics import calculate_tflops, matmul_out_dtype
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
@@ -298,7 +308,10 @@ def _vs_baseline_mode(config: BenchConfig, mesh: Mesh, size: int,
     return ModeSetup(mode_name, (x, w), baseline_program, overlapped_program,
                      build,
                      memory_gib_per_device=estimate_memory_gib(
-                         mode_name, config, d, size))
+                         mode_name, config, d, size),
+                     validate=make_corner_validate(
+                         overlapped_program, (x, w),
+                         lambda: expected_corner(x, w), config.dtype))
 
 
 def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,

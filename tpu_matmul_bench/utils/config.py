@@ -53,6 +53,7 @@ class BenchConfig:
     seed: int
     profile_dir: str | None = None
     percentiles: bool = False
+    validate: bool = False
     # Pallas kernel block override (None → kernel defaults); ignored by --matmul-impl xla
     block_m: int | None = None
     block_n: int | None = None
@@ -124,6 +125,13 @@ def build_parser(
     )
     p.add_argument("--seed", type=int, default=0, help="PRNG seed for operand data")
     p.add_argument(
+        "--validate", action="store_true",
+        help="Check a corner of each mode's result against a recomputed "
+             "reference before timing (the reference defines this check but "
+             "never calls it — matmul_scaling_benchmark.py:240-249; here "
+             "it is live)",
+    )
+    p.add_argument(
         "--percentiles", action="store_true",
         help="Also measure per-iteration latency percentiles (p50/p90/p99) — "
              "exposes jitter that the whole-loop mean hides",
@@ -159,6 +167,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         seed=args.seed,
         profile_dir=getattr(args, "profile_dir", None),
         percentiles=getattr(args, "percentiles", False),
+        validate=getattr(args, "validate", False),
         block_m=getattr(args, "block_m", None),
         block_n=getattr(args, "block_n", None),
         block_k=getattr(args, "block_k", None),

@@ -4,7 +4,6 @@ every mode corner-checks its result against a recomputed reference and
 reports the verdict in record extras."""
 
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from tpu_matmul_bench.parallel.modes import (
@@ -107,3 +106,24 @@ def test_hybrid_mode_validates(devices):
     cfg = _cfg()
     rec = run_mode_benchmark(hybrid_mode(cfg, mesh, SIZE), cfg)
     assert rec.extras["validation"] == "ok", rec.extras
+
+
+@pytest.mark.parametrize("op", ["psum", "all_gather", "reduce_scatter",
+                                "ppermute", "all_to_all"])
+def test_collective_benchmark_validates(mesh, op):
+    from tpu_matmul_bench.parallel.collective_bench import (
+        run_collective_benchmark,
+    )
+
+    cfg = _cfg()
+    rec = run_collective_benchmark(cfg, mesh, SIZE, op)
+    assert rec.extras["validation"] == "ok", (op, rec.extras)
+
+
+def test_tune_validates(mesh):
+    from tpu_matmul_bench.benchmarks import pallas_tune
+
+    recs = pallas_tune.main(
+        ["--sizes", str(SIZE), "--iterations", "1", "--warmup", "0",
+         "--dtype", "float32", "--validate", "--candidates", "16,16,16"])
+    assert recs and recs[0].extras["validation"] == "ok"

@@ -94,6 +94,9 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         description=__doc__ or "collective benchmark",
         modes=list(COLLECTIVES),
         default_mode="psum",
+        # int8 payloads: collectives move bytes, and the reductions (psum /
+        # reduce_scatter) stay in-range for the small-int operand data
+        extra_dtypes=("int8",),
     )
     return run(config)
 

@@ -50,14 +50,16 @@ def _bench_single(
     with jax.default_device(device if device is not None else jax.devices()[0]):
         a, b = wl.operands()
         mm = make_matmul(config.matmul_impl, config.blocks)
+        verdict: dict = {}
+        if config.validate:  # before timing: a wrong kernel fails fast
+            got = mm(a, b)[:VALIDATION_CORNER, :VALIDATION_CORNER]
+            verdict = corner_validation(got, expected_corner(a, b),
+                                        config.dtype)
         t = time_jitted(mm, (a, b), iterations=config.iterations, warmup=config.warmup)
         extras: dict = {} if t.reliable else {"timing_reliable": False}
         if config.percentiles:
             extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
-        if config.validate:
-            got = mm(a, b)[:VALIDATION_CORNER, :VALIDATION_CORNER]
-            extras.update(corner_validation(got, expected_corner(a, b),
-                                            config.dtype))
+        extras.update(verdict)
     tflops = calculate_tflops(size, t.avg_s)
     return BenchmarkRecord(
         benchmark="matmul",
@@ -95,14 +97,16 @@ def _bench_all_devices(
             out_specs=P("x"),
         )
     )
+    verdict: dict = {}
+    if config.validate:  # before timing: a wrong kernel fails fast
+        got = mm(a, b)[0, :VALIDATION_CORNER, :VALIDATION_CORNER]
+        verdict = corner_validation(got, expected_corner(a[0], b[0]),
+                                    config.dtype)
     t = time_jitted(mm, (a, b), iterations=config.iterations, warmup=config.warmup)
     extras: dict = {} if t.reliable else {"timing_reliable": False}
     if config.percentiles:
         extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
-    if config.validate:
-        got = mm(a, b)[0, :VALIDATION_CORNER, :VALIDATION_CORNER]
-        extras.update(corner_validation(got, expected_corner(a[0], b[0]),
-                                        config.dtype))
+    extras.update(verdict)
     per_device = calculate_tflops(size, t.avg_s)  # each device did one matmul/iter
     return BenchmarkRecord(
         benchmark="matmul",

@@ -23,6 +23,11 @@ import jax
 from tpu_matmul_bench.models.workloads import MatmulWorkload
 from tpu_matmul_bench.ops.matmul import make_matmul
 from tpu_matmul_bench.ops.pallas_matmul import effective_blocks
+from tpu_matmul_bench.parallel.modes import (
+    VALIDATION_CORNER,
+    corner_validation,
+    expected_corner,
+)
 from tpu_matmul_bench.utils.config import build_parser, config_from_args
 from tpu_matmul_bench.utils.device import (
     collect_device_info,
@@ -115,6 +120,15 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                            f"bk={bk}{note} ...")
                     try:
                         mm = make_matmul("pallas", eff)
+                        verdict: dict = {}
+                        if config.validate:  # a wrong blocking fails fast
+                            got = mm(a, b)[:VALIDATION_CORNER,
+                                           :VALIDATION_CORNER]
+                            verdict = corner_validation(
+                                got, expected_corner(a, b), config.dtype)
+                            if verdict["validation"] != "ok":
+                                report(f"  VALIDATION FAILED: {verdict}")
+                                continue
                         t = time_jitted(mm, (a, b),
                                         iterations=config.iterations,
                                         warmup=config.warmup)
@@ -131,7 +145,8 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                         iterations=t.iterations, warmup=config.warmup,
                         avg_time_s=t.avg_s, tflops_per_device=tflops,
                         tflops_total=tflops, device_kind=info.device_kind,
-                        extras={"block_m": bm, "block_n": bn, "block_k": bk},
+                        extras={"block_m": bm, "block_n": bn, "block_k": bk,
+                                **verdict},
                     ).finalize()
                     records.append(rec)
                     jw.write(rec)

@@ -22,6 +22,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_matmul_bench.parallel.mesh import ring_perm, sharded_normal, smap, world_size
+from tpu_matmul_bench.parallel.modes import corner_validation
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
 from tpu_matmul_bench.utils.timing import time_jitted
@@ -116,8 +117,43 @@ def collective_setup(config: BenchConfig, mesh: Mesh, size: int,
     return fn, x, spec
 
 
+def _collective_reference(op: str, d: int, x) -> "object":
+    """Expected global output of one collective, computed with numpy from
+    the global operand (shards = leading-dim blocks)."""
+    import numpy as np
+
+    xs = np.asarray(x, np.float64)
+    shards = xs.reshape(d, -1, xs.shape[1])
+    if op == "psum":
+        return np.concatenate([shards.sum(axis=0)] * d)
+    if op == "all_gather":
+        return np.concatenate([xs] * d)
+    if op == "reduce_scatter":
+        return shards.sum(axis=0)  # row block j lands on device j → global sum
+    if op == "ppermute":
+        return np.concatenate([shards[(j - 1) % d] for j in range(d)])
+    if op == "all_to_all":
+        rows = shards.shape[1] // d
+        blocks = shards.reshape(d, d, rows, xs.shape[1])  # [src, blk, r, c]
+        return np.concatenate(
+            [np.concatenate(list(blocks[:, j]), axis=0) for j in range(d)])
+    raise ValueError(op)
+
+
+def validate_collective(config: BenchConfig, mesh: Mesh, op: str) -> dict:
+    """--validate for the bandwidth benchmark: run the op once on a small
+    payload and compare the full result against the numpy reference —
+    per-op semantics, not just the startup verify_collectives smoke test."""
+    d = world_size(mesh)
+    size_v = 8 * d  # small, divisible payload; semantics don't depend on size
+    fn, x, _ = collective_setup(config, mesh, size_v, op)
+    return corner_validation(fn(x), _collective_reference(op, d, x),
+                             config.dtype)
+
+
 def run_collective_benchmark(config: BenchConfig, mesh: Mesh, size: int,
                              op: str) -> BenchmarkRecord:
+    verdict = validate_collective(config, mesh, op) if config.validate else {}
     fn, x, spec = collective_setup(config, mesh, size, op)
     d = world_size(mesh)
     t = time_jitted(fn, (x,), iterations=config.iterations,
@@ -139,7 +175,7 @@ def run_collective_benchmark(config: BenchConfig, mesh: Mesh, size: int,
         algbw_gbps=algbw,
         busbw_gbps=algbw * spec.bus_factor(d),
         comm_time_s=t.avg_s,
-        extras={"bus_factor": round(spec.bus_factor(d), 4)},
+        extras={"bus_factor": round(spec.bus_factor(d), 4), **verdict},
     )
     if not t.reliable:
         rec.extras["timing_reliable"] = False

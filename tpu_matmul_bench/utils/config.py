@@ -126,10 +126,11 @@ def build_parser(
     p.add_argument("--seed", type=int, default=0, help="PRNG seed for operand data")
     p.add_argument(
         "--validate", action="store_true",
-        help="Check a corner of each mode's result against a recomputed "
-             "reference before timing (the reference defines this check but "
-             "never calls it — matmul_scaling_benchmark.py:240-249; here "
-             "it is live)",
+        help="Corner-check each mode's result against a recomputed "
+             "reference before the timed run, reporting the verdict in "
+             "record extras (the reference defines this check but never "
+             "calls it — matmul_scaling_benchmark.py:240-249; here it is "
+             "live)",
     )
     p.add_argument(
         "--percentiles", action="store_true",

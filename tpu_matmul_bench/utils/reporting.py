@@ -74,8 +74,9 @@ class BenchmarkRecord:
             self.comm_overhead_pct = (
                 100.0 * self.comm_time_s / (self.compute_time_s + self.comm_time_s)
             )
-        if throughput_unit(self.dtype) != "TFLOPS":
-            # flag integer records so JSON consumers read tflops_* as TOPS
+        if self.algbw_gbps is None and throughput_unit(self.dtype) != "TFLOPS":
+            # flag integer FLOP-benchmark records so JSON consumers read
+            # tflops_* as TOPS (bandwidth records carry no such fields)
             self.extras.setdefault("throughput_unit", throughput_unit(self.dtype))
         if self.peak_efficiency_pct is None and self.device_kind:
             peak = theoretical_peak_tflops(self.device_kind, self.dtype)

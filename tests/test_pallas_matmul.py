@@ -76,3 +76,23 @@ def test_tuned_blocks_table():
     for size in (4096, 8192, 16384):
         assert tuned_blocks(size, size, size, "TPU v5 lite",
                             jnp.int8) == (1024, 1024, 512)
+
+
+def test_fuzz_shapes_vs_xla():
+    """Padding-path fuzz: odd/prime/non-square shapes must match XLA's dot
+    (the kernel pads to 128 multiples and slices back)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    shapes = [(7, 13, 5), (129, 64, 257), (100, 300, 200), (1, 128, 1),
+              (255, 255, 255), (64, 1, 64)]
+    for m, k, n in shapes:
+        a = jnp.asarray(rng.randn(m, k), jnp.float32)
+        b = jnp.asarray(rng.randn(k, n), jnp.float32)
+        got = np.asarray(pallas_matmul(a, b, block_m=64, block_n=64,
+                                       block_k=64))
+        want = np.asarray(a) @ np.asarray(b)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"shape {(m, k, n)}")

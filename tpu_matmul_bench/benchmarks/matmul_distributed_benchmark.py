@@ -17,7 +17,7 @@ from typing import Sequence
 
 from tpu_matmul_bench.benchmarks.matmul_scaling_benchmark import run
 from tpu_matmul_bench.parallel.modes import DISTRIBUTED_MODES
-from tpu_matmul_bench.utils.config import BenchConfig, parse_config
+from tpu_matmul_bench.utils.config import parse_config
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
 
 

@@ -10,7 +10,6 @@ over a JAX mesh: `psum` / `pmean` / `all_gather` / `ppermute` inside
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

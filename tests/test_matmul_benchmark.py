@@ -50,3 +50,45 @@ def test_oom_resilience(tmp_path, monkeypatch):
     monkeypatch.setattr(matmul_benchmark, "_bench_single", failing)
     recs = matmul_benchmark.main(_argv(tmp_path, ["--num-devices", "1"]))
     assert [r.size for r in recs] == [128]
+
+
+def test_mkn_rectangular(tmp_path):
+    import json
+
+    from tpu_matmul_bench.benchmarks import matmul_benchmark
+
+    out = tmp_path / "rect.jsonl"
+    recs = matmul_benchmark.main(
+        ["--mkn", "96", "256", "160", "--iterations", "1", "--warmup", "0",
+         "--dtype", "float32", "--validate", "--num-devices", "1",
+         "--json-out", str(out)])
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec.flops_per_op == 2.0 * 96 * 256 * 160
+    assert rec.extras["shape"] == "96x256x160"
+    assert rec.extras["validation"] == "ok"
+    assert rec.roofline_pct is None  # square-only metric
+    saved = json.loads(out.read_text())
+    assert saved["flops_per_op"] == rec.flops_per_op
+
+
+def test_mkn_rejects_multi_device():
+    import pytest
+
+    from tpu_matmul_bench.benchmarks import matmul_benchmark
+
+    with pytest.raises(SystemExit):
+        matmul_benchmark.main(
+            ["--mkn", "64", "64", "64", "--iterations", "1", "--warmup", "0"])
+
+
+def test_rect_workload_memory():
+    import jax.numpy as jnp
+
+    from tpu_matmul_bench.models.workloads import RectMatmulWorkload
+
+    wl = RectMatmulWorkload(1024, 2048, 512, jnp.int8)
+    want = (1024 * 2048 + 2048 * 512 + 1024 * 512 * 4) / 1024**3
+    assert abs(wl.memory_gib - want) < 1e-12
+    a, b = wl.operands()
+    assert a.shape == (1024, 2048) and b.shape == (2048, 512)

@@ -20,7 +20,7 @@ from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 from tpu_matmul_bench.benchmarks.runner import run_sizes
-from tpu_matmul_bench.models.workloads import MatmulWorkload
+from tpu_matmul_bench.models.workloads import MatmulWorkload, RectMatmulWorkload
 from tpu_matmul_bench.ops.matmul import make_matmul, matmul_2d
 from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
 from tpu_matmul_bench.parallel.modes import (
@@ -124,7 +124,43 @@ def _bench_all_devices(
     )
 
 
-def run(config: BenchConfig) -> list[BenchmarkRecord]:
+def _bench_rect(
+    config: BenchConfig, mkn: tuple[int, int, int], device_kind: str,
+    device: jax.Device,
+) -> BenchmarkRecord:
+    """--mkn M K N: one rectangular matmul (beyond the reference's square
+    sweep; the kernels are shape-general)."""
+    m, k, n = mkn
+    wl = RectMatmulWorkload(m, k, n, config.dtype, seed=config.seed)
+    with jax.default_device(device):
+        a, b = wl.operands()
+        mm = make_matmul(config.matmul_impl, config.blocks)
+        verdict: dict = {}
+        if config.validate:
+            c = min(VALIDATION_CORNER, m, n)  # rect: corner bounded by M, N
+            got = mm(a, b)[:c, :c]
+            verdict = corner_validation(got, expected_corner(a, b, corner=c),
+                                        config.dtype)
+        t = time_jitted(mm, (a, b), iterations=config.iterations,
+                        warmup=config.warmup)
+        extras: dict = {"shape": f"{m}x{k}x{n}"}
+        if not t.reliable:
+            extras["timing_reliable"] = False
+        if config.percentiles:
+            extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
+        extras.update(verdict)
+    tflops = calculate_tflops(max(mkn), t.avg_s, flops=wl.flops)
+    return BenchmarkRecord(
+        benchmark="matmul", mode="single", size=max(mkn),
+        dtype=config.dtype_name, world=1, iterations=t.iterations,
+        warmup=config.warmup, avg_time_s=t.avg_s,
+        tflops_per_device=tflops, tflops_total=tflops,
+        device_kind=device_kind, flops_per_op=wl.flops, extras=extras,
+    )
+
+
+def run(config: BenchConfig, mkn: tuple[int, int, int] | None = None
+        ) -> list[BenchmarkRecord]:
     maybe_init_multihost()
     devices = resolve_devices(config.device, config.num_devices)
     info = collect_device_info(devices)
@@ -143,6 +179,30 @@ def run(config: BenchConfig) -> list[BenchmarkRecord]:
         )
     )
 
+    if mkn is not None:
+        if len(devices) > 1:
+            raise SystemExit("--mkn is single-device (use --num-devices 1); "
+                             "the sharded modes are square-sweep programs")
+        m, k, n = mkn
+        wl = RectMatmulWorkload(m, k, n, config.dtype)
+        # one "size" through the shared runner: same pre-flight memory
+        # guard, OOM backstop, JSON sink, and report pipeline as the sweep
+        with maybe_trace(config.profile_dir):
+            records = run_sizes(
+                config,
+                lambda _s: _bench_rect(config, mkn, info.device_kind,
+                                       devices[0]),
+                sizes=[max(mkn)],
+                memory_gib=lambda _s: wl.memory_gib,
+                memory_limit_gib=info.memory_gib,
+                preamble=lambda _s: (
+                    f"\nBenchmarking {m}x{k}x{n} matrix multiplication:\n"
+                    f"  - Total memory for A, B, C: {wl.memory_gib:.2f} GiB"
+                ),
+            )
+        report("\n" + "=" * 60, "Benchmark completed!", "=" * 60)
+        return records
+
     def bench_one(size: int) -> BenchmarkRecord:
         if len(devices) == 1:
             return _bench_single(config, size, info.device_kind, devices[0])
@@ -160,9 +220,19 @@ def run(config: BenchConfig) -> list[BenchmarkRecord]:
 
 
 def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
-    config = parse_config(argv, description=__doc__ or "matmul benchmark",
+    from tpu_matmul_bench.utils.config import build_parser, config_from_args
+
+    parser = build_parser(__doc__ or "matmul benchmark",
                           extra_dtypes=("int8",))
-    return run(config)
+    parser.add_argument(
+        "--mkn", type=int, nargs=3, metavar=("M", "K", "N"), default=None,
+        help="Benchmark one rectangular C[M,N] = A[M,K]·B[K,N] instead of "
+             "the square --sizes sweep (single-device; beyond the "
+             "reference's square-only surface)",
+    )
+    args = parser.parse_args(argv)
+    config = config_from_args(args)
+    return run(config, mkn=tuple(args.mkn) if args.mkn else None)
 
 
 if __name__ == "__main__":

@@ -28,6 +28,7 @@ def run_sizes(
     sizes: Iterable[int] | None = None,
     memory_gib: Callable[[int], float] | None = None,
     memory_limit_gib: float | None = None,
+    preamble: Callable[[int], str] | None = None,
 ) -> list[BenchmarkRecord]:
     """Run `bench_one(size)` over the size sweep; skip OOM sizes and
     continue (≙ reference `matmul_scaling_benchmark.py:337-342`).
@@ -41,7 +42,8 @@ def run_sizes(
     records: list[BenchmarkRecord] = []
     with JsonWriter(config.json_out) as jw:
         for size in sizes if sizes is not None else config.sizes:
-            report(size_preamble(size, config.dtype_name))
+            report(preamble(size) if preamble is not None
+                   else size_preamble(size, config.dtype_name))
             if (
                 memory_gib is not None
                 and memory_limit_gib is not None

@@ -14,6 +14,7 @@ import jax
 
 from tpu_matmul_bench.ops.matmul import random_operands
 from tpu_matmul_bench.utils.metrics import (
+    bytes_per_element,
     matmul_flops,
     matmul_out_dtype,
     matrix_memory_gib,
@@ -42,6 +43,37 @@ class MatmulWorkload:
         a, b = random_operands(
             self.seed + seed_offset, (self.size, self.size), self.dtype
         )
+        return a, b
+
+
+@dataclasses.dataclass(frozen=True)
+class RectMatmulWorkload:
+    """One rectangular matmul C[m,n] = A[m,k]·B[k,n] — beyond the
+    reference's square-only sweep (`matmul_benchmark.py:157`); the kernels
+    underneath are shape-general."""
+
+    m: int
+    k: int
+    n: int
+    dtype: Any
+    seed: int = 0
+
+    @property
+    def flops(self) -> float:
+        return matmul_flops(self.m, self.n, self.k)
+
+    @property
+    def memory_gib(self) -> float:
+        bpe = bytes_per_element(self.dtype)
+        out_bpe = bytes_per_element(matmul_out_dtype(self.dtype))
+        return ((self.m * self.k + self.k * self.n) * bpe
+                + self.m * self.n * out_bpe) / (1024 ** 3)
+
+    def operands(self, seed_offset: int = 0) -> tuple[jax.Array, jax.Array]:
+        (a,) = random_operands(self.seed + seed_offset, (self.m, self.k),
+                               self.dtype, count=1)
+        (b,) = random_operands(self.seed + seed_offset + 1, (self.k, self.n),
+                               self.dtype, count=1)
         return a, b
 
 

@@ -27,12 +27,16 @@ def matmul_flops(m: int, n: int | None = None, k: int | None = None) -> float:
     return 2.0 * m * n * k
 
 
-def calculate_tflops(matrix_size: int, time_seconds: float, num_ops: int = 1) -> float:
+def calculate_tflops(matrix_size: int, time_seconds: float, num_ops: int = 1,
+                     flops: float | None = None) -> float:
     """TFLOPS of `num_ops` square matmuls of `matrix_size` done in
-    `time_seconds` ≙ reference `matmul_scaling_benchmark.py:63-67`."""
+    `time_seconds` ≙ reference `matmul_scaling_benchmark.py:63-67`.
+    Pass `flops` to override the square 2n³ count (rectangular problems)."""
     if time_seconds <= 0:
         return float("inf")
-    return matmul_flops(matrix_size) * num_ops / time_seconds / 1e12
+    if flops is None:
+        flops = matmul_flops(matrix_size) * num_ops
+    return flops / time_seconds / 1e12
 
 
 def bytes_per_element(dtype: Any) -> int:

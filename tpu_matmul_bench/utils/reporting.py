@@ -61,6 +61,8 @@ class BenchmarkRecord:
     # measured vs the HBM roofline, set only for comm-free records at sizes
     # where the memory leg binds (peak_efficiency_pct covers the MXU leg)
     roofline_pct: float | None = None
+    # rectangular problems (--mkn): actual FLOPs per op; None → square 2·size³
+    flops_per_op: float | None = None
     extras: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def finalize(self) -> "BenchmarkRecord":
@@ -86,6 +88,7 @@ class BenchmarkRecord:
             self.roofline_pct is None
             and self.device_kind
             and self.algbw_gbps is None  # FLOP benchmarks only
+            and self.flops_per_op is None  # square problems only
             and self.avg_time_s > 0
             and not self.comm_time_s  # comm-free: per-chip bound applies
         ):
@@ -135,8 +138,9 @@ def size_preamble(size: int, dtype: str) -> str:
 def format_record(rec: BenchmarkRecord) -> str:
     """Per-size results block ≙ reference `matmul_scaling_benchmark.py:308-335`."""
     rec.finalize()
+    shape = rec.extras.get("shape") or f"{rec.size}x{rec.size}"
     lines = [
-        f"\nResults for {rec.size}x{rec.size} [{rec.mode}]:",
+        f"\nResults for {shape} [{rec.mode}]:",
         f"  - Average time per operation: {rec.avg_time_s * 1e3:.3f} ms",
     ]
     if rec.algbw_gbps is None:  # FLOP benchmark; collectives do no matmul
@@ -144,11 +148,12 @@ def format_record(rec: BenchmarkRecord) -> str:
         ops_name, ops_unit = (
             ("FLOPs", "TFLOPs") if unit == "TFLOPS" else ("ops", "Tops")
         )
+        flops = rec.flops_per_op if rec.flops_per_op is not None \
+            else matmul_flops(rec.size)
         lines += [
             f"  - {unit} per device: {rec.tflops_per_device:.2f}",
             f"  - Total {unit} ({rec.world} device(s)): {rec.tflops_total:.2f}",
-            f"  - {ops_name} per operation: "
-            f"{matmul_flops(rec.size) / 1e12:.2f} {ops_unit}",
+            f"  - {ops_name} per operation: {flops / 1e12:.2f} {ops_unit}",
         ]
     if rec.algbw_gbps is not None:
         bus = f", bus {rec.busbw_gbps:.2f} GB/s" if rec.busbw_gbps is not None else ""

@@ -54,6 +54,8 @@ class BenchConfig:
     profile_dir: str | None = None
     percentiles: bool = False
     validate: bool = False
+    # int8-wire all_reduce for the gradient-sync modes (EQuARX-flavored)
+    comm_quant: str | None = None
     # Pallas kernel block override (None → kernel defaults); ignored by --matmul-impl xla
     block_m: int | None = None
     block_n: int | None = None
@@ -133,6 +135,13 @@ def build_parser(
              "live)",
     )
     p.add_argument(
+        "--comm-quant", type=str, default=None, choices=["none", "int8"],
+        help="Quantize all_reduce wire traffic (int8 payloads + per-row "
+             "scales over a ring — half the bf16 bytes at ~d/254 relative "
+             "error; parallel/quantized.py). Applies to the psum modes "
+             "(batch_parallel, data_parallel, model_parallel).",
+    )
+    p.add_argument(
         "--percentiles", action="store_true",
         help="Also measure per-iteration latency percentiles (p50/p90/p99) — "
              "exposes jitter that the whole-loop mean hides",
@@ -169,6 +178,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         profile_dir=getattr(args, "profile_dir", None),
         percentiles=getattr(args, "percentiles", False),
         validate=getattr(args, "validate", False),
+        comm_quant=getattr(args, "comm_quant", None),
         block_m=getattr(args, "block_m", None),
         block_n=getattr(args, "block_n", None),
         block_k=getattr(args, "block_k", None),

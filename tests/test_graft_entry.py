@@ -1,7 +1,14 @@
 """Driver contract: entry() compiles; dryrun_multichip runs on the CPU mesh."""
 
+import os
+import subprocess
+import sys
+from pathlib import Path
+
 import jax
 import numpy as np
+
+REPO = Path(__file__).parent.parent
 
 
 def test_entry_compiles():
@@ -19,3 +26,29 @@ def test_dryrun_multichip_8(capsys):
 
     ge.dryrun_multichip(8)
     assert "passed" in capsys.readouterr().out
+
+
+def test_dryrun_self_bootstraps_from_short_platform():
+    """The round-1 driver failure mode: the caller's process initialized JAX
+    on a platform with fewer than n devices (the 1-chip tunneled TPU). The
+    fixed dryrun must respawn itself on an 8-device virtual CPU mesh and
+    succeed rather than assert. Simulated here with a 1-device CPU parent."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"  # 1 device — too few, like the driver's TPU
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax\n"
+         "assert len(jax.devices()) == 1, jax.devices()\n"
+         "import importlib.util\n"
+         "spec = importlib.util.spec_from_file_location("
+         "'__graft_entry__', '__graft_entry__.py')\n"
+         "mod = importlib.util.module_from_spec(spec)\n"
+         "spec.loader.exec_module(mod)\n"
+         "mod.dryrun_multichip(8)\n"
+         "print('DRIVER_CONTRACT_OK')\n"],
+        cwd=str(REPO), env=env, text=True, capture_output=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "DRIVER_CONTRACT_OK" in out.stdout
+    assert "dryrun_multichip(8) passed" in out.stdout

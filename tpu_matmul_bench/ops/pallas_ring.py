@@ -94,14 +94,21 @@ def _ring_kernel(d: int, axis: str, use_barrier: bool, x_ref, w_ref, o_ref,
                         preferred_element_type=matmul_acc_dtype(o_ref.dtype))
         o_ref[pl.ds(src * mshard, mshard), :] = block.astype(o_ref.dtype)
 
+        if t + 1 < d:
+            # our outgoing copy FROM slot `cur` must drain before we ack the
+            # slot free: the left neighbor's next-hop RDMA targets exactly
+            # this slot, and an early ack would let its write race our
+            # in-flight send (corrupting the chunk delivered rightward)
+            rdma.wait_send()
+
         if t <= d - 3 and use_barrier:
-            # done reading slot `cur` — tell our writer it may reuse it
+            # done reading slot `cur` (matmul + send) — writer may reuse it
             pltpu.semaphore_signal(free_sem.at[cur], inc=1, device_id=left,
                                    device_id_type=pltpu.DeviceIdType.LOGICAL)
 
         if t + 1 < d:
-            # wait: our send drained AND the left neighbor's chunk arrived
-            rdma.wait()
+            # the left neighbor's chunk arrived in slot `nxt`
+            rdma.wait_recv()
 
 
 def ring_allgather_matmul(mesh: Mesh, axis: str = "x",

@@ -123,12 +123,18 @@ def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
         src = jax.lax.rem(my + d - t, d) if t else my
         chunk_matmul(cur, o_hbm.at[pl.ds(src * mshard, mshard), :])
 
+        if t + 1 < d:
+            # drain our outgoing send from slot `cur` before acking it free
+            # (the left neighbor's next write targets this slot; see
+            # pallas_ring._ring_kernel for the full hazard argument)
+            rdma.wait_send()
+
         if t <= d - 3 and use_barrier:
             pltpu.semaphore_signal(free_sem.at[cur], inc=1, device_id=left,
                                    device_id_type=pltpu.DeviceIdType.LOGICAL)
 
         if t + 1 < d:
-            rdma.wait()
+            rdma.wait_recv()
 
 
 # Measured on the v5e (8k bf16 sweep via utils.timing, 2026-07-29): the

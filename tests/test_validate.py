@@ -77,6 +77,37 @@ def test_int8_validation_exact(mesh):
     assert rec.extras["validation_max_rel_err"] == 0.0
 
 
+@pytest.mark.parametrize("table,mode", [
+    ("scaling", "batch_parallel"),
+    ("distributed", "data_parallel"),
+    ("distributed", "model_parallel"),
+])
+def test_quantized_comm_validates_and_tolerance_scales(mesh, table, mode):
+    # int8-wire psum error grows ~d/254 per hop; at d=8 the worst case
+    # (3.1%) exceeds the fixed bf16 tolerance (3e-2), so the validation
+    # tolerance must scale with the reduction width (ADVICE r1)
+    modes = SCALING_MODES if table == "scaling" else DISTRIBUTED_MODES
+    cfg = _cfg(extra=["--comm-quant", "int8"])
+    rec = run_mode_benchmark(modes[mode](cfg, mesh, SIZE), cfg)
+    assert rec.extras["validation"] == "ok", rec.extras
+    assert rec.extras["comm_quant"] == "int8"
+    d = mesh.shape["x"]
+    assert rec.extras["validation_tolerance"] >= 2 * d / 254
+
+
+def test_int8_dtype_with_quantized_comm_is_exact(mesh):
+    # integer inputs bypass the quantized wire (summed exactly via lax.psum)
+    # — and that exact path must still satisfy the sharded out_specs' vma
+    # (regression: invariant psum output under varying_out failed tracing)
+    cfg = _cfg(dtype="int8", extra=["--comm-quant", "int8"])
+    rec = run_mode_benchmark(DISTRIBUTED_MODES["data_parallel"](cfg, mesh,
+                                                                SIZE), cfg)
+    assert rec.extras["validation"] == "ok", rec.extras
+    assert rec.extras["validation_max_rel_err"] == 0.0
+    # the exact path keeps the exact tolerance — no quantized-wire headroom
+    assert rec.extras["validation_tolerance"] == 0.0
+
+
 def test_matmul_benchmark_cli_validates(mesh):
     from tpu_matmul_bench.benchmarks import matmul_benchmark
 

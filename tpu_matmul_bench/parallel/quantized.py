@@ -89,11 +89,41 @@ def quantized_psum(x: jax.Array, axis_name: str) -> jax.Array:
     return _dequantize(q_all, s_all).astype(x.dtype).reshape(orig_shape)
 
 
-def psum_impl(comm_quant: str | None):
+def uses_quantized_comm(config) -> bool:
+    """Whether a BenchConfig selects a quantized-wire collective (the one
+    normalization of --comm-quant's None/"none" defaults)."""
+    return bool(config.comm_quant and config.comm_quant != "none")
+
+
+def _psum_varying(x: jax.Array, axis_name: str) -> jax.Array:
+    """Exact lax.psum cast to varying-over-axis, for shard_map bodies whose
+    out_specs shard the axis (lax.psum output is axis-invariant)."""
+    return lax.pcast(lax.psum(x, axis_name), axis_name, to="varying")
+
+
+def psum_impl(comm_quant: str | None, varying_out: bool = False):
     """The psum implementation a mode should use: exact lax.psum, or the
-    int8-wire ring when --comm-quant int8 is given."""
+    int8-wire ring when --comm-quant int8 is given.
+
+    `varying_out=True` returns a callable whose output vma is varying over
+    the axis either way — the quantized ring's output is already varying
+    (it ends in an all_gather of per-device chunks), while exact psum needs
+    a pcast; callers with sharded out_specs must not pcast again (pcast
+    varying→varying is an error)."""
     if comm_quant in (None, "none"):
-        return lax.psum
+        return _psum_varying if varying_out else lax.psum
     if comm_quant == "int8":
-        return quantized_psum
+        if not varying_out:
+            return quantized_psum
+
+        def int8_varying(x: jax.Array, axis_name: str) -> jax.Array:
+            # integer inputs take quantized_psum's exact lax.psum path,
+            # whose output is axis-invariant and needs the same pcast as
+            # the plain-psum branch; the float ring ends in all_gather and
+            # is varying already
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                return _psum_varying(x, axis_name)
+            return quantized_psum(x, axis_name)
+
+        return int8_varying
     raise ValueError(f"unknown comm quantization {comm_quant!r}")

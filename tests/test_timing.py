@@ -4,7 +4,13 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from tpu_matmul_bench.utils.timing import Timing, time_jitted, time_legs
+from tpu_matmul_bench.utils.timing import (
+    Timing,
+    time_jitted,
+    time_legs,
+    time_variants,
+    time_variants_n,
+)
 
 
 def test_timing_properties():
@@ -58,6 +64,38 @@ def test_time_legs_chain_and_split():
     # chain correctness: comm receives compute's output
     out = comm(compute(a, a))
     assert jnp.allclose(out, (a @ a) * 2)
+
+
+def test_time_variants_n_median_of_repeats():
+    @jax.jit
+    def f(a, b):
+        return a @ b
+
+    @jax.jit
+    def g(a, b):
+        return (a @ b) + a
+
+    a = jnp.ones((64, 64))
+    ts = time_variants_n((f, g), (a, a), iterations=2, warmup=1, repeats=3)
+    assert len(ts) == 2
+    for t in ts:
+        assert t.total_s > 0
+
+
+def test_time_variants_comm_split_nonnegative():
+    @jax.jit
+    def f(a, b):
+        return a @ b
+
+    @jax.jit
+    def g(a, b):
+        return (a @ b) + a
+
+    a = jnp.ones((64, 64))
+    t_c, t_f, comm = time_variants(f, g, (a, a), iterations=2, warmup=1,
+                                   repeats=3)
+    assert comm >= 0.0
+    assert t_c.total_s > 0 and t_f.total_s > 0
 
 
 def test_time_legs_requires_legs():

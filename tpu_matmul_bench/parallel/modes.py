@@ -38,6 +38,7 @@ from tpu_matmul_bench.utils.timing import (
     latency_percentiles_ms,
     time_jitted,
     time_variants,
+    time_variants_n,
 )
 
 
@@ -57,6 +58,14 @@ class ModeSetup:
     # reference (None → not applicable, e.g. scan programs whose outputs
     # are per-step scalars)
     validate: Callable[[], dict] | None = None
+    # third program variant: the full program's structure WITHOUT its
+    # collective. When present, comm = full − nocomm (the collective alone)
+    # and overhead = nocomm − compute (ring/scan machinery), so program
+    # overhead is never charged to comm_time_s (VERDICT r1 #7)
+    nocomm: Callable[..., Any] | None = None
+    # steps one timed program call represents (scan programs); per-step
+    # extras divide by this
+    steps_per_program: int = 1
 
 
 # --validate corner size ≙ the reference's 10×10 spot check
@@ -546,12 +555,29 @@ def run_mode_benchmark(setup: ModeSetup, config: BenchConfig) -> BenchmarkRecord
                 setup.compute, setup.operands, config)
         rec.extras.update(verdict)
         return rec
-    t_compute, t_full, comm_s = time_variants(
-        setup.compute, setup.full, setup.operands,
-        iterations=config.iterations, warmup=config.warmup,
-    )
+    t_nocomm = None
+    if setup.nocomm is not None:
+        # 3-variant split: comm is isolated as full − nocomm (identical
+        # program structure, collective removed), and the structure's own
+        # cost is reported separately instead of polluting comm_time_s
+        t_compute, t_nocomm, t_full = time_variants_n(
+            (setup.compute, setup.nocomm, setup.full), setup.operands,
+            iterations=config.iterations, warmup=config.warmup,
+        )
+        comm_s = max(t_full.avg_s - t_nocomm.avg_s, 0.0)
+        overhead_s = max(t_nocomm.avg_s - t_compute.avg_s, 0.0)
+    else:
+        t_compute, t_full, comm_s = time_variants(
+            setup.compute, setup.full, setup.operands,
+            iterations=config.iterations, warmup=config.warmup,
+        )
+        overhead_s = None
     rec = setup.build_record(t_compute, t_full, comm_s)
-    if not (t_compute.reliable and t_full.reliable):
+    if overhead_s is not None:
+        rec.extras["overhead_time_s"] = round(
+            overhead_s / setup.steps_per_program, 9)
+    if not (t_compute.reliable and t_full.reliable
+            and (t_nocomm is None or t_nocomm.reliable)):
         rec.extras["timing_reliable"] = False
     if config.percentiles:
         rec.extras["latency_ms"] = latency_percentiles_ms(

@@ -121,7 +121,13 @@ def _steps_program(mesh: Mesh, variant: str, steps: int, impl: str = "xla",
         return smap(body, mesh, in_specs=(P("x"), P("x")), out_specs=P("x"),
                     check_vma=False)
 
-    if variant in ("overlap", "pipeline"):
+    if variant in ("overlap", "pipeline", "overlap_nocomm",
+                   "pipeline_nocomm"):
+        # *_nocomm: identical ring machinery with the collective removed —
+        # the third timing variant that isolates comm = full − nocomm and
+        # overhead = nocomm − compute (VERDICT r1 #7)
+        with_comm = not variant.endswith("_nocomm")
+
         def body(a, b, ring0):
             k = ring0.shape[0]
 
@@ -129,11 +135,16 @@ def _steps_program(mesh: Mesh, variant: str, steps: int, impl: str = "xla",
                 slot = i % k
                 oldest = jax.lax.dynamic_index_in_dim(ring, slot, axis=0,
                                                       keepdims=False)
-                # all_reduce the oldest in-flight product; deliberately NO
-                # dependency with this step's matmul — XLA's latency-hiding
-                # scheduler overlaps them (the dataflow analogue of the
-                # two-stream trick, :129-144)
-                r = jax.lax.psum(oldest, "x")
+                if with_comm:
+                    # all_reduce the oldest in-flight product; deliberately
+                    # NO dependency with this step's matmul — XLA's
+                    # latency-hiding scheduler overlaps them (the dataflow
+                    # analogue of the two-stream trick, :129-144)
+                    r = jax.lax.psum(oldest, "x")
+                else:
+                    # keep the slice materialized so only the collective
+                    # is missing from this variant's cost
+                    r = jax.lax.optimization_barrier(oldest)
                 c_new = mm(a[slot % a.shape[0]], b[slot % b.shape[0]])
                 ring = jax.lax.dynamic_update_index_in_dim(ring, c_new, slot,
                                                            axis=0)
@@ -185,6 +196,12 @@ def overlap_mode(config: BenchConfig, mesh: Mesh, size: int, variant: str,
                              config.blocks)
     full = _steps_program(mesh, variant, steps_per_call, impl,
                           config.blocks)
+    # the ring variants get the 3rd (structure-without-collective) program,
+    # so their comm_time_s is the collective alone — scan/ring overhead is
+    # reported as extras.overhead_time_s instead (VERDICT r1 #7)
+    nocomm = (_steps_program(mesh, f"{variant}_nocomm", steps_per_call, impl,
+                             config.blocks)
+              if variant in ("overlap", "pipeline") else None)
     # compute program takes (a, b) only; wrap so both share `operands`
     compute_fn = (lambda a, b, ring0=None: compute(a, b)) \
         if len(operands) == 3 else compute
@@ -192,7 +209,10 @@ def overlap_mode(config: BenchConfig, mesh: Mesh, size: int, variant: str,
     def build(t_compute: Timing, t_full: Timing | None, comm_s: float) -> BenchmarkRecord:
         total_s = (t_full.avg_s if t_full else t_compute.avg_s) / steps_per_call
         compute_s = t_compute.avg_s / steps_per_call
-        comm_step = max(total_s - compute_s, 0.0)
+        # comm_s comes from the runner's variant split: full − nocomm when
+        # the 3rd program exists (the collective alone), full − compute
+        # otherwise
+        comm_step = comm_s / steps_per_call
         per_dev = calculate_tflops(size, total_s)  # one matmul per device-step
         overhead = 100.0 * comm_step / total_s if total_s > 0 else 0.0
         return BenchmarkRecord(
@@ -215,7 +235,8 @@ def overlap_mode(config: BenchConfig, mesh: Mesh, size: int, variant: str,
 
     return ModeSetup(variant, operands, compute_fn, full, build,
                      memory_gib_per_device=estimate_memory_gib(
-                         variant, config, d, size))
+                         variant, config, d, size),
+                     nocomm=nocomm, steps_per_program=steps_per_call)
 
 
 # ---------------------------------------------------------------------------

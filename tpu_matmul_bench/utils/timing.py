@@ -153,6 +153,38 @@ def time_jitted(
     )
 
 
+def time_variants_n(
+    fns: Sequence[Callable[..., Any]],
+    args: Sequence[Any],
+    *,
+    iterations: int = 50,
+    warmup: int = 10,
+    repeats: int = 3,
+) -> list[Timing]:
+    """Time several program variants interleaved, median-of-`repeats` each.
+
+    A/B comparisons between separately timed programs are noise-limited by
+    run-to-run variance (~1% on the chip ≈ 0.5 ms at 16k — the same order
+    as a small comm leg). Interleaving the variants round-robin and taking
+    each variant's median-by-avg spreads drift (clock ramps, neighbors)
+    across all variants instead of biasing one, and the median rejects a
+    single slow outlier round. Warmup (incl. compile) happens only in the
+    first round — later rounds reuse the jit cache.
+    """
+    rounds = []
+    for r in range(repeats):
+        rounds.append([
+            time_jitted(fn, args, iterations=iterations,
+                        warmup=warmup if r == 0 else 1)
+            for fn in fns
+        ])
+    out = []
+    for i in range(len(fns)):
+        ts = sorted((row[i] for row in rounds), key=lambda t: t.avg_s)
+        out.append(ts[len(ts) // 2])
+    return out
+
+
 def time_variants(
     compute_fn: Callable[..., Any],
     full_fn: Callable[..., Any],
@@ -160,17 +192,20 @@ def time_variants(
     *,
     iterations: int = 50,
     warmup: int = 10,
+    repeats: int = 3,
 ) -> tuple[Timing, Timing, float]:
     """Compute/comm split via program variants (the XLA-native split, SURVEY §7).
 
     Times the compute-only program and the full (serialized compute+comm)
-    program under identical protocol; returns (compute, full, comm_seconds)
+    program under identical protocol — interleaved, median-of-`repeats`
+    (see `time_variants_n`) — and returns (compute, full, comm_seconds)
     where comm = max(full − compute, 0) per iteration. The full program must
     serialize its legs (e.g. with `optimization_barrier`) for the difference
     to equal the comm leg — the builders in `parallel.modes` do this.
     """
-    t_compute = time_jitted(compute_fn, args, iterations=iterations, warmup=warmup)
-    t_full = time_jitted(full_fn, args, iterations=iterations, warmup=warmup)
+    t_compute, t_full = time_variants_n(
+        (compute_fn, full_fn), args,
+        iterations=iterations, warmup=warmup, repeats=repeats)
     comm_s = max(t_full.avg_s - t_compute.avg_s, 0.0)
     return t_compute, t_full, comm_s
 

@@ -73,3 +73,10 @@ def test_parse_dtype():
     assert parse_dtype("int8") == jnp.int8
     with pytest.raises(ValueError):
         parse_dtype("int4")
+
+
+def test_precision_flag():
+    assert parse_config([], "d").precision == "default"
+    assert parse_config(["--precision", "highest"], "d").precision == "highest"
+    with pytest.raises(SystemExit):
+        parse_config(["--precision", "float64"], "d")

@@ -11,6 +11,7 @@ import traceback
 from typing import Callable, Iterable
 
 from tpu_matmul_bench.utils.config import BenchConfig
+from tpu_matmul_bench.utils.device import apply_matmul_precision
 from tpu_matmul_bench.utils.errors import is_oom_error, release_device_memory
 from tpu_matmul_bench.utils.reporting import (
     BenchmarkRecord,
@@ -39,6 +40,8 @@ def run_sizes(
     subsequent allocations, so the guard is sturdier than try/except alone
     (which remains as the backstop).
     """
+    # must precede tracing: every program's jit cache keys on the precision
+    apply_matmul_precision(config.precision)
     records: list[BenchmarkRecord] = []
     with JsonWriter(config.json_out) as jw:
         for size in sizes if sizes is not None else config.sizes:
@@ -65,6 +68,8 @@ def run_sizes(
                     report(traceback.format_exc())
                 release_device_memory()
                 continue
+            if config.precision != "default":
+                rec.extras["precision"] = config.precision
             records.append(rec)
             jw.write(rec)
             report(format_record(rec))

@@ -56,6 +56,11 @@ class BenchConfig:
     validate: bool = False
     # int8-wire all_reduce for the gradient-sync modes (EQuARX-flavored)
     comm_quant: str | None = None
+    # matmul precision: "default" lets the TPU backend lower fp32 dots onto
+    # the bf16 MXU path (xla_allow_excess_precision); "highest" forces true
+    # fp32 multi-pass so the reference's bf16-vs-fp32 gap (README.md:50) is
+    # actually measurable
+    precision: str = "default"
     # Pallas kernel block override (None → kernel defaults); ignored by --matmul-impl xla
     block_m: int | None = None
     block_n: int | None = None
@@ -142,6 +147,15 @@ def build_parser(
              "(batch_parallel, data_parallel, model_parallel).",
     )
     p.add_argument(
+        "--precision", type=str, default="default",
+        choices=["default", "high", "highest"],
+        help="Matmul precision (jax.default_matmul_precision). On TPU, "
+             "fp32 dots lower to the bf16 MXU path by default "
+             "(xla_allow_excess_precision); --precision highest forces "
+             "strict-fp32 multi-pass lowering, reproducing the reference's "
+             "bf16-vs-fp32 comparison (README.md:50) with a real gap.",
+    )
+    p.add_argument(
         "--percentiles", action="store_true",
         help="Also measure per-iteration latency percentiles (p50/p90/p99) — "
              "exposes jitter that the whole-loop mean hides",
@@ -179,6 +193,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         percentiles=getattr(args, "percentiles", False),
         validate=getattr(args, "validate", False),
         comm_quant=getattr(args, "comm_quant", None),
+        precision=getattr(args, "precision", "default"),
         block_m=getattr(args, "block_m", None),
         block_n=getattr(args, "block_n", None),
         block_k=getattr(args, "block_k", None),

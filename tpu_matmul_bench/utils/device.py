@@ -33,6 +33,23 @@ class DeviceInfo:
     memory_gib: float | None  # per-device HBM, when the backend reports it
 
 
+def apply_matmul_precision(precision: str | None) -> None:
+    """--precision → `jax.default_matmul_precision` (VERDICT r1 #5).
+
+    "highest" forces strict-fp32 dot lowering where the TPU backend would
+    otherwise run fp32 dots on the bf16 MXU path (xla_allow_excess_precision),
+    so the reference's ~5× bf16-vs-fp32 insight (README.md:50) is
+    reproducible with a real gap. Applied process-globally before tracing;
+    "default"/None leave the backend's policy untouched.
+    """
+    if precision and precision != "default":
+        jax.config.update("jax_default_matmul_precision", precision)
+    else:
+        # explicit reset: in-process multi-config runs (compare driver,
+        # tests) must not inherit a previous row's precision
+        jax.config.update("jax_default_matmul_precision", None)
+
+
 def platform_name(devices: Sequence[jax.Device] | None = None) -> str:
     """Platform of the (first) benchmark device: 'tpu', 'gpu', or 'cpu'."""
     devices = list(devices) if devices is not None else jax.devices()

@@ -28,15 +28,22 @@ def _run(module_main, argv: list[str]) -> list[BenchmarkRecord]:
 
 
 def compare(size: int, dtype: str, num_devices: int | None,
-            iterations: int, warmup: int) -> dict[str, BenchmarkRecord]:
+            iterations: int, warmup: int,
+            precision: str = "default") -> dict[str, BenchmarkRecord]:
+    import jax
+
     from tpu_matmul_bench.benchmarks import (
         matmul_benchmark,
+        matmul_distributed_benchmark,
+        matmul_hybrid_benchmark,
         matmul_overlap_benchmark,
         matmul_scaling_benchmark,
     )
 
+    world = num_devices or len(jax.devices())
     common = ["--sizes", str(size), "--dtype", dtype,
-              "--iterations", str(iterations), "--warmup", str(warmup)]
+              "--iterations", str(iterations), "--warmup", str(warmup),
+              "--precision", precision]
     base = common + (["--num-devices", str(num_devices)] if num_devices else [])
 
     results: dict[str, BenchmarkRecord] = {}
@@ -51,25 +58,51 @@ def compare(size: int, dtype: str, num_devices: int | None,
         for rec in _run(matmul_scaling_benchmark.main, base + ["--mode", mode]):
             results[mode] = rec
 
+    # the distributed-benchmark rows the reference's compare also runs
+    # (backup/compare_benchmarks.py:37-49 runs its data_parallel variant)
+    for mode in ("data_parallel", "model_parallel"):
+        report(f"\n### distributed: {mode} " + "#" * 40)
+        for rec in _run(matmul_distributed_benchmark.main,
+                        base + ["--mode", mode]):
+            results[mode] = rec
+
+    # 2-D dp×tp composed sharding (beyond the reference's 1-D modes);
+    # the gate mirrors make_hybrid_mesh's requirement: dp divides the world
+    # and tp = world/dp is at least 1 more axis worth of devices
+    hybrid_dp = 2
+    if world > hybrid_dp and world % hybrid_dp == 0:
+        report("\n### hybrid (dp x tp) " + "#" * 40)
+        for rec in _run(matmul_hybrid_benchmark.main,
+                        base + ["--dp", str(hybrid_dp)]):
+            results["hybrid"] = rec
+    else:
+        report(f"\n### hybrid skipped (needs a device count divisible by "
+               f"dp={hybrid_dp} with tp ≥ 2, have {world})")
+
     for mode in ("no_overlap", "overlap", "pipeline", "collective_matmul",
                  "collective_matmul_rs"):
         report(f"\n### overlap: {mode} " + "#" * 40)
         for rec in _run(matmul_overlap_benchmark.main, base + ["--mode", mode]):
             results[mode] = rec
 
-    # pallas_ring is VMEM-resident — run it at the largest size that fits
+    # pallas_ring is VMEM-resident; when its cap is far below the headline
+    # size the row would be dispatch-bound noise (timing_reliable=false at
+    # ~1k on the tunneled chip — VERDICT r1), so it only runs when the
+    # headline size fits; the HBM-blocked rings below carry the full-size
+    # in-kernel-RDMA story either way
     from tpu_matmul_bench.parallel.overlap import pallas_ring_max_size
-    import jax
 
-    ring_size = size
-    if jax.default_backend() == "tpu":
-        ring_size = min(size, pallas_ring_max_size(num_devices or 1, dtype))
-    ring_args = [a if a != str(size) else str(ring_size) for a in base]
-    report(f"\n### overlap: pallas_ring (size {ring_size}) " + "#" * 30)
-    for rec in _run(matmul_overlap_benchmark.main, ring_args + ["--mode", "pallas_ring"]):
-        if ring_size != size:
-            rec.extras["note"] = f"run at {ring_size} (VMEM-resident kernel), not {size}"
-        results["pallas_ring"] = rec
+    ring_cap = (pallas_ring_max_size(world, dtype)
+                if jax.default_backend() == "tpu" else size)
+    if size <= ring_cap:
+        report(f"\n### overlap: pallas_ring " + "#" * 40)
+        for rec in _run(matmul_overlap_benchmark.main,
+                        base + ["--mode", "pallas_ring"]):
+            results["pallas_ring"] = rec
+    else:
+        report(f"\n### overlap: pallas_ring skipped — VMEM-resident cap "
+               f"~{ring_cap} < {size}; see pallas_ring_hbm for the "
+               f"full-size in-kernel ring")
 
     # the HBM-blocked in-kernel rings have no VMEM cap — run the full size
     for hbm_mode in ("pallas_ring_hbm", "pallas_ring_rs_hbm"):
@@ -88,7 +121,7 @@ def compare(size: int, dtype: str, num_devices: int | None,
         report(f"\n### single-device {dt} " + "#" * 40)
         sweep_args = ["--sizes", str(size), "--dtype", dt,
                       "--iterations", str(iterations), "--warmup", str(warmup),
-                      "--num-devices", "1"]
+                      "--precision", precision, "--num-devices", "1"]
         for rec in _run(matmul_benchmark.main, sweep_args):
             results[f"single_{dt}"] = rec
 
@@ -189,6 +222,11 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
     p.add_argument("--num-devices", type=int, default=None)
     p.add_argument("--iterations", type=int, default=50)
     p.add_argument("--warmup", type=int, default=10)
+    p.add_argument("--precision", type=str, default="default",
+                   choices=["default", "high", "highest"],
+                   help="matmul precision for every row incl. the dtype "
+                        "sweep — 'highest' makes the fp32 rows strict-fp32 "
+                        "so the bf16-vs-fp32 line shows the real gap")
     p.add_argument("--json-out", type=str, default=None,
                    help="write the comparison table as JSON lines")
     p.add_argument("--markdown-out", type=str, default=None,
@@ -197,7 +235,8 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
     args = p.parse_args(argv)
 
     results = compare(args.size, args.dtype, args.num_devices,
-                      args.iterations, args.warmup)
+                      args.iterations, args.warmup,
+                      precision=args.precision)
     report(summarize(results))
     if args.markdown_out:
         with open(args.markdown_out, "w") as fh:

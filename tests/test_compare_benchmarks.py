@@ -38,8 +38,9 @@ def test_render_markdown_reference_table_shape():
         return r
 
     ring = rec("pallas_ring", 90.0, 11.3)
-    ring.size = 4096  # rerun at its VMEM-limited size, not the headline 16384
-    ring.extras["note"] = "run at 4096 (VMEM-resident kernel), not 16384"
+    # the real producers are the batch-growth notes (parallel/modes.py:312,
+    # parallel/hybrid.py:92); any extras['note'] must surface as a footnote
+    ring.extras["note"] = "global batch grown from 4 to 8 to cover 8 devices"
     md = render_markdown({
         "single": rec("single", 190.0, 190.0),
         "independent": rec("independent", 1500.0, 187.5, scaling=99.0),
@@ -50,9 +51,9 @@ def test_render_markdown_reference_table_shape():
     })
     assert "| independent | 1500.0 | 187.5 | 99% |" in md
     assert "| matrix_parallel | 180.0 | 22.5 | N/A |" in md
-    # off-headline-size rows are labeled and their caveat surfaces
-    assert "| pallas_ring (at 4096x4096) | 90.0 | 11.3 | N/A |" in md
-    assert "VMEM-resident kernel" in md
+    # per-row caveats surface as footnotes under the table
+    assert "| pallas_ring | 90.0 | 11.3 | N/A |" in md
+    assert "global batch grown from 4 to 8" in md
     assert "single_bfloat16" not in md  # dtype rows fold into the speedup line
     assert "bf16 vs fp32 speedup: 5.00x" in md
 

@@ -56,26 +56,29 @@ def test_bad_shapes():
 def test_tuned_blocks_table():
     from tpu_matmul_bench.ops.pallas_matmul import tuned_blocks
 
-    # measured winners on the v5e chip (tune CLI, RESULTS_TPU.md)
-    assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite") == (512, 2048, 512)
-    assert tuned_blocks(8192, 8192, 8192, "TPU v5 lite") == (1024, 1024, 512)
-    assert tuned_blocks(4096, 4096, 4096, "TPU v5 lite") == (512, 2048, 512)
+    # measured winners on the v5e chip (tune CLI r2, RESULTS_TPU.md)
+    assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite") == (4096, 2048, 512)
+    assert tuned_blocks(8192, 8192, 8192, "TPU v5 lite") == (2048, 2048, 512)
+    assert tuned_blocks(4096, 4096, 4096, "TPU v5 lite") == (1024, 2048, 512)
     # between tuned rows: the largest row ≤ min dim applies
-    assert tuned_blocks(12288, 12288, 12288, "TPU v5 lite") == (1024, 1024, 512)
+    assert tuned_blocks(12288, 12288, 12288, "TPU v5 lite") == (2048, 2048, 512)
     # unknown chip / interpreter and sub-table sizes fall back to the baseline
     assert tuned_blocks(16384, 16384, 16384, "cpu") == (512, 512, 512)
     assert tuned_blocks(512, 512, 512, "TPU v5 lite") == (512, 512, 512)
-    # per-dtype rows: float32 has no table (4-byte tiles would blow VMEM),
+    # per-dtype rows: float32 is untuned so far (falls back to baseline),
     # float16 shares the bf16 rows, int8 has its own measured winners
     import jax.numpy as jnp
 
     assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
                         jnp.float32) == (512, 512, 512)
     assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
-                        jnp.float16) == (512, 2048, 512)
-    for size in (4096, 8192, 16384):
-        assert tuned_blocks(size, size, size, "TPU v5 lite",
-                            jnp.int8) == (1024, 1024, 512)
+                        jnp.float16) == (4096, 2048, 512)
+    assert tuned_blocks(4096, 4096, 4096, "TPU v5 lite",
+                        jnp.int8) == (2048, 2048, 1024)
+    assert tuned_blocks(8192, 8192, 8192, "TPU v5 lite",
+                        jnp.int8) == (2048, 4096, 512)
+    assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
+                        jnp.int8) == (2048, 2048, 1024)
 
 
 def test_fuzz_shapes_vs_xla():

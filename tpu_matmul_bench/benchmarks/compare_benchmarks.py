@@ -195,15 +195,10 @@ def render_markdown(results: dict[str, BenchmarkRecord]) -> str:
             continue  # dtype-sweep rows have their own story
         scaling = (f"{rec.scaling_efficiency_pct:.0f}%"
                    if rec.scaling_efficiency_pct is not None else "N/A")
-        label = name
-        if rec.size != size:
-            # e.g. pallas_ring rerun at its VMEM-limited size — the row must
-            # not claim the headline size (the caveat lives in extras['note'])
-            label = f"{name} (at {rec.size}x{rec.size})"
         if rec.extras.get("note"):
             notes.append(f"{name}: {rec.extras['note']}")
         lines.append(
-            f"| {label} | {rec.tflops_total:.1f} | "
+            f"| {name} | {rec.tflops_total:.1f} | "
             f"{rec.tflops_per_device:.1f} | {scaling} |"
         )
     dtype_line = bf16_vs_fp32_line(results)

@@ -43,8 +43,11 @@ from tpu_matmul_bench.utils.reporting import (
 )
 from tpu_matmul_bench.utils.timing import time_jitted
 
-# Hardware-aligned candidates inside the ~16 MB VMEM budget (bf16 tiles +
-# fp32 accumulator, double-buffered inputs).
+# Hardware-aligned candidates. The kernel raises Mosaic's vmem_limit_bytes
+# to fit each tile set (pallas_matmul._vmem_limit), so the grid includes
+# large-tile blockings past the old ~16 MB budget — bigger (bm, bn) cuts HBM
+# traffic (A is re-read N/bn times, B M/bm times); candidates that exceed
+# physical VMEM fail to compile and are skipped.
 DEFAULT_CANDIDATES = [
     (512, 512, 512),
     (512, 1024, 512),
@@ -54,6 +57,14 @@ DEFAULT_CANDIDATES = [
     (512, 1024, 1024),
     (256, 1024, 512),
     (512, 2048, 512),
+    (1024, 2048, 512),
+    (2048, 1024, 512),
+    (2048, 2048, 512),
+    (1024, 1024, 1024),
+    (512, 2048, 1024),
+    (2048, 2048, 1024),
+    (2048, 4096, 512),
+    (4096, 2048, 512),
 ]
 
 

@@ -13,8 +13,10 @@ Design (per the Pallas TPU playbook):
   pallas pipeline (double-buffered by the compiler), output written on the
   last K step and downcast to the input dtype — the same
   accumulate-high/store-low contract as cuBLAS bf16 matmul.
-- 512³ blocks: A/B tiles 0.5 MB each in bf16, accumulator 1 MB fp32 — well
-  inside the ~16 MB/core VMEM budget including double buffering.
+- 512³ baseline blocks for unknown chips; on tuned chips the defaults come
+  from `_TUNED_BLOCKS`, and `vmem_limit_bytes` is raised to fit the tile set
+  (`_vmem_limit`) — the measured v5e winners use multi-MB output tiles far
+  past Mosaic's default scoped-VMEM budget.
 """
 
 from __future__ import annotations
@@ -49,20 +51,26 @@ def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref):
 DEFAULT_BLOCK = 512  # the kernel's baseline (bm, bn, bk); see module docstring
 
 # Per-device-kind tuned blockings, measured on real hardware with the `tune`
-# CLI (10 timed iterations per candidate; winners recorded in RESULTS_TPU.md).
-# Keyed by lowercase substring of jax Device.device_kind; rows are
-# (min problem dim, (bm, bn, bk)) — the largest row ≤ min(m, n, k) applies.
-# Larger-N blocks win on v5e (fewer accumulator spills per output tile);
-# ≥2 MB-tile configs like (1024, 2048, 512) exceed VMEM and fail to compile.
+# CLI (winners recorded in RESULTS_TPU.md). Keyed by lowercase substring of
+# jax Device.device_kind; rows are (min problem dim, (bm, bn, bk)) — the
+# largest row ≤ min(m, n, k) applies. Large (bm, bn) tiles win on v5e once
+# vmem_limit_bytes is raised past Mosaic's default budget (`_vmem_limit`):
+# A is re-read N/bn times and B M/bm times, so 2048²+ output tiles cut HBM
+# traffic ~3× vs the 512-class tiles the default budget allows.
 _V5E_ROWS: dict[str, list[tuple[int, tuple[int, int, int]]]] = {
-    # bf16 sweep (winners over 14 candidates, 2 rounds)
+    # bf16 sweep, 16-candidate grid incl. large tiles (r2, 20-30 iters):
+    # 4k 185.5 / 8k 194.3 / 16k 193.8 TFLOPS
     "bfloat16": [
-        (4096, (512, 2048, 512)),
-        (8192, (1024, 1024, 512)),
-        (16384, (512, 2048, 512)),
+        (4096, (1024, 2048, 512)),
+        (8192, (2048, 2048, 512)),
+        (16384, (4096, 2048, 512)),
     ],
-    # int8 sweep: (1024, 1024, 512) wins at 4k/8k/16k (283/330/349 TOPS)
-    "int8": [(4096, (1024, 1024, 512))],
+    # int8 sweep (r2): 4k 316.1 / 8k 346.0 / 16k 377.4 TOPS
+    "int8": [
+        (4096, (2048, 2048, 1024)),
+        (8192, (2048, 4096, 512)),
+        (16384, (2048, 2048, 1024)),
+    ],
 }
 _TUNED_BLOCKS: dict[str, dict[str, list[tuple[int, tuple[int, int, int]]]]] = {
     "v5 lite": _V5E_ROWS,
@@ -77,8 +85,8 @@ def tuned_blocks(
     falling back to the 512³ baseline for unknown chips (including the CPU
     interpreter), problems smaller than any tuned row, or dtypes without a
     table — float16 shares the bfloat16 rows (same operand width); float32
-    has none, since a (512, 2048) float32 tile set exceeds the VMEM budget
-    that already kills the 2 MB bf16 configs."""
+    is simply untuned so far (large 4-byte tile sets compile fine under the
+    raised `_vmem_limit`, they just haven't been swept on hardware)."""
     name = jnp.dtype(dtype).name
     if name == "float16":
         name = "bfloat16"
@@ -93,6 +101,31 @@ def tuned_blocks(
             if best is not None:
                 return best
     return (DEFAULT_BLOCK, DEFAULT_BLOCK, DEFAULT_BLOCK)
+
+
+def vmem_bytes_estimate(
+    bm: int, bn: int, bk: int, in_dtype: Any, out_dtype: Any, acc_dtype: Any
+) -> int:
+    """Worst-case VMEM footprint of one grid step: double-buffered A/B input
+    tiles, double-buffered output tile, and the persistent accumulator."""
+    in_sz = jnp.dtype(in_dtype).itemsize
+    return (
+        2 * (bm * bk + bk * bn) * in_sz
+        + 2 * bm * bn * jnp.dtype(out_dtype).itemsize
+        + bm * bn * jnp.dtype(acc_dtype).itemsize
+    )
+
+
+# Mosaic's default scoped-VMEM budget rejects tile sets past ~16 MB, but the
+# chip has more (v5e: 128 MB); raising vmem_limit_bytes to the measured need
+# unlocks large-tile blockings that halve HBM traffic (A re-read N/bn times,
+# B re-read M/bm times). Cap at the physical ceiling; infeasible candidates
+# still fail to compile and the tuner skips them.
+VMEM_LIMIT_CAP = 128 * 1024 * 1024
+
+
+def _vmem_limit(est: int) -> int:
+    return min(max(int(est * 1.4), 32 * 1024 * 1024), VMEM_LIMIT_CAP)
 
 
 def _pick_block(dim: int, preferred: int) -> int:
@@ -179,6 +212,9 @@ def pallas_matmul(
         scratch_shapes=[pltpu.VMEM((bm, bn), acc_dtype)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=_vmem_limit(
+                vmem_bytes_estimate(bm, bn, bk, a.dtype, out_dtype, acc_dtype)
+            ),
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * m * n * k,

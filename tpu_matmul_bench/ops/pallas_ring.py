@@ -66,10 +66,13 @@ def _ring_kernel(d: int, axis: str, use_barrier: bool, x_ref, w_ref, o_ref,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(barrier, 2)
 
-    comm_buf[0] = x_ref[:]  # own chunk seeds slot 0
-
     for t in range(d):
         cur, nxt = t % 2, (t + 1) % 2
+        # step 0's chunk is the device's own — compute and send it straight
+        # from the input ref (no seed copy; comm slot 0 stays untouched
+        # until the left neighbor's t=1 write, so the ack protocol is
+        # unchanged and slot `cur` is first read at t=2)
+        chunk = x_ref if t == 0 else comm_buf.at[cur]
         if t + 1 < d:
             if t >= 1 and use_barrier:
                 # right neighbor read slot `nxt` during its step t-1; wait
@@ -79,7 +82,7 @@ def _ring_kernel(d: int, axis: str, use_barrier: bool, x_ref, w_ref, o_ref,
                 pltpu.semaphore_wait(free_sem.at[nxt], 1)
             # stream the resident chunk onward while we multiply it
             rdma = pltpu.make_async_remote_copy(
-                src_ref=comm_buf.at[cur],
+                src_ref=chunk,
                 dst_ref=comm_buf.at[nxt],
                 send_sem=send_sem.at[cur],
                 recv_sem=recv_sem.at[nxt],
@@ -90,7 +93,7 @@ def _ring_kernel(d: int, axis: str, use_barrier: bool, x_ref, w_ref, o_ref,
 
         # chunk resident at step t originated at device (my - t) mod d
         src = jax.lax.rem(my + d - t, d) if t else my
-        block = jnp.dot(comm_buf[cur], w_ref[:],
+        block = jnp.dot(chunk[:], w_ref[:],
                         preferred_element_type=matmul_acc_dtype(o_ref.dtype))
         o_ref[pl.ds(src * mshard, mshard), :] = block.astype(o_ref.dtype)
 

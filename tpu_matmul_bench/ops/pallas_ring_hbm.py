@@ -27,7 +27,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tpu_matmul_bench.ops.pallas_matmul import (
     _matmul_kernel,
+    _vmem_limit,
     effective_blocks,
+    tuned_blocks,
+    vmem_bytes_estimate,
 )
 from tpu_matmul_bench.parallel.mesh import smap
 from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
@@ -37,7 +40,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
                      blocks: tuple[int, int, int],
                      x_hbm, w_hbm, o_hbm, comm_buf,
-                     seed_sem, send_sem, recv_sem, free_sem,
+                     send_sem, recv_sem, free_sem,
                      acc_ref):
     """One device's program: ring-rotate HBM-resident X chunks; per step, a
     nested VMEM pipeline multiplies the resident chunk into its Y row block.
@@ -61,11 +64,6 @@ def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(barrier, 2)
 
-    # own chunk seeds slot 0 (HBM→HBM local DMA)
-    seed = pltpu.make_async_copy(x_hbm, comm_buf.at[0], seed_sem)
-    seed.start()
-    seed.wait()
-
     if use_barrier:  # compiled TPU: the nested VMEM pipeline
         # the blocked matmul over one resident chunk: grid (M, N, K), K
         # innermost; body is the SAME kernel as ops/pallas_matmul.py, its
@@ -80,22 +78,22 @@ def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
             out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         )
 
-        def chunk_matmul(cur, o_rows):
-            pipeline(comm_buf.at[cur], w_hbm, o_rows, scratches=(acc_ref,))
+        def chunk_matmul(chunk, o_rows):
+            pipeline(chunk, w_hbm, o_rows, scratches=(acc_ref,))
     else:
         # interpreter path (emit_pipeline requires real TPU device info):
         # the same blocked accumulation, addressed directly — validates the
         # ring/addressing semantics the CPU-mesh tests check
         acc_dtype = matmul_acc_dtype(o_hbm.dtype)
 
-        def chunk_matmul(cur, o_rows):
+        def chunk_matmul(chunk, o_rows):
             for i in range(mshard // bm):
                 for j in range(nshard // bn):
                     acc = jnp.zeros((bm, bn), acc_dtype)
                     for kk in range(k // bk):
                         acc += jnp.dot(
-                            comm_buf[cur, i * bm:(i + 1) * bm,
-                                     kk * bk:(kk + 1) * bk],
+                            chunk[i * bm:(i + 1) * bm,
+                                  kk * bk:(kk + 1) * bk],
                             w_hbm[kk * bk:(kk + 1) * bk,
                                   j * bn:(j + 1) * bn],
                             preferred_element_type=acc_dtype,
@@ -105,11 +103,17 @@ def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
 
     for t in range(d):
         cur, nxt = t % 2, (t + 1) % 2
+        # step 0's chunk is the device's own: compute and send straight from
+        # the input ref — no HBM→HBM seed copy (a full-shard round trip the
+        # d=1 measurement showed costing ~5% of the matmul time). Comm slot
+        # 0 stays untouched until the left neighbor's t=1 write, so the
+        # ack protocol below is unchanged; slot `cur` is first read at t=2.
+        chunk = x_hbm if t == 0 else comm_buf.at[cur]
         if t + 1 < d:
             if t >= 1 and use_barrier:
                 pltpu.semaphore_wait(free_sem.at[nxt], 1)
             rdma = pltpu.make_async_remote_copy(
-                src_ref=comm_buf.at[cur],
+                src_ref=chunk,
                 dst_ref=comm_buf.at[nxt],
                 send_sem=send_sem.at[cur],
                 recv_sem=recv_sem.at[nxt],
@@ -121,7 +125,7 @@ def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
         # chunk resident at step t originated at device (my - t) mod d;
         # its product lands in Y rows [src·mshard, (src+1)·mshard)
         src = jax.lax.rem(my + d - t, d) if t else my
-        chunk_matmul(cur, o_hbm.at[pl.ds(src * mshard, mshard), :])
+        chunk_matmul(chunk, o_hbm.at[pl.ds(src * mshard, mshard), :])
 
         if t + 1 < d:
             # drain our outgoing send from slot `cur` before acking it free
@@ -137,20 +141,19 @@ def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
             rdma.wait_recv()
 
 
-# Measured on the v5e (8k bf16 sweep via utils.timing, 2026-07-29): the
-# nested pipeline matches the implicit pallas_call pipeline — 184-185 TFLOPS
-# for every ≥(512, 1024) blocking, 144 at 512³. (1024, 1024, 512) matches
-# the chip's tuned table in ops/pallas_matmul.py; buffer sets ≥16 MB
-# (e.g. 1024×1024×1024) fail to compile.
-HBM_RING_BLOCK = (1024, 1024, 512)
-
-
-def default_hbm_blocks(dtype) -> tuple[int, int, int]:
-    """Inner-pipeline block defaults by operand width: the measured table
-    is for ≤2-byte dtypes; a (1024, 1024) float32 tile set exceeds the VMEM
-    budget (same rule as pallas_matmul.tuned_blocks). Shared by the AG and
-    RS HBM ring kernels."""
-    return HBM_RING_BLOCK if jnp.dtype(dtype).itemsize <= 2 else (512, 512, 512)
+def default_hbm_blocks(
+    mshard: int, nshard: int, k: int, dtype, interpret: bool = False
+) -> tuple[int, int, int]:
+    """Inner-pipeline block defaults for the AG and RS HBM ring kernels:
+    the per-chip tuned table of the plain kernel, keyed by the LOCAL chunk
+    problem (the nested pipeline runs the same `_matmul_kernel` with the
+    same raised VMEM limit, so the same winners apply — measured r2 on the
+    v5e at d=1: (2048, 2048, 512)-class tiles lift the 16k ring from 181 to
+    ~188 TFLOPS vs 194 for the plain kernel). `interpret` selects the
+    512-baseline like pallas_matmul's effective-interpret keying."""
+    kind = "" if interpret or jax.default_backend() != "tpu" else \
+        jax.devices()[0].device_kind
+    return tuned_blocks(mshard, nshard, k, kind, dtype)
 
 
 def ring_allgather_matmul_hbm(
@@ -177,7 +180,8 @@ def ring_allgather_matmul_hbm(
         m = mshard * d
         bm, bn, bk = (v if v is not None else dflt for v, dflt in
                       zip((block_m, block_n, block_k),
-                          default_hbm_blocks(x_local.dtype)))
+                          default_hbm_blocks(mshard, nshard, k,
+                                             x_local.dtype, interpret)))
         blocks = effective_blocks(mshard, nshard, k, bm, bn, bk)
         out_dtype = matmul_out_dtype(x_local.dtype)
         kernel = functools.partial(_hbm_ring_kernel, d, axis, not interpret,
@@ -201,7 +205,6 @@ def ring_allgather_matmul_hbm(
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
             scratch_shapes=[
-                pltpu.SemaphoreType.DMA,
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.REGULAR((2,)),
@@ -211,6 +214,12 @@ def ring_allgather_matmul_hbm(
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
                 collective_id=1,  # distinct from pallas_ring's barrier
+                # the nested pipeline's tile set (operands/comm ring stay in
+                # HBM) — raised past Mosaic's default budget exactly like
+                # ops/pallas_matmul.py, unlocking the large-tile blockings
+                vmem_limit_bytes=_vmem_limit(vmem_bytes_estimate(
+                    *blocks, x_local.dtype, out_dtype,
+                    matmul_acc_dtype(out_dtype))),
             ),
             interpret=interpret,
         )(x_local, w_local)

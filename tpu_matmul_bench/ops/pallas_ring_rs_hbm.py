@@ -29,7 +29,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpu_matmul_bench.ops.pallas_matmul import _matmul_kernel, effective_blocks
+from tpu_matmul_bench.ops.pallas_matmul import (
+    _matmul_kernel,
+    _vmem_limit,
+    effective_blocks,
+    vmem_bytes_estimate,
+)
 from tpu_matmul_bench.ops.pallas_ring_hbm import default_hbm_blocks
 from tpu_matmul_bench.parallel.mesh import smap
 from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
@@ -202,7 +207,8 @@ def ring_reduce_scatter_matmul_hbm(
         out_dtype = matmul_out_dtype(x_local.dtype)
         bm, bn, bk = (v if v is not None else dflt for v, dflt in
                       zip((block_m, block_n, block_k),
-                          default_hbm_blocks(x_local.dtype)))
+                          default_hbm_blocks(mshard, n, klocal,
+                                             x_local.dtype, interpret)))
         blocks = effective_blocks(mshard, n, klocal, bm, bn, bk)
         kernel = functools.partial(_hbm_ring_rs_kernel, d, axis,
                                    not interpret, blocks)
@@ -234,6 +240,15 @@ def ring_reduce_scatter_matmul_hbm(
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
                 collective_id=2,  # distinct from the AG rings' barriers
+                # nested-pipeline tile set + the double-buffered accin tile
+                # (the ring pickup is a third pipeline input), raised past
+                # Mosaic's default budget as in ops/pallas_matmul.py
+                vmem_limit_bytes=_vmem_limit(
+                    vmem_bytes_estimate(
+                        *blocks, x_local.dtype, out_dtype,
+                        matmul_acc_dtype(out_dtype))
+                    + 2 * blocks[0] * blocks[1]
+                    * jnp.dtype(out_dtype).itemsize),
             ),
             interpret=interpret,
         )(x_local, w_local)

@@ -29,6 +29,7 @@ from hlo_deps import (
     reaches_opcode,
 )
 from tpu_matmul_bench.parallel.overlap import (
+    collective_matmul_bidir_program,
     collective_matmul_program,
     collective_matmul_rs_program,
     overlap_mode,
@@ -126,6 +127,29 @@ def test_collective_matmul_ring_overlaps(mesh, cm_operands):
         assert not reaches_opcode(comps, comp, p, MATMUL_OPS), (
             "a ring hop depends on a matmul product — the all-gather ring "
             "no longer streams raw chunks")
+    assert any(
+        not reaches_opcode(comps, comp, dt, ("collective-permute",))
+        for dt in dots
+    ), "every matmul waits on a hop — the resident-chunk overlap is gone"
+
+
+def test_collective_matmul_bidir_ring_overlaps(mesh, cm_operands):
+    d = mesh.shape["x"]
+    txt = compiled_text(collective_matmul_bidir_program(mesh, overlap=True),
+                        *cm_operands)
+    comps = parse_hlo(txt)
+    comp = _entry_with(comps, "collective-permute")
+    perms = instructions_of(comp, "collective-permute")
+    dots = instructions_of(comp, *MATMUL_OPS)
+    # two counter-rotating half-chunk streams: one hop per direction per
+    # step, and per step t ≥ 1 two half-chunk matmuls (plus the t=0 full
+    # resident-chunk matmul)
+    assert len(perms) == 2 * (d - 1), (len(perms), d)
+    assert len(dots) == 2 * d - 1, (len(dots), d)
+    for p in perms:
+        assert not reaches_opcode(comps, comp, p, MATMUL_OPS), (
+            "a bidirectional hop depends on a matmul product — the ring "
+            "no longer streams raw half-chunks")
     assert any(
         not reaches_opcode(comps, comp, dt, ("collective-permute",))
         for dt in dots

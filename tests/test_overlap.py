@@ -7,6 +7,7 @@ import pytest
 from tpu_matmul_bench.parallel.modes import run_mode_benchmark
 from tpu_matmul_bench.parallel.overlap import (
     OVERLAP_MODES,
+    collective_matmul_bidir_program,
     collective_matmul_program,
     collective_matmul_rs_program,
     overlap_mode,
@@ -34,6 +35,19 @@ def test_collective_matmul_matches_dense(mesh):
     want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
     overlapped = collective_matmul_program(mesh, overlap=True)
     baseline = collective_matmul_program(mesh, overlap=False)
+    np.testing.assert_allclose(np.asarray(overlapped(x, w)), want, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(baseline(x, w)), want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("size", [SIZE, 72])  # 72/8 = 9 rows: odd half-split
+def test_collective_matmul_bidir_matches_dense(mesh, size):
+    # the counter-rotating half-chunk ring must equal the dense product,
+    # including when a chunk splits into unequal forward/backward halves
+    (x,) = sharded_normal(0, (size, size), jnp.float32, mesh, P("x", None), count=1)
+    (w,) = sharded_normal(1, (size, size), jnp.float32, mesh, P(None, "x"), count=1)
+    want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+    overlapped = collective_matmul_bidir_program(mesh, overlap=True)
+    baseline = collective_matmul_bidir_program(mesh, overlap=False)
     np.testing.assert_allclose(np.asarray(overlapped(x, w)), want, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(baseline(x, w)), want, rtol=1e-4, atol=1e-4)
 

@@ -62,6 +62,9 @@ def test_tuned_blocks_table():
     assert tuned_blocks(4096, 4096, 4096, "TPU v5 lite") == (1024, 2048, 512)
     # between tuned rows: the largest row ≤ min dim applies
     assert tuned_blocks(12288, 12288, 12288, "TPU v5 lite") == (2048, 2048, 512)
+    # sharded ring chunks (min dim = size/d < 4096) hit the 1024 row, not
+    # the 512³ baseline — the d≥2 in-kernel rings must keep large tiles
+    assert tuned_blocks(2048, 2048, 16384, "TPU v5 lite") == (1024, 2048, 512)
     # unknown chip / interpreter and sub-table sizes fall back to the baseline
     assert tuned_blocks(16384, 16384, 16384, "cpu") == (512, 512, 512)
     assert tuned_blocks(512, 512, 512, "TPU v5 lite") == (512, 512, 512)

@@ -80,7 +80,7 @@ def compare(size: int, dtype: str, num_devices: int | None,
                f"dp={hybrid_dp} with tp ≥ 2, have {world})")
 
     for mode in ("no_overlap", "overlap", "pipeline", "collective_matmul",
-                 "collective_matmul_rs"):
+                 "collective_matmul_bidir", "collective_matmul_rs"):
         report(f"\n### overlap: {mode} " + "#" * 40)
         for rec in _run(matmul_overlap_benchmark.main, base + ["--mode", mode]):
             results[mode] = rec
@@ -125,6 +125,19 @@ def compare(size: int, dtype: str, num_devices: int | None,
         for rec in _run(matmul_benchmark.main, sweep_args):
             results[f"single_{dt}"] = rec
 
+    # strict-fp32 row: --precision highest forces true fp32 dot lowering
+    # (XLA's excess-precision default otherwise routes fp32 dots onto the
+    # bf16 MXU path), so the reference's bf16-vs-fp32 key insight
+    # (README.md:50, ~5×) is reproducible with a real gap
+    if precision != "highest":
+        report("\n### single-device float32 (strict lowering) " + "#" * 26)
+        strict_args = ["--sizes", str(size), "--dtype", "float32",
+                       "--iterations", str(iterations),
+                       "--warmup", str(warmup),
+                       "--precision", "highest", "--num-devices", "1"]
+        for rec in _run(matmul_benchmark.main, strict_args):
+            results["single_float32_strict"] = rec
+
     return results
 
 
@@ -135,8 +148,13 @@ def bf16_vs_fp32_line(results: dict[str, BenchmarkRecord]) -> str | None:
     bf16 = results.get("single_bfloat16")
     if not (f32 and bf16 and f32.avg_time_s > 0 and bf16.avg_time_s > 0):
         return None
-    return (f"bf16 vs fp32 speedup: {f32.avg_time_s / bf16.avg_time_s:.2f}x "
+    line = (f"bf16 vs fp32 speedup: {f32.avg_time_s / bf16.avg_time_s:.2f}x "
             f"(reference observed ~5x on the RTX 6000 Ada, README.md:50)")
+    strict = results.get("single_float32_strict")
+    if strict and strict.avg_time_s > 0:
+        line += (f"; vs strict-fp32 lowering (--precision highest): "
+                 f"{strict.avg_time_s / bf16.avg_time_s:.2f}x")
+    return line
 
 
 def summarize(results: dict[str, BenchmarkRecord]) -> str:

@@ -4,7 +4,9 @@
 Modes {no_overlap, overlap, pipeline} re-designed for XLA's async collectives
 and latency-hiding scheduler (no user streams on TPU), plus the TPU-native
 collective-matmul modes — `collective_matmul` (ppermute-ring all-gather
-matmul, the form BASELINE.json's north star names), `collective_matmul_rs`
+matmul, the form BASELINE.json's north star names),
+`collective_matmul_bidir` (counter-rotating half-chunks riding both
+directions of each full-duplex ICI link), `collective_matmul_rs`
 (its reduce-scatter dual), `pallas_ring` (in-kernel ring RDMA,
 VMEM-resident), and `pallas_ring_hbm` / `pallas_ring_rs_hbm` (in-kernel
 gather/reduce-scatter rings with HBM operands + a nested VMEM pipeline —

@@ -59,14 +59,20 @@ DEFAULT_BLOCK = 512  # the kernel's baseline (bm, bn, bk); see module docstring
 # traffic ~3× vs the 512-class tiles the default budget allows.
 _V5E_ROWS: dict[str, list[tuple[int, tuple[int, int, int]]]] = {
     # bf16 sweep, 16-candidate grid incl. large tiles (r2, 20-30 iters):
-    # 4k 185.5 / 8k 194.3 / 16k 193.8 TFLOPS
+    # 4k 185.5 / 8k 194.3 / 16k 193.8 TFLOPS. The 1024 row covers sharded
+    # ring chunks (min dim = size/d < 4096): measured at the d=8 16k chunk
+    # shape (2048, k=16384, 2048) — 187.7 TFLOPS vs 148.1 for the 512³
+    # fallback; requested blocks clamp to the actual dims.
     "bfloat16": [
+        (1024, (1024, 2048, 512)),
         (4096, (1024, 2048, 512)),
         (8192, (2048, 2048, 512)),
         (16384, (4096, 2048, 512)),
     ],
-    # int8 sweep (r2): 4k 316.1 / 8k 346.0 / 16k 377.4 TOPS
+    # int8 sweep (r2): 4k 316.1 / 8k 346.0 / 16k 377.4 TOPS; the 1024 row
+    # is the r1-measured (1024, 1024, 512) class (unswept at chunk shapes)
     "int8": [
+        (1024, (1024, 1024, 512)),
         (4096, (2048, 2048, 1024)),
         (8192, (2048, 4096, 512)),
         (16384, (2048, 2048, 1024)),

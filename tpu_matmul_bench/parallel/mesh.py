@@ -85,3 +85,10 @@ def smap(fn, mesh: Mesh, in_specs, out_specs, check_vma: bool = True):
 def ring_perm(n: int) -> list[tuple[int, int]]:
     """Unidirectional ring permutation for ppermute (d → d+1 mod n)."""
     return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_perm_rev(n: int) -> list[tuple[int, int]]:
+    """Reverse-direction ring permutation for ppermute (d → d−1 mod n) —
+    the counter-rotating half of a bidirectional ring, which uses both
+    directions of each full-duplex ICI link concurrently."""
+    return [(i, (i - 1) % n) for i in range(n)]

@@ -26,7 +26,11 @@ collectives + latency-hiding scheduler inside ONE compiled program:
   latency-hiding collective-matmul pattern; BASELINE.json's north-star names
   this form). No reference analogue — this is what the stream tricks become
   when re-designed for ICI.
-- ``collective_matmul_rs``: its reduce-scatter dual — chunked partial
+- ``collective_matmul_bidir``: the bidirectional refinement — each chunk
+  splits into two counter-rotating halves so both directions of every
+  full-duplex ICI link carry traffic concurrently, halving the per-step
+  transfer the MXU work must hide.
+- ``collective_matmul_rs``: the reduce-scatter dual — chunked partial
   products picked up by an accumulator ring (the "matmul then gradient
   sync" shape).
 - ``pallas_ring``: the all-gather ring hand-scheduled inside one Pallas
@@ -56,6 +60,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpu_matmul_bench.ops.matmul import matmul_2d
 from tpu_matmul_bench.parallel.mesh import (
     ring_perm,
+    ring_perm_rev,
     sharded_normal,
     smap,
     world_size,
@@ -348,6 +353,88 @@ def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
     )
 
 
+def collective_matmul_bidir_program(mesh: Mesh, overlap: bool = True,
+                                    impl: str = "xla",
+                                    blocks: tuple[int, int, int] | None = None):
+    """Bidirectional collective matmul: same contract as
+    `collective_matmul_program` (X row-sharded [m/D, k], W column-sharded
+    [k, n/D] → Y [m, n/D]), but each device splits its chunk into two
+    halves that counter-rotate — the top half hops d→d+1, the bottom half
+    d→d−1 — so every ring step moves only HALF a chunk per direction.
+
+    ICI links are full-duplex: both directions carry traffic concurrently,
+    so the per-step transfer time is half the unidirectional ring's while
+    the per-step MXU work (two half-chunk matmuls = one chunk) is
+    unchanged. When the unidirectional ring is comm-bound (per-chunk
+    transfer > per-chunk compute), this halves the exposed latency — the
+    bidirectional refinement of the collective-matmul pattern ("Overlap
+    Communication with Dependent Computation" / scaling-book recipe; no
+    reference analogue — CUDA streams cannot express link directions).
+
+    Step t ≥ 1 multiplies the forward half from device (my − t) mod d and
+    the backward half from device (my + t) mod d; after D−1 steps both
+    half-streams have visited every device. Odd-row chunks split unevenly
+    (⌊mshard/2⌋ forward, the rest backward) — consistent across devices,
+    so the ppermutes stay shape-uniform.
+    """
+    d = mesh.shape["x"]
+    mm = matmul_2d(impl, blocks)
+
+    def body(x_local, w_local):  # [m/d, k], [k, n/d]
+        mshard = x_local.shape[0]
+
+        if not overlap:
+            x_full = jax.lax.all_gather(x_local, "x", axis=0, tiled=True)
+            x_full = jax.lax.optimization_barrier(x_full)
+            return mm(x_full, w_local)
+
+        my = jax.lax.axis_index("x")
+        m = mshard * d
+        half = mshard // 2
+        y = jnp.zeros((m, w_local.shape[1]),
+                      dtype=matmul_out_dtype(x_local.dtype))
+        fwd = x_local[:half]      # counter-rotating half-chunk streams
+        bwd = x_local[half:]
+        for t in range(d):
+            if t + 1 < d:
+                fwd_nxt = jax.lax.ppermute(fwd, "x", ring_perm(d))
+                bwd_nxt = jax.lax.ppermute(bwd, "x", ring_perm_rev(d))
+            if t == 0:
+                # own chunk, in one full-height matmul (reads overlap the
+                # two outbound permutes — no data hazard)
+                y = jax.lax.dynamic_update_slice(
+                    y, mm(x_local, w_local), (my * mshard, 0))
+            else:
+                src_f = jax.lax.rem(my - t + d, d)   # fwd half's origin
+                src_b = jax.lax.rem(my + t, d)       # bwd half's origin
+                y = jax.lax.dynamic_update_slice(
+                    y, mm(fwd, w_local), (src_f * mshard, 0))
+                y = jax.lax.dynamic_update_slice(
+                    y, mm(bwd, w_local), (src_b * mshard + half, 0))
+            if t + 1 < d:
+                fwd, bwd = fwd_nxt, bwd_nxt
+        return y
+
+    return smap(body, mesh, in_specs=(P("x", None), P(None, "x")),
+                out_specs=P(None, "x"), check_vma=False)
+
+
+def collective_matmul_bidir_mode(config: BenchConfig, mesh: Mesh, size: int,
+                                 benchmark: str = "overlap") -> ModeSetup:
+    return _vs_baseline_mode(
+        config, mesh, size, "collective_matmul_bidir",
+        collective_matmul_bidir_program(mesh, overlap=False,
+                                        impl=config.matmul_impl,
+                                        blocks=config.blocks),
+        collective_matmul_bidir_program(mesh, overlap=True,
+                                        impl=config.matmul_impl,
+                                        blocks=config.blocks),
+        "all_gather-then-matmul",
+        {"matmul_impl": config.matmul_impl, "ring": "bidirectional"},
+        benchmark,
+    )
+
+
 def collective_matmul_rs_program(mesh: Mesh, overlap: bool = True,
                                  impl: str = "xla",
                                  blocks: tuple[int, int, int] | None = None):
@@ -510,6 +597,7 @@ OVERLAP_MODES = {
     "overlap": functools.partial(overlap_mode, variant="overlap"),
     "pipeline": functools.partial(overlap_mode, variant="pipeline"),
     "collective_matmul": collective_matmul_mode,
+    "collective_matmul_bidir": collective_matmul_bidir_mode,
     "collective_matmul_rs": collective_matmul_rs_mode,
     "pallas_ring": pallas_ring_mode,
     "pallas_ring_hbm": pallas_ring_hbm_mode,

@@ -135,8 +135,7 @@ def test_collective_matmul_ring_overlaps(mesh, cm_operands):
 
 def test_collective_matmul_bidir_ring_overlaps(mesh, cm_operands):
     d = mesh.shape["x"]
-    txt = compiled_text(collective_matmul_bidir_program(mesh, overlap=True),
-                        *cm_operands)
+    txt = compiled_text(collective_matmul_bidir_program(mesh), *cm_operands)
     comps = parse_hlo(txt)
     comp = _entry_with(comps, "collective-permute")
     perms = instructions_of(comp, "collective-permute")

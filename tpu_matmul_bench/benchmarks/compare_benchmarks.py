@@ -105,7 +105,8 @@ def compare(size: int, dtype: str, num_devices: int | None,
                f"full-size in-kernel ring")
 
     # the HBM-blocked in-kernel rings have no VMEM cap — run the full size
-    for hbm_mode in ("pallas_ring_hbm", "pallas_ring_rs_hbm"):
+    for hbm_mode in ("pallas_ring_hbm", "pallas_ring_bidir_hbm",
+                     "pallas_ring_rs_hbm"):
         report(f"\n### overlap: {hbm_mode} " + "#" * 36)
         for rec in _run(matmul_overlap_benchmark.main,
                         base + ["--mode", hbm_mode]):

@@ -1,0 +1,210 @@
+"""HBM-blocked Pallas BIDIRECTIONAL ring all-gather matmul.
+
+The in-kernel analogue of `parallel/overlap.py collective_matmul_bidir_program`
+(as `ops/pallas_ring_hbm.py` is to `collective_matmul_program`): each
+device's X chunk splits into two halves that counter-rotate — the top half
+hops right (d→d+1) through `fwd_buf`, the bottom half hops left (d→d−1)
+through `bwd_buf` — so BOTH directions of every full-duplex ICI link carry
+an RDMA concurrently and the per-step, per-direction transfer is half a
+chunk. Per step the MXU runs two half-chunk nested `emit_pipeline` matmuls
+(= one chunk of work, same as the unidirectional ring), so when the
+unidirectional ring is comm-bound this halves the exposed latency. The
+reference's CUDA streams cannot express link directions
+(`backup/matmul_overlap_benchmark.py:124-157` overlaps a single NCCL ring);
+this is the TPU-native refinement, hand-scheduled.
+
+Same contract as `ring_allgather_matmul_hbm`: Y = X·W, X row-sharded
+P(axis, None), W column-sharded P(None, axis), Y out P(None, axis).
+Per-direction ring flow control is identical to the unidirectional kernel
+(2 comm slots, ack-your-writer free-semaphore handshake, balanced counts —
+see `pallas_ring._ring_kernel` for the WAR-hazard argument); the forward
+ring acks its writer (the left neighbor), the backward ring acks the right.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_matmul_bench.ops.pallas_matmul import (
+    _vmem_limit,
+    effective_blocks,
+    vmem_bytes_estimate,
+)
+from tpu_matmul_bench.ops.pallas_ring_hbm import (
+    _chunk_pipeline,
+    default_hbm_blocks,
+)
+from tpu_matmul_bench.parallel.mesh import smap
+from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _bidir_ring_kernel(d: int, axis: str, use_barrier: bool,
+                       h: int, blocks_f: tuple[int, int, int],
+                       blocks_b: tuple[int, int, int],
+                       x_hbm, w_hbm, o_hbm, fwd_buf, bwd_buf,
+                       fsend, frecv, ffree, bsend, brecv, bfree,
+                       acc_f, acc_b):
+    """One device's program: two counter-rotating half-chunk rings, two
+    half-chunk pipelines per step. Forward ring: top halves hop to the
+    RIGHT neighbor's fwd_buf (writer = left, so fwd acks go left).
+    Backward ring: bottom halves hop LEFT (writer = right, acks go right).
+    Step 0 computes and sends straight from the input ref (no seed copy)."""
+    mshard, k = x_hbm.shape
+    nshard = w_hbm.shape[1]
+    hb = mshard - h  # backward-half rows (≥ h when mshard is odd)
+    my = jax.lax.axis_index(axis)
+    right = jax.lax.rem(my + 1, d)
+    left = jax.lax.rem(my + d - 1, d)
+
+    if use_barrier:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    run_f = _chunk_pipeline(use_barrier, h, nshard, k, blocks_f, w_hbm,
+                            o_hbm.dtype, acc_f)
+    run_b = _chunk_pipeline(use_barrier, hb, nshard, k, blocks_b, w_hbm,
+                            o_hbm.dtype, acc_b)
+
+    for t in range(d):
+        cur, nxt = t % 2, (t + 1) % 2
+        fwd_chunk = x_hbm.at[pl.ds(0, h), :] if t == 0 else fwd_buf.at[cur]
+        bwd_chunk = x_hbm.at[pl.ds(h, hb), :] if t == 0 else bwd_buf.at[cur]
+
+        if t + 1 < d:
+            if t >= 1 and use_barrier:
+                # per-direction WAR handshake (see pallas_ring docstring):
+                # the neighbor we write must have acked the slot free
+                pltpu.semaphore_wait(ffree.at[nxt], 1)
+                pltpu.semaphore_wait(bfree.at[nxt], 1)
+            rdma_f = pltpu.make_async_remote_copy(
+                src_ref=fwd_chunk, dst_ref=fwd_buf.at[nxt],
+                send_sem=fsend.at[cur], recv_sem=frecv.at[nxt],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma_b = pltpu.make_async_remote_copy(
+                src_ref=bwd_chunk, dst_ref=bwd_buf.at[nxt],
+                send_sem=bsend.at[cur], recv_sem=brecv.at[nxt],
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma_f.start()
+            rdma_b.start()
+
+        # forward half resident at step t originated at (my − t) mod d and
+        # fills the TOP h rows of that chunk's Y block; the backward half
+        # originated at (my + t) mod d and fills the BOTTOM hb rows
+        src_f = jax.lax.rem(my + d - t, d) if t else my
+        src_b = jax.lax.rem(my + t, d)
+        run_f(fwd_chunk, o_hbm.at[pl.ds(src_f * mshard, h), :])
+        run_b(bwd_chunk, o_hbm.at[pl.ds(src_b * mshard + h, hb), :])
+
+        if t + 1 < d:
+            # drain our outgoing sends before acking the slots free (the
+            # writers' next-hop RDMAs target exactly these slots)
+            rdma_f.wait_send()
+            rdma_b.wait_send()
+
+        if t <= d - 3 and use_barrier:
+            pltpu.semaphore_signal(ffree.at[cur], inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_signal(bfree.at[cur], inc=1, device_id=right,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        if t + 1 < d:
+            rdma_f.wait_recv()
+            rdma_b.wait_recv()
+
+
+def ring_allgather_matmul_bidir_hbm(
+    mesh: Mesh, axis: str = "x",
+    block_m: int | None = None,
+    block_n: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """Build the jitted shard_map'd bidirectional HBM ring kernel.
+
+    fn(x, w) with x sharded P(axis, None), w P(None, axis) → y P(None, axis).
+    Per-device VMEM footprint is the two half-pipelines' tile sets —
+    independent of the problem size, so any HBM-sized operands work.
+    Requires ≥ 2 rows per shard (a 1-row chunk cannot split)."""
+    d = mesh.shape[axis]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def per_device(x_local, w_local):
+        mshard, k = x_local.shape
+        nshard = w_local.shape[1]
+        if mshard < 2:
+            raise ValueError(
+                f"bidirectional ring needs ≥ 2 rows per shard, got {mshard}"
+                " — use the unidirectional ring_allgather_matmul_hbm")
+        m = mshard * d
+        h = mshard // 2
+        out_dtype = matmul_out_dtype(x_local.dtype)
+        bm, bn, bk = (v if v is not None else dflt for v, dflt in
+                      zip((block_m, block_n, block_k),
+                          default_hbm_blocks(h, nshard, k,
+                                             x_local.dtype, interpret)))
+        blocks_f = effective_blocks(h, nshard, k, bm, bn, bk)
+        blocks_b = effective_blocks(mshard - h, nshard, k, bm, bn, bk)
+        acc_dtype = matmul_acc_dtype(out_dtype)
+        kernel = functools.partial(_bidir_ring_kernel, d, axis,
+                                   not interpret, h, blocks_f, blocks_b)
+        y, _, _ = pl.pallas_call(
+            kernel,
+            out_shape=[
+                jax.ShapeDtypeStruct((m, nshard), out_dtype),
+                # per-direction 2-slot comm rings, in HBM as discarded
+                # outputs (Mosaic forbids HBM scratch; outputs are
+                # writable — same trick as the unidirectional kernel)
+                jax.ShapeDtypeStruct((2, h, k), x_local.dtype),
+                jax.ShapeDtypeStruct((2, mshard - h, k), x_local.dtype),
+            ],
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),      # fwd send
+                pltpu.SemaphoreType.DMA((2,)),      # fwd recv
+                pltpu.SemaphoreType.REGULAR((2,)),  # fwd free-acks
+                pltpu.SemaphoreType.DMA((2,)),      # bwd send
+                pltpu.SemaphoreType.DMA((2,)),      # bwd recv
+                pltpu.SemaphoreType.REGULAR((2,)),  # bwd free-acks
+                pltpu.VMEM((blocks_f[0], blocks_f[1]), acc_dtype),
+                pltpu.VMEM((blocks_b[0], blocks_b[1]), acc_dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=3,  # distinct from the other rings' barriers
+                # both half-pipelines' tile sets + both accumulators,
+                # raised past Mosaic's default budget as in pallas_matmul
+                vmem_limit_bytes=_vmem_limit(
+                    vmem_bytes_estimate(*blocks_f, x_local.dtype, out_dtype,
+                                        acc_dtype)
+                    + vmem_bytes_estimate(*blocks_b, x_local.dtype,
+                                          out_dtype, acc_dtype)),
+            ),
+            interpret=interpret,
+        )(x_local, w_local)
+        return y
+
+    return smap(per_device, mesh, in_specs=(P(axis, None), P(None, axis)),
+                out_specs=P(None, axis), check_vma=False)

@@ -37,6 +37,49 @@ from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _chunk_pipeline(use_barrier, rows, nshard, k, blocks, w_hbm, o_dtype,
+                    acc_ref):
+    """One resident chunk's blocked matmul: chunk_ref × w_hbm → out_ref.
+    Compiled TPU path = nested `emit_pipeline` sharing `_matmul_kernel`
+    with the plain kernel (accumulator passed through `scratches`);
+    interpreter path = the same blocked accumulation addressed directly
+    (emit_pipeline needs real TPU device info), which is what the
+    CPU-mesh tests execute. Shared by the unidirectional and
+    bidirectional AG ring kernels."""
+    bm, bn, bk = blocks
+    if use_barrier:
+        pipeline = pltpu.emit_pipeline(
+            _matmul_kernel,
+            grid=(rows // bm, nshard // bn, k // bk),
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+                pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        )
+
+        def run(chunk, o_rows):
+            pipeline(chunk, w_hbm, o_rows, scratches=(acc_ref,))
+    else:
+        acc_dtype = matmul_acc_dtype(o_dtype)
+
+        def run(chunk, o_rows):
+            for i in range(rows // bm):
+                for j in range(nshard // bn):
+                    acc = jnp.zeros((bm, bn), acc_dtype)
+                    for kk in range(k // bk):
+                        acc += jnp.dot(
+                            chunk[i * bm:(i + 1) * bm,
+                                  kk * bk:(kk + 1) * bk],
+                            w_hbm[kk * bk:(kk + 1) * bk,
+                                  j * bn:(j + 1) * bn],
+                            preferred_element_type=acc_dtype,
+                        )
+                    o_rows[i * bm:(i + 1) * bm, j * bn:(j + 1) * bn] = \
+                        acc.astype(o_dtype)
+    return run
+
+
 def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
                      blocks: tuple[int, int, int],
                      x_hbm, w_hbm, o_hbm, comm_buf,
@@ -51,7 +94,6 @@ def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
     """
     mshard, k = x_hbm.shape
     nshard = w_hbm.shape[1]
-    bm, bn, bk = blocks
     my = jax.lax.axis_index(axis)
     right = jax.lax.rem(my + 1, d)
     left = jax.lax.rem(my + d - 1, d)
@@ -64,42 +106,8 @@ def _hbm_ring_kernel(d: int, axis: str, use_barrier: bool,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(barrier, 2)
 
-    if use_barrier:  # compiled TPU: the nested VMEM pipeline
-        # the blocked matmul over one resident chunk: grid (M, N, K), K
-        # innermost; body is the SAME kernel as ops/pallas_matmul.py, its
-        # accumulator passed through `scratches`
-        pipeline = pltpu.emit_pipeline(
-            _matmul_kernel,
-            grid=(mshard // bm, nshard // bn, k // bk),
-            in_specs=[
-                pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
-                pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
-            ],
-            out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        )
-
-        def chunk_matmul(chunk, o_rows):
-            pipeline(chunk, w_hbm, o_rows, scratches=(acc_ref,))
-    else:
-        # interpreter path (emit_pipeline requires real TPU device info):
-        # the same blocked accumulation, addressed directly — validates the
-        # ring/addressing semantics the CPU-mesh tests check
-        acc_dtype = matmul_acc_dtype(o_hbm.dtype)
-
-        def chunk_matmul(chunk, o_rows):
-            for i in range(mshard // bm):
-                for j in range(nshard // bn):
-                    acc = jnp.zeros((bm, bn), acc_dtype)
-                    for kk in range(k // bk):
-                        acc += jnp.dot(
-                            chunk[i * bm:(i + 1) * bm,
-                                  kk * bk:(kk + 1) * bk],
-                            w_hbm[kk * bk:(kk + 1) * bk,
-                                  j * bn:(j + 1) * bn],
-                            preferred_element_type=acc_dtype,
-                        )
-                    o_rows[i * bm:(i + 1) * bm, j * bn:(j + 1) * bn] = \
-                        acc.astype(o_hbm.dtype)
+    chunk_matmul = _chunk_pipeline(use_barrier, mshard, nshard, k, blocks,
+                                   w_hbm, o_hbm.dtype, acc_ref)
 
     for t in range(d):
         cur, nxt = t % 2, (t + 1) % 2

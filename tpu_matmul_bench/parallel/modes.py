@@ -207,9 +207,10 @@ def estimate_memory_gib(
     if mode == "batch_parallel":
         lb = max(batch // d, 1)
         return gib(2 * lb, lb)
-    if mode == "pallas_ring_hbm":
+    if mode in ("pallas_ring_hbm", "pallas_ring_bidir_hbm"):
         # sharded operands (2/d) + the 2-slot HBM comm buffer (2/d, operand
-        # dtype) + full-size combined C + one temp (the baseline leg's
+        # dtype — the bidir form's two per-direction half-rings total the
+        # same) + full-size combined C + one temp (the baseline leg's
         # gathered X); applies at every d — the d=1 sanity config still
         # allocates the comm buffer
         return gib(4.0 / d, 2)

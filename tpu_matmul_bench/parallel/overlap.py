@@ -40,6 +40,10 @@ collectives + latency-hiding scheduler inside ONE compiled program:
   and a nested `emit_pipeline` blocked matmul per step
   (`ops/pallas_ring_hbm.py`, `ops/pallas_ring_rs_hbm.py`) — no VMEM size
   cap, so in-kernel RDMA overlap covers the full sweep.
+- ``pallas_ring_bidir_hbm``: the bidirectional in-kernel form
+  (`ops/pallas_ring_bidir_hbm.py`) — two counter-rotating half-chunk RDMA
+  streams per step, the hand-scheduled analogue of
+  ``collective_matmul_bidir``.
 
 Every variant times ONE jitted scan program of `steps_per_call` steps, so the
 host never intervenes mid-pipeline (the scan is the stream). The ring-buffer
@@ -353,8 +357,7 @@ def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
     )
 
 
-def collective_matmul_bidir_program(mesh: Mesh, overlap: bool = True,
-                                    impl: str = "xla",
+def collective_matmul_bidir_program(mesh: Mesh, impl: str = "xla",
                                     blocks: tuple[int, int, int] | None = None):
     """Bidirectional collective matmul: same contract as
     `collective_matmul_program` (X row-sharded [m/D, k], W column-sharded
@@ -375,19 +378,15 @@ def collective_matmul_bidir_program(mesh: Mesh, overlap: bool = True,
     the backward half from device (my + t) mod d; after D−1 steps both
     half-streams have visited every device. Odd-row chunks split unevenly
     (⌊mshard/2⌋ forward, the rest backward) — consistent across devices,
-    so the ppermutes stay shape-uniform.
+    so the ppermutes stay shape-uniform. The serialized baseline is the
+    same gather-then-matmul as the unidirectional form's —
+    `collective_matmul_program(mesh, overlap=False)`.
     """
     d = mesh.shape["x"]
     mm = matmul_2d(impl, blocks)
 
     def body(x_local, w_local):  # [m/d, k], [k, n/d]
         mshard = x_local.shape[0]
-
-        if not overlap:
-            x_full = jax.lax.all_gather(x_local, "x", axis=0, tiled=True)
-            x_full = jax.lax.optimization_barrier(x_full)
-            return mm(x_full, w_local)
-
         my = jax.lax.axis_index("x")
         m = mshard * d
         half = mshard // 2
@@ -423,11 +422,10 @@ def collective_matmul_bidir_mode(config: BenchConfig, mesh: Mesh, size: int,
                                  benchmark: str = "overlap") -> ModeSetup:
     return _vs_baseline_mode(
         config, mesh, size, "collective_matmul_bidir",
-        collective_matmul_bidir_program(mesh, overlap=False,
-                                        impl=config.matmul_impl,
-                                        blocks=config.blocks),
-        collective_matmul_bidir_program(mesh, overlap=True,
-                                        impl=config.matmul_impl,
+        collective_matmul_program(mesh, overlap=False,
+                                  impl=config.matmul_impl,
+                                  blocks=config.blocks),
+        collective_matmul_bidir_program(mesh, impl=config.matmul_impl,
                                         blocks=config.blocks),
         "all_gather-then-matmul",
         {"matmul_impl": config.matmul_impl, "ring": "bidirectional"},
@@ -569,6 +567,29 @@ def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
     )
 
 
+def pallas_ring_bidir_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
+                               benchmark: str = "overlap") -> ModeSetup:
+    """The bidirectional in-kernel HBM ring
+    (`ops/pallas_ring_bidir_hbm.py`): counter-rotating half-chunk RDMA
+    streams riding both directions of each full-duplex ICI link, two
+    half-chunk nested pipelines per step — the hand-scheduled analogue of
+    `collective_matmul_bidir`. Baseline leg = XLA gather-then-matmul."""
+    from tpu_matmul_bench.ops.pallas_ring_bidir_hbm import (
+        ring_allgather_matmul_bidir_hbm,
+    )
+
+    kw = _explicit_blocks(config)
+    return _vs_baseline_mode(
+        config, mesh, size, "pallas_ring_bidir_hbm",
+        collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl,
+                                  blocks=config.blocks),
+        ring_allgather_matmul_bidir_hbm(mesh, **kw),
+        "all_gather-then-matmul",
+        {"kernel": "pallas bidirectional HBM ring RDMA all-gather matmul"},
+        benchmark,
+    )
+
+
 def pallas_ring_rs_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
                             benchmark: str = "overlap") -> ModeSetup:
     """The reduce-scatter dual of `pallas_ring_hbm`
@@ -601,5 +622,6 @@ OVERLAP_MODES = {
     "collective_matmul_rs": collective_matmul_rs_mode,
     "pallas_ring": pallas_ring_mode,
     "pallas_ring_hbm": pallas_ring_hbm_mode,
+    "pallas_ring_bidir_hbm": pallas_ring_bidir_hbm_mode,
     "pallas_ring_rs_hbm": pallas_ring_rs_hbm_mode,
 }

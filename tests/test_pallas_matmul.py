@@ -68,12 +68,13 @@ def test_tuned_blocks_table():
     # unknown chip / interpreter and sub-table sizes fall back to the baseline
     assert tuned_blocks(16384, 16384, 16384, "cpu") == (512, 512, 512)
     assert tuned_blocks(512, 512, 512, "TPU v5 lite") == (512, 512, 512)
-    # per-dtype rows: float32 is untuned so far (falls back to baseline),
-    # float16 shares the bf16 rows, int8 has its own measured winners
+    # per-dtype rows: float32 has its own measured row (serves both the
+    # strict and fast precisions), float16 shares the bf16 rows, int8 has
+    # its own measured winners
     import jax.numpy as jnp
 
     assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
-                        jnp.float32) == (512, 512, 512)
+                        jnp.float32) == (1024, 1024, 512)
     assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
                         jnp.float16) == (4096, 2048, 512)
     assert tuned_blocks(4096, 4096, 4096, "TPU v5 lite",

@@ -53,3 +53,20 @@ def test_runner_applies_and_records_precision(mesh):
         assert jax.config.jax_default_matmul_precision == "highest"
     finally:
         apply_matmul_precision("default")
+
+
+def test_tune_applies_and_records_precision():
+    # the tuner has its own loop (doesn't go through runner.run_sizes), so
+    # it must apply --precision itself — a silent no-op here once produced
+    # impossible "strict-fp32" throughput numbers
+    from tpu_matmul_bench.benchmarks import pallas_tune
+
+    try:
+        recs = pallas_tune.main(
+            ["--sizes", "64", "--iterations", "1", "--warmup", "0",
+             "--dtype", "float32", "--precision", "highest",
+             "--candidates", "32,32,32"])
+        assert recs and recs[0].extras["precision"] == "highest"
+        assert jax.config.jax_default_matmul_precision == "highest"
+    finally:
+        apply_matmul_precision("default")

@@ -25,6 +25,29 @@ def test_effective_blocks_clamping():
     assert effective_blocks(8192, 8192, 8192, 768, 768, 768) == (512, 512, 512)
     assert effective_blocks(8192, 8192, 8192, 512, 1024, 512) == (512, 1024, 512)
     assert effective_blocks(64, 64, 64, 512, 512, 512) == (64, 64, 64)
+    # the ladder has 1024/2048/4096 rungs: a 2048-tile request on a
+    # 1024-dim problem degrades to 1024-class tiles, not 512-class
+    assert effective_blocks(1024, 1024, 1024, 2048, 2048, 1024) == \
+        (1024, 1024, 1024)
+    assert effective_blocks(2048, 2048, 16384, 4096, 2048, 512) == \
+        (2048, 2048, 512)
+
+
+def test_tune_rect_mkn(tmp_path, capsys):
+    # --mkn tunes one rectangular shape; records carry the shape and the
+    # rectangular FLOP count (2·M·K·N, not 2·max³)
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    records = main([
+        "--mkn", "32", "96", "64", "--iterations", "2", "--warmup", "1",
+        "--dtype", "float32", "--candidates", "32,32,32",
+        "--json-out", str(tmp_path / "rect.jsonl"),
+    ])
+    out = capsys.readouterr().out
+    assert "[32x96x64] BEST" in out
+    assert len(records) == 1
+    assert records[0].extras["shape"] == "32x96x64"
+    assert records[0].flops_per_op == 2 * 32 * 96 * 64
 
 
 def test_tune_dedupes_clamped_candidates(capsys):

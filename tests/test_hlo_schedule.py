@@ -196,6 +196,30 @@ def test_collective_matmul_rs_ring_overlaps(mesh, rs_operands):
             "has been serialized")
 
 
+def test_collective_matmul_bidir_rs_ring_overlaps(mesh, rs_operands):
+    from tpu_matmul_bench.parallel.overlap import (
+        collective_matmul_bidir_rs_program,
+    )
+
+    d = mesh.shape["x"]
+    txt = compiled_text(collective_matmul_bidir_rs_program(mesh),
+                        *rs_operands)
+    comps = parse_hlo(txt)
+    comp = _entry_with(comps, "collective-permute")
+    perms = instructions_of(comp, "collective-permute")
+    dots = instructions_of(comp, *MATMUL_OPS)
+    # two counter-rotating half-accumulator streams: one hop per direction
+    # per step, two half-row matmuls per step
+    assert len(perms) == 2 * (d - 1), (len(perms), d)
+    assert len(dots) == 2 * d, (len(dots), d)
+    # accumulator hops pick up products (hops DO depend on matmuls), but
+    # no matmul ever waits for a hop — products come from the local shard
+    for dt in dots:
+        assert not reaches_opcode(comps, comp, dt, ("collective-permute",)), (
+            "a matmul depends on a ring hop — the bidirectional "
+            "reduce-scatter overlap has been serialized")
+
+
 def test_collective_matmul_rs_baseline_is_serialized(mesh, rs_operands):
     txt = compiled_text(collective_matmul_rs_program(mesh, overlap=False),
                         *rs_operands)

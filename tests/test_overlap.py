@@ -52,6 +52,22 @@ def test_collective_matmul_bidir_matches_dense(mesh, size):
     np.testing.assert_allclose(np.asarray(overlapped(x, w)), want, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("size", [SIZE, 72])  # 72/8 = 9 rows: odd half-split
+def test_collective_matmul_bidir_rs_matches_dense(mesh, size):
+    # the counter-rotating half-accumulator ring must equal the dense
+    # product (serialized baseline = collective_matmul_rs_program's,
+    # covered by its own test)
+    from tpu_matmul_bench.parallel.overlap import (
+        collective_matmul_bidir_rs_program,
+    )
+
+    (x,) = sharded_normal(0, (size, size), jnp.float32, mesh, P(None, "x"), count=1)
+    (w,) = sharded_normal(1, (size, size), jnp.float32, mesh, P("x", None), count=1)
+    want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+    got = collective_matmul_bidir_rs_program(mesh)(x, w)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
 def test_collective_matmul_rs_matches_dense(mesh):
     # the chunked ring reduce-scatter matmul must equal the dense product:
     # X k-split P(None,'x'), W row-sharded P('x',None) → Y row-sharded

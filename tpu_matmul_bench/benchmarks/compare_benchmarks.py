@@ -80,7 +80,8 @@ def compare(size: int, dtype: str, num_devices: int | None,
                f"dp={hybrid_dp} with tp ≥ 2, have {world})")
 
     for mode in ("no_overlap", "overlap", "pipeline", "collective_matmul",
-                 "collective_matmul_bidir", "collective_matmul_rs"):
+                 "collective_matmul_bidir", "collective_matmul_rs",
+                 "collective_matmul_bidir_rs"):
         report(f"\n### overlap: {mode} " + "#" * 40)
         for rec in _run(matmul_overlap_benchmark.main, base + ["--mode", mode]):
             results[mode] = rec

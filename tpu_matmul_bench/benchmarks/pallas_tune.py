@@ -193,7 +193,10 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                         iterations=t.iterations, warmup=config.warmup,
                         avg_time_s=t.avg_s, tflops_per_device=tflops,
                         tflops_total=tflops, device_kind=info.device_kind,
-                        flops_per_op=wl.flops, extras=extras,
+                        # rectangular-only: setting it for squares would
+                        # suppress finalize()'s roofline_pct gate
+                        flops_per_op=wl.flops if rect else None,
+                        extras=extras,
                     ).finalize()
                     records.append(rec)
                     jw.write(rec)

@@ -221,7 +221,7 @@ def estimate_memory_gib(
         return gib(2.0 / d, 2 + 4.0 / d)
     if mode in ("matrix_parallel", "model_parallel", "collective_matmul",
                 "collective_matmul_bidir", "collective_matmul_rs",
-                "pallas_ring") and d > 1:
+                "collective_matmul_bidir_rs", "pallas_ring") and d > 1:
         # sharded operands (2/d) + full-size combined C + one temp
         return gib(2.0 / d, 2)
     if mode in ("no_overlap", "overlap", "pipeline"):

@@ -32,7 +32,8 @@ collectives + latency-hiding scheduler inside ONE compiled program:
   transfer the MXU work must hide.
 - ``collective_matmul_rs``: the reduce-scatter dual — chunked partial
   products picked up by an accumulator ring (the "matmul then gradient
-  sync" shape).
+  sync" shape); ``collective_matmul_bidir_rs`` bidirectionalizes it with
+  two counter-rotating half-row accumulator streams.
 - ``pallas_ring``: the all-gather ring hand-scheduled inside one Pallas
   kernel (`ops/pallas_ring.py`), RDMA double-buffered against the MXU.
 - ``pallas_ring_hbm`` / ``pallas_ring_rs_hbm``: the same in-kernel
@@ -479,6 +480,74 @@ def collective_matmul_rs_program(mesh: Mesh, overlap: bool = True,
                 out_specs=P("x", None), check_vma=False)
 
 
+def collective_matmul_bidir_rs_program(mesh: Mesh, impl: str = "xla",
+                                       blocks: tuple[int, int, int] | None = None):
+    """Bidirectional ring reduce-scatter matmul — the RS dual of
+    `collective_matmul_bidir_program`, same contract as
+    `collective_matmul_rs_program` (X [m, k/D] column-sharded, W [k/D, n]
+    row-sharded → Y [m/D, n] row-sharded).
+
+    Each output chunk's accumulator splits into two half-row streams: the
+    top-half accumulator for chunk c starts at device c+1 and hops RIGHT
+    (picking up each device's partial product), the bottom-half starts at
+    c−1 and hops LEFT — so per step each full-duplex ICI link carries one
+    half-accumulator in each direction and the per-step, per-direction
+    transfer is half the unidirectional RS ring's. Per step the MXU runs
+    two half-chunk partial products (= one chunk of work, unchanged).
+    After D−1 hops both halves of chunk `my` are home and fully summed.
+    The serialized baseline is the unidirectional form's —
+    `collective_matmul_rs_program(mesh, overlap=False)` (matmul then
+    psum_scatter).
+    """
+    d = mesh.shape["x"]
+    mm = matmul_2d(impl, blocks)
+
+    def body(x_local, w_local):  # [m, k/d], [k/d, n]
+        m = x_local.shape[0]
+        mshard = m // d
+        h = mshard // 2
+        my = jax.lax.axis_index("x")
+        out_dtype = matmul_out_dtype(x_local.dtype)
+        acc_f = jnp.zeros((h, w_local.shape[1]), dtype=out_dtype)
+        acc_b = jnp.zeros((mshard - h, w_local.shape[1]), dtype=out_dtype)
+        for t in range(d):
+            # resident top-half accumulator belongs to chunk (my − 1 − t)
+            # mod d (same origin walk as the unidirectional RS ring); the
+            # bottom-half mirrors it: chunk (my + 1 + t) mod d
+            cf = jax.lax.rem(my + 2 * d - 1 - t, d)
+            cb = jax.lax.rem(my + 1 + t, d)
+            rows_f = jax.lax.dynamic_slice_in_dim(x_local, cf * mshard, h)
+            rows_b = jax.lax.dynamic_slice_in_dim(
+                x_local, cb * mshard + h, mshard - h)
+            acc_f = acc_f + mm(rows_f, w_local)
+            acc_b = acc_b + mm(rows_b, w_local)
+            if t + 1 < d:
+                acc_f = jax.lax.ppermute(acc_f, "x", ring_perm(d))
+                acc_b = jax.lax.ppermute(acc_b, "x", ring_perm_rev(d))
+        # after d−1 hops both half-accumulators of chunk `my` are home
+        return jnp.concatenate([acc_f, acc_b], axis=0)
+
+    return smap(body, mesh, in_specs=(P(None, "x"), P("x", None)),
+                out_specs=P("x", None), check_vma=False)
+
+
+def collective_matmul_bidir_rs_mode(config: BenchConfig, mesh: Mesh,
+                                    size: int,
+                                    benchmark: str = "overlap") -> ModeSetup:
+    return _vs_baseline_mode(
+        config, mesh, size, "collective_matmul_bidir_rs",
+        collective_matmul_rs_program(mesh, overlap=False,
+                                     impl=config.matmul_impl,
+                                     blocks=config.blocks),
+        collective_matmul_bidir_rs_program(mesh, impl=config.matmul_impl,
+                                           blocks=config.blocks),
+        "matmul-then-psum_scatter",
+        {"matmul_impl": config.matmul_impl, "ring": "bidirectional"},
+        benchmark,
+        x_spec=P(None, "x"), w_spec=P("x", None),
+    )
+
+
 def collective_matmul_rs_mode(config: BenchConfig, mesh: Mesh, size: int,
                               benchmark: str = "overlap") -> ModeSetup:
     return _vs_baseline_mode(
@@ -620,6 +689,7 @@ OVERLAP_MODES = {
     "collective_matmul": collective_matmul_mode,
     "collective_matmul_bidir": collective_matmul_bidir_mode,
     "collective_matmul_rs": collective_matmul_rs_mode,
+    "collective_matmul_bidir_rs": collective_matmul_bidir_rs_mode,
     "pallas_ring": pallas_ring_mode,
     "pallas_ring_hbm": pallas_ring_hbm_mode,
     "pallas_ring_bidir_hbm": pallas_ring_bidir_hbm_mode,

@@ -1,0 +1,75 @@
+"""bench.py harness logic (the driver's headline artifact).
+
+The real measurement needs the TPU chip; these tests pin the parent-side
+contract — result collection from attempt files, best-of selection, and
+the one-JSON-line output schema — which must hold even when the tunnel
+wedges and children never finish (the parent never imports jax, so it can
+always emit)."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_collect_reads_only_valid_attempts(tmp_path):
+    bench = _load_bench()
+    good = tmp_path / "a.jsonl"
+    good.write_text(json.dumps({"mode": "single", "tflops_per_device": 194.1})
+                    + "\n" + '{"half-written rec')  # partial trailing line
+    bad = tmp_path / "b.jsonl"
+    bad.write_text("not json\n")
+    missing = tmp_path / "c.jsonl"
+    vals = bench._collect([str(good), str(bad), str(missing)])
+    assert vals == [194.1]
+
+
+def test_emit_schema(capsys):
+    bench = _load_bench()
+    bench._emit(194.41)
+    line = capsys.readouterr().out.strip()
+    rec = json.loads(line)
+    assert rec == {
+        "metric": "bf16_matmul_16k_tflops_per_chip",
+        "value": 194.41,
+        "unit": "TFLOPS",
+        "vs_baseline": round(194.41 / 140.0, 4),
+    }
+
+
+def test_parent_never_calls_jax():
+    # the whole point of the subprocess design: a wedged tunnel cannot
+    # hang the parent. The container's sitecustomize imports jax into
+    # every interpreter (harmless — only backend *calls* touch the
+    # tunnel), so the invariant is that bench.py's parent-side code never
+    # references jax; only the child source string may.
+    import ast
+
+    tree = ast.parse((REPO / "bench.py").read_text())
+    for node in ast.walk(tree):  # literals (docstring, child code) excluded
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in node.names]
+            mod = getattr(node, "module", "") or ""
+            assert not any("jax" in n or "tpu_matmul_bench" in n
+                           for n in names + [mod]), (names, mod)
+    # and loading the module must be instant (no backend contact)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import importlib.util\n"
+         f"spec = importlib.util.spec_from_file_location('bench', {str(REPO / 'bench.py')!r})\n"
+         "m = importlib.util.module_from_spec(spec)\n"
+         "spec.loader.exec_module(m)\n"
+         "print('loaded')"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.stdout.strip() == "loaded", out.stderr

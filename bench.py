@@ -72,9 +72,7 @@ def _collect(outputs: list[str]) -> list[float]:
     return vals
 
 
-def main() -> None:
-    budget_s = float(os.environ.get("BENCH_TIMEOUT_S", "3000"))
-    deadline = time.time() + budget_s - 30  # margin to emit + exit
+def _run_attempts(deadline: float) -> list[str]:
     tmpdir = tempfile.mkdtemp(prefix="bench_")
     outputs: list[str] = []
     procs: list[subprocess.Popen] = []
@@ -91,14 +89,14 @@ def main() -> None:
              "--sizes", "16384", "--dtype", "bfloat16",
              "--iterations", "50", "--warmup", "10", "--num-devices", "1",
              "--matmul-impl", impl, "--json-out", out_path],
-            stdout=subprocess.DEVNULL, stderr=sys.stderr,
+            # human report → stderr (stdout must stay clean for the one
+            # JSON line; the machine channel is the --json-out file)
+            stdout=sys.stderr, stderr=sys.stderr,
         ))
-        soft = min(time.time() + SOFT_DEADLINE_S, deadline)
-        while time.time() < soft:
-            if procs[-1].poll() is not None:
-                break
-            time.sleep(5)
-        if procs[-1].poll() is None:
+        try:
+            procs[-1].wait(timeout=max(
+                0.0, min(SOFT_DEADLINE_S, deadline - time.time())))
+        except subprocess.TimeoutExpired:
             # soft deadline blown: leave the child running (killing a
             # tunnel client mid-RPC strands the relay grant for everyone —
             # see .claude/skills/verify/SKILL.md) and move on; its late
@@ -106,23 +104,30 @@ def main() -> None:
             print(f"[bench] attempt {i} ({impl}) slow — continuing "
                   "without killing it", file=sys.stderr, flush=True)
 
-    # drain window: children left running may still land results. Wait
-    # until every attempt reported (or exited), the straggler grace after
-    # the first result expires, or the global budget runs out.
+    # drain window: children left running may still land results — wait
+    # until all children exited, the straggler grace after the first
+    # result expires, or the global budget runs out
     first_result_t: float | None = None
     while time.time() < deadline:
-        vals = _collect(outputs)
-        if vals and first_result_t is None:
+        if first_result_t is None and _collect(outputs):
             first_result_t = time.time()
-        live = any(p.poll() is None for p in procs)
-        if not live and len(vals) >= len([p for p in procs]):
+        if all(p.poll() is not None for p in procs):
             break
-        if not live:
-            break
-        if vals and time.time() - first_result_t > STRAGGLER_GRACE_S:
+        if (first_result_t is not None
+                and time.time() - first_result_t > STRAGGLER_GRACE_S):
             break
         time.sleep(10)
+    return outputs
 
+
+def main() -> None:
+    budget_s = float(os.environ.get("BENCH_TIMEOUT_S", "3000"))
+    deadline = time.time() + budget_s - 30  # margin to emit + exit
+    outputs: list[str] = []
+    try:
+        outputs = _run_attempts(deadline)
+    except Exception as e:  # noqa: BLE001 — the one JSON line must ALWAYS print
+        print(f"[bench] harness error: {e!r}", file=sys.stderr, flush=True)
     vals = _collect(outputs)
     _emit(max(vals) if vals else 0.0)
     # children may still be running (wedged tunnel); don't wait on them

@@ -47,6 +47,23 @@ def test_emit_schema(capsys):
     }
 
 
+def test_always_emits_one_json_line():
+    # with the budget already exhausted no attempt is spawned, yet the one
+    # JSON line must still print (the driver parses stdout unconditionally)
+    import os
+
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env={**os.environ, "BENCH_TIMEOUT_S": "30"},  # deadline = now
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+    )
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "bf16_matmul_16k_tflops_per_chip"
+    assert rec["value"] == 0.0
+
+
 def test_parent_never_calls_jax():
     # the whole point of the subprocess design: a wedged tunnel cannot
     # hang the parent. The container's sitecustomize imports jax into

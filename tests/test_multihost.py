@@ -42,6 +42,26 @@ def test_multihost_launcher_runs_scaling_benchmark():
     assert out.stdout.count("Results for 64x64") == 1
 
 
+def test_multihost_launcher_runs_bidir_overlap():
+    """The bidirectional collective matmul over a REAL 2-process cluster
+    (4-device global ring spanning the process boundary) — the
+    counter-rotating ppermutes must resolve across hosts, not just on the
+    single-process virtual mesh."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")}
+    env["MULTIHOST_PROGRAM"] = "overlap"
+    out = subprocess.run(
+        ["./run_multihost_benchmark.sh", "2", "collective_matmul_bidir",
+         "bfloat16", "--device=cpu", "--sizes", "64", "--iterations", "2",
+         "--warmup", "1", "--validate"],
+        cwd=str(WORKER.parent.parent), env=env, text=True,
+        capture_output=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Results for 64x64 [collective_matmul_bidir]" in out.stdout
+    assert "validation: ok" in out.stdout
+
+
 def test_two_process_psum():
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items()

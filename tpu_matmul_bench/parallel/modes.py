@@ -120,8 +120,18 @@ def corner_validation(got: jax.Array, expected: jax.Array, dtype: Any,
     quantized-wire collectives, whose error grows with the mesh size)."""
     import numpy as np
 
-    g = np.asarray(got, np.float64)
-    e = np.asarray(expected, np.float64)
+    def fetch(x):
+        # under a multi-process cluster a sharded corner can span
+        # non-addressable devices; gather it to every host first (a direct
+        # np.asarray raises on non-addressable jax.Arrays)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            x = multihost_utils.process_allgather(x, tiled=True)
+        return np.asarray(x, np.float64)
+
+    g = fetch(got)
+    e = fetch(expected)
     denom = float(np.abs(e).max()) or 1.0
     err = float(np.abs(g - e).max()) / denom
     if tol is None:

@@ -134,8 +134,16 @@ def test_collective_matmul_ring_overlaps(mesh, cm_operands):
 
 
 def test_collective_matmul_bidir_ring_overlaps(mesh, cm_operands):
+    import re
+
     d = mesh.shape["x"]
     txt = compiled_text(collective_matmul_bidir_program(mesh), *cm_operands)
+    # both link directions must actually be used: hops 0→1 (forward ring)
+    # AND 1→0 (backward ring) in the compiled permutes
+    pair_sets = set()
+    for m_ in re.finditer(r"source_target_pairs=\{(.*?)\}\}", txt):
+        pair_sets.update(re.findall(r"\{(\d+),(\d+)\}", m_.group(0)))
+    assert ("0", "1") in pair_sets and ("1", "0") in pair_sets, pair_sets
     comps = parse_hlo(txt)
     comp = _entry_with(comps, "collective-permute")
     perms = instructions_of(comp, "collective-permute")
@@ -228,6 +236,57 @@ def test_collective_matmul_rs_baseline_is_serialized(mesh, rs_operands):
     (rs,) = instructions_of(comp, "reduce-scatter")
     assert reaches_opcode(comps, comp, rs, MATMUL_OPS), (
         "baseline reduce-scatter no longer consumes the partial product")
+
+
+def test_hybrid_collectives_ride_disjoint_axes(mesh):
+    """The 2-D dp×tp claim (parallel/hybrid.py): the tp all-gather and the
+    dp all-reduce must partition the device world along DIFFERENT axes —
+    that is what lets them ride disjoint ICI rings concurrently on
+    hardware. Checked on the optimized HLO's replica groups."""
+    import re
+
+    import jax
+    from tpu_matmul_bench.parallel.hybrid import (
+        hybrid_programs,
+        make_hybrid_mesh,
+    )
+
+    m = make_hybrid_mesh(jax.devices()[:8], dp=2)  # dp=2 × tp=4
+    cfg = _cfg()
+    (x,) = sharded_normal(cfg.seed, (2, SIZE, SIZE), cfg.dtype, m,
+                          P("dp"), count=1)
+    (w,) = sharded_normal(cfg.seed + 1, (SIZE, SIZE), cfg.dtype, m,
+                          P(None, "tp"), count=1)
+    compute, full = hybrid_programs(m)
+
+    # the compute leg must be collective-free (it is the comm-split basis)
+    txt_c = compiled_text(compute, x, w)
+    assert "all-gather" not in txt_c and "all-reduce" not in txt_c
+
+    txt_f = compiled_text(full, x, w)
+
+    def group_sizes(opcode):
+        sizes = set()
+        for line in txt_f.splitlines():
+            if f" {opcode}(" not in line and f"{opcode}-start" not in line:
+                continue
+            m_ = re.search(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}",
+                           line)
+            if m_:
+                for grp in re.findall(r"\{([^}]*)\}", m_.group(1)):
+                    sizes.add(len(grp.split(",")))
+            else:  # iota form: replica_groups=[n,m]<=[...]
+                m_ = re.search(r"replica_groups=\[(\d+),(\d+)\]", line)
+                if m_:
+                    sizes.add(int(m_.group(2)))
+        return sizes
+
+    ag, ar = group_sizes("all-gather"), group_sizes("all-reduce")
+    assert ag, "tp all-gather missing from the compiled hybrid step"
+    assert ar, "dp all-reduce missing from the compiled hybrid step"
+    # tp groups have 4 devices, dp groups 2 — different axes, disjoint rings
+    assert ag == {4}, ag
+    assert ar == {2}, ar
 
 
 def test_async_pairs_bracket_matmul_when_backend_emits_them(scan_hlo):

@@ -24,6 +24,77 @@ def test_compare_small(tmp_path):
     assert all(l["tflops_total"] > 0 for l in lines)
 
 
+def test_record_json_roundtrip():
+    from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+
+    rec = BenchmarkRecord(
+        benchmark="overlap", mode="collective_matmul_bidir", size=8192,
+        dtype="bfloat16", world=8, iterations=20, warmup=5,
+        avg_time_s=0.0059, tflops_per_device=23.3, tflops_total=186.4,
+        extras={"overlap_speedup_x": 1.004},
+    ).finalize()
+    back = BenchmarkRecord.from_json(rec.to_json())
+    assert back == rec
+    # unknown keys (the compare driver's comparison_key) are ignored
+    import json as _json
+
+    d = _json.loads(rec.to_json())
+    d["comparison_key"] = "collective_matmul_bidir"
+    assert BenchmarkRecord.from_json(_json.dumps(d)) == rec
+
+
+def _cpu_child_env(monkeypatch):
+    # children must land on the virtual CPU mesh: the container's
+    # sitecustomize forces the axon TPU backend unless the pool env is
+    # unset (verify SKILL.md)
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+
+
+def test_run_isolated_reads_child_records(monkeypatch):
+    _cpu_child_env(monkeypatch)
+    recs = compare_benchmarks._run_isolated(
+        "tpu_matmul_bench.benchmarks.matmul_benchmark",
+        ["--sizes", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", "--num-devices", "1"],
+        timeout_s=240.0,
+    )
+    assert len(recs) == 1
+    assert recs[0].mode == "single" and recs[0].size == 64
+    assert recs[0].tflops_total > 0
+
+
+def test_run_isolated_skips_slow_row_without_killing(monkeypatch, capsys):
+    _cpu_child_env(monkeypatch)
+    try:
+        recs = compare_benchmarks._run_isolated(
+            "tpu_matmul_bench.benchmarks.matmul_benchmark",
+            ["--sizes", "64", "--iterations", "1", "--warmup", "0",
+             "--dtype", "float32", "--num-devices", "1"],
+            timeout_s=0.5,  # guaranteed slower than jax import
+        )
+        assert recs == []
+        assert "row skipped" in capsys.readouterr().out
+        assert compare_benchmarks._ORPHANS  # tracked, not lost
+    finally:
+        # the never-kill policy protects TUNNEL clients; this one is a
+        # local CPU child — terminate it so it doesn't outlive the test
+        for p in compare_benchmarks._ORPHANS:
+            p.terminate()
+            p.wait(timeout=60)
+        compare_benchmarks._ORPHANS.clear()
+
+
+def test_probe_backend_via_child(monkeypatch):
+    # --isolate's parent must learn (backend, world) without initializing
+    # the backend itself; the probe child reports the CPU mesh here
+    _cpu_child_env(monkeypatch)
+    backend, n = compare_benchmarks._probe_backend(240.0)
+    assert backend == "cpu" and n == 8
+
+
 def test_render_markdown_reference_table_shape():
     from tpu_matmul_bench.benchmarks.compare_benchmarks import render_markdown
     from tpu_matmul_bench.utils.reporting import BenchmarkRecord

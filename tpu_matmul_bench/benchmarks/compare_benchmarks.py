@@ -8,7 +8,12 @@ scraping (SURVEY §5 recommends exactly this). The qualitative summary
 is derived from the measured numbers instead of asserted as prose.
 
 Run: python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
-        [--size 16384] [--num-devices N] [--dtype bfloat16]
+        [--size 16384] [--num-devices N] [--dtype bfloat16] [--isolate]
+
+`--isolate` runs each row in a child process (records still structured,
+via --json-out JSONL — not scraping): on backends where a compile can
+hang indefinitely (see the tunnel-wedge gotcha in the verify skill), one
+stuck row is skipped instead of taking the whole table down.
 """
 
 from __future__ import annotations
@@ -27,9 +32,105 @@ def _run(module_main, argv: list[str]) -> list[BenchmarkRecord]:
         return []
 
 
+# timed-out --isolate children, left running by policy (killing a tunnel
+# client mid-RPC strands the relay grant for every later client —
+# .claude/skills/verify/SKILL.md). Polled on each later row so finished
+# orphans are reaped; exposed so tests can terminate their local children.
+_ORPHANS: list = []
+
+
+def _reap_orphans() -> int:
+    """Poll (and thereby reap) finished orphans; return how many still run."""
+    live = [p for p in _ORPHANS if p.poll() is None]
+    _ORPHANS[:] = live
+    return len(live)
+
+
+def _run_isolated(module_name: str, argv: list[str],
+                  timeout_s: float) -> list[BenchmarkRecord]:
+    """Run one benchmark program in a CHILD process, reading its structured
+    records back from a --json-out JSONL file (still no stdout scraping —
+    the records are the machine channel, SURVEY §5). For hostile backends:
+    a child that exceeds the soft timeout is LEFT RUNNING (see _ORPHANS)
+    and its row is skipped, so one wedged compile cannot take down the
+    whole comparison table the way an in-process hang would. Caveat: on
+    runtimes with exclusive per-process device ownership a live orphan can
+    make LATER rows fail init — those failures are reported per row."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    if _reap_orphans():
+        report(f"[compare] note: {len(_ORPHANS)} timed-out row(s) still "
+               "running — later rows may fail if the backend is "
+               "exclusive-ownership")
+    fd, path = tempfile.mkstemp(prefix="compare_row_", suffix=".jsonl")
+    os.close(fd)
+    # child inherits the parent's streams (sys.stdout may be a captured
+    # pseudo-file without a fileno under test harnesses); the human report
+    # flows through like the in-process path, records ride the JSONL file
+    proc = subprocess.Popen(
+        [sys.executable, "-m", module_name, *argv, "--json-out", path],
+    )
+    try:
+        proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        report(f"[compare] {module_name} exceeded {timeout_s:.0f}s — "
+               "left running (never kill a tunnel client), row skipped")
+        _ORPHANS.append(proc)
+        return []  # the live child may still write `path`; leave it
+    try:
+        if proc.returncode != 0:
+            report(f"[compare] {module_name} exited rc={proc.returncode} — "
+                   "row skipped")
+        records = []
+        try:
+            with open(path) as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            return []
+        for line in lines:
+            try:
+                records.append(BenchmarkRecord.from_json(line))
+            except (ValueError, TypeError, KeyError):
+                continue
+        return records
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def _probe_backend(timeout_s: float) -> tuple[str, int]:
+    """Child-process probe of (backend, device_count) so the --isolate
+    parent never initializes the backend itself — on exclusive-ownership
+    runtimes a parent-held device would fail every child's init, and on a
+    wedged tunnel the parent would hang before any row. A probe past the
+    timeout is killed: it is only *waiting* for a device grant, not
+    holding one, so the kill cannot strand the relay."""
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend(), len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout_s)
+        backend, n = out.stdout.split()
+        return backend, int(n)
+    except Exception:  # noqa: BLE001 — probe is best-effort
+        report("[compare] backend probe failed or timed out — "
+               "assuming 1 device")
+        return "unknown", 1
+
+
 def compare(size: int, dtype: str, num_devices: int | None,
             iterations: int, warmup: int,
-            precision: str = "default") -> dict[str, BenchmarkRecord]:
+            precision: str = "default",
+            isolate: bool = False,
+            mode_timeout: float = 900.0) -> dict[str, BenchmarkRecord]:
     import jax
 
     from tpu_matmul_bench.benchmarks import (
@@ -40,29 +141,45 @@ def compare(size: int, dtype: str, num_devices: int | None,
         matmul_scaling_benchmark,
     )
 
-    world = num_devices or len(jax.devices())
+    if isolate:
+        # the parent must stay backend-free: world/platform come from a
+        # probe child, and the rank-0 report gate is forced (the compare
+        # driver is single-controller by construction)
+        from tpu_matmul_bench.utils.reporting import force_reporting_process
+
+        force_reporting_process(True)
+        backend, probed_n = _probe_backend(min(120.0, mode_timeout))
+        world = num_devices or probed_n
+    else:
+        backend = None  # resolved lazily below via jax
+        world = num_devices or len(jax.devices())
     common = ["--sizes", str(size), "--dtype", dtype,
               "--iterations", str(iterations), "--warmup", str(warmup),
               "--precision", precision]
     base = common + (["--num-devices", str(num_devices)] if num_devices else [])
 
+    def run_prog(module, argv: list[str]) -> list[BenchmarkRecord]:
+        if isolate:
+            return _run_isolated(module.__name__, argv, mode_timeout)
+        return _run(module.main, argv)
+
     results: dict[str, BenchmarkRecord] = {}
 
     # the 'single' row is the per-chip baseline — always exactly 1 device
     report("\n### single-device matmul " + "#" * 40)
-    for rec in _run(matmul_benchmark.main, common + ["--num-devices", "1"]):
+    for rec in run_prog(matmul_benchmark, common + ["--num-devices", "1"]):
         results["single"] = rec
 
     for mode in ("independent", "batch_parallel", "matrix_parallel"):
         report(f"\n### scaling: {mode} " + "#" * 40)
-        for rec in _run(matmul_scaling_benchmark.main, base + ["--mode", mode]):
+        for rec in run_prog(matmul_scaling_benchmark, base + ["--mode", mode]):
             results[mode] = rec
 
     # the distributed-benchmark rows the reference's compare also runs
     # (backup/compare_benchmarks.py:37-49 runs its data_parallel variant)
     for mode in ("data_parallel", "model_parallel"):
         report(f"\n### distributed: {mode} " + "#" * 40)
-        for rec in _run(matmul_distributed_benchmark.main,
+        for rec in run_prog(matmul_distributed_benchmark,
                         base + ["--mode", mode]):
             results[mode] = rec
 
@@ -72,7 +189,7 @@ def compare(size: int, dtype: str, num_devices: int | None,
     hybrid_dp = 2
     if world > hybrid_dp and world % hybrid_dp == 0:
         report("\n### hybrid (dp x tp) " + "#" * 40)
-        for rec in _run(matmul_hybrid_benchmark.main,
+        for rec in run_prog(matmul_hybrid_benchmark,
                         base + ["--dp", str(hybrid_dp)]):
             results["hybrid"] = rec
     else:
@@ -83,7 +200,7 @@ def compare(size: int, dtype: str, num_devices: int | None,
                  "collective_matmul_bidir", "collective_matmul_rs",
                  "collective_matmul_bidir_rs"):
         report(f"\n### overlap: {mode} " + "#" * 40)
-        for rec in _run(matmul_overlap_benchmark.main, base + ["--mode", mode]):
+        for rec in run_prog(matmul_overlap_benchmark, base + ["--mode", mode]):
             results[mode] = rec
 
     # pallas_ring is VMEM-resident; when its cap is far below the headline
@@ -93,11 +210,12 @@ def compare(size: int, dtype: str, num_devices: int | None,
     # in-kernel-RDMA story either way
     from tpu_matmul_bench.parallel.overlap import pallas_ring_max_size
 
+    platform = backend if backend is not None else jax.default_backend()
     ring_cap = (pallas_ring_max_size(world, dtype)
-                if jax.default_backend() == "tpu" else size)
+                if platform == "tpu" else size)
     if size <= ring_cap:
         report(f"\n### overlap: pallas_ring " + "#" * 40)
-        for rec in _run(matmul_overlap_benchmark.main,
+        for rec in run_prog(matmul_overlap_benchmark,
                         base + ["--mode", "pallas_ring"]):
             results["pallas_ring"] = rec
     else:
@@ -109,7 +227,7 @@ def compare(size: int, dtype: str, num_devices: int | None,
     for hbm_mode in ("pallas_ring_hbm", "pallas_ring_bidir_hbm",
                      "pallas_ring_rs_hbm"):
         report(f"\n### overlap: {hbm_mode} " + "#" * 36)
-        for rec in _run(matmul_overlap_benchmark.main,
+        for rec in run_prog(matmul_overlap_benchmark,
                         base + ["--mode", hbm_mode]):
             results[hbm_mode] = rec
 
@@ -124,7 +242,7 @@ def compare(size: int, dtype: str, num_devices: int | None,
         sweep_args = ["--sizes", str(size), "--dtype", dt,
                       "--iterations", str(iterations), "--warmup", str(warmup),
                       "--precision", precision, "--num-devices", "1"]
-        for rec in _run(matmul_benchmark.main, sweep_args):
+        for rec in run_prog(matmul_benchmark, sweep_args):
             results[f"single_{dt}"] = rec
 
     # strict-fp32 row: --precision highest forces true fp32 dot lowering
@@ -137,7 +255,7 @@ def compare(size: int, dtype: str, num_devices: int | None,
                        "--iterations", str(iterations),
                        "--warmup", str(warmup),
                        "--precision", "highest", "--num-devices", "1"]
-        for rec in _run(matmul_benchmark.main, strict_args):
+        for rec in run_prog(matmul_benchmark, strict_args):
             results["single_float32_strict"] = rec
 
     return results
@@ -247,11 +365,33 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
     p.add_argument("--markdown-out", type=str, default=None,
                    help="write the README-style results table here "
                         "(the reference table shape, README.md:39-47)")
+    p.add_argument("--isolate", action="store_true",
+                   help="run each benchmark row in a child process reading "
+                        "its --json-out records (one wedged compile can no "
+                        "longer hang the whole table; slow rows are left "
+                        "running and skipped)")
+    p.add_argument("--mode-timeout", type=float, default=900.0,
+                   help="soft per-row timeout (seconds) under --isolate")
     args = p.parse_args(argv)
 
-    results = compare(args.size, args.dtype, args.num_devices,
-                      args.iterations, args.warmup,
-                      precision=args.precision)
+    from tpu_matmul_bench.utils.reporting import force_reporting_process
+
+    try:
+        results = compare(args.size, args.dtype, args.num_devices,
+                          args.iterations, args.warmup,
+                          precision=args.precision,
+                          isolate=args.isolate,
+                          mode_timeout=args.mode_timeout)
+        return _finish(args, results)
+    finally:
+        # compare(isolate=True) forces the report gate so the parent never
+        # initializes the backend; undo only after ALL parent-side
+        # reporting is done, for in-process callers that keep using this
+        # interpreter (tests)
+        force_reporting_process(None)
+
+
+def _finish(args, results: dict[str, BenchmarkRecord]):
     report(summarize(results))
     if args.markdown_out:
         with open(args.markdown_out, "w") as fh:

@@ -102,9 +102,33 @@ class BenchmarkRecord:
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)
 
+    @classmethod
+    def from_json(cls, line: str) -> "BenchmarkRecord":
+        """Rebuild a record from a to_json line (the JSONL channel), for
+        consumers that read another process's records — unknown keys (e.g.
+        the compare driver's `comparison_key`) are ignored for
+        forward-compatibility."""
+        d = json.loads(line)
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+
+_FORCE_REPORTING: bool | None = None
+
+
+def force_reporting_process(value: bool | None) -> None:
+    """Override the reporting-process gate without touching the backend —
+    `jax.process_index()` initializes jax, which a backend-avoiding parent
+    (compare --isolate) must not do; single-controller drivers are
+    trivially the reporting process."""
+    global _FORCE_REPORTING
+    _FORCE_REPORTING = value
+
 
 def is_reporting_process() -> bool:
     """≙ the reference's `if rank == 0:` gate — true on the controller."""
+    if _FORCE_REPORTING is not None:
+        return _FORCE_REPORTING
     return jax.process_index() == 0
 
 

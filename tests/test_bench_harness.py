@@ -64,6 +64,65 @@ def test_always_emits_one_json_line():
     assert rec["value"] == 0.0
 
 
+def test_fast_failures_retry_until_spawn_cap(monkeypatch):
+    # a backend erroring fast (tunnel UNAVAILABLE) must not end the bench
+    # after the 3-attempt protocol — it retries up to MAX_SPAWNS while the
+    # budget lasts, so a mid-window recovery can still land a result
+    import time
+
+    bench = _load_bench()
+    spawned = []
+
+    class FakeProc:
+        returncode = 1
+
+        def wait(self, timeout=None):
+            return 1
+
+        def poll(self):
+            return 1
+
+    monkeypatch.setattr(bench, "RETRY_BACKOFF_S", 0.0)
+    monkeypatch.setattr(
+        bench.subprocess, "Popen",
+        lambda args, **kw: (spawned.append(args), FakeProc())[1])
+    outputs = bench._run_attempts(deadline=time.time() + 30)
+    assert len(spawned) == bench.MAX_SPAWNS
+    assert bench._collect(outputs) == []
+
+
+def test_result_stops_retries_after_protocol(monkeypatch):
+    # healthy path: each fake child "measures" a record; the best-of-3
+    # protocol runs exactly its 3 attempts and never enters retry mode
+    import time
+
+    bench = _load_bench()
+    spawned = []
+
+    class OkProc:
+        returncode = 0
+
+        def __init__(self, out_path):
+            with open(out_path, "w") as f:
+                f.write(json.dumps({"mode": "single",
+                                    "tflops_per_device": 194.0}) + "\n")
+
+        def wait(self, timeout=None):
+            return 0
+
+        def poll(self):
+            return 0
+
+    def fake_popen(args, **kw):
+        spawned.append(args)
+        return OkProc(args[args.index("--json-out") + 1])
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    outputs = bench._run_attempts(deadline=time.time() + 30)
+    assert len(spawned) == len(bench.ATTEMPTS)
+    assert bench._collect(outputs) == [194.0] * 3
+
+
 def test_parent_never_calls_jax():
     # the whole point of the subprocess design: a wedged tunnel cannot
     # hang the parent. The container's sitecustomize imports jax into

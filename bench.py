@@ -103,7 +103,11 @@ def _run_attempts(deadline: float) -> list[str]:
         try:
             procs[-1].wait(timeout=max(
                 0.0, min(SOFT_DEADLINE_S, deadline - time.time())))
-            will_retry = (i + 1 < MAX_SPAWNS and time.time() < deadline
+            # back off only in RETRY mode (past the best-of-3 protocol):
+            # protocol attempts use distinct impls, so an impl-specific
+            # fast failure shouldn't delay the next impl's attempt
+            will_retry = (i + 1 >= len(ATTEMPTS)
+                          and i + 1 < MAX_SPAWNS and time.time() < deadline
                           and not _collect(outputs))
             if procs[-1].returncode != 0 and will_retry:
                 print(f"[bench] attempt {i} ({impl}) failed "

@@ -87,6 +87,48 @@ def test_run_isolated_skips_slow_row_without_killing(monkeypatch, capsys):
         compare_benchmarks._ORPHANS.clear()
 
 
+def test_compare_only_filters_rows(tmp_path):
+    out = tmp_path / "only.jsonl"
+    results = compare_benchmarks.main(
+        ["--size", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", "--only", "single,independent",
+         "--json-out", str(out)]
+    )
+    keys = set(results)
+    assert {"single", "independent"} <= keys
+    # nothing outside the requested subset ran (single_float32 is the
+    # dtype-sweep alias of the measured single row — not a separate run)
+    assert keys <= {"single", "independent", "single_float32"}
+
+
+def test_compare_only_rejects_unknown_keys():
+    import pytest
+
+    with pytest.raises(SystemExit, match="unknown row key"):
+        compare_benchmarks.main(
+            ["--size", "64", "--iterations", "1", "--warmup", "0",
+             "--dtype", "float32", "--only", "overlp"])  # typo must not
+    # silently run zero rows; whitespace in the list is tolerated
+    results = compare_benchmarks.main(
+        ["--size", "64", "--iterations", "1", "--warmup", "0",
+         "--dtype", "float32", "--only", " independent "])
+    assert set(results) == {"independent"}
+
+
+def test_compare_only_isolated_e2e(monkeypatch, tmp_path):
+    # the post-wedge recovery path: --isolate + --only on one cheap row,
+    # end-to-end through a child process on the CPU mesh
+    _cpu_child_env(monkeypatch)
+    results = compare_benchmarks.main(
+        ["--size", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", "--only", "single", "--isolate",
+         "--mode-timeout", "240"]
+    )
+    assert set(results) == {"single"}
+    assert results["single"].tflops_total > 0
+    assert not compare_benchmarks._ORPHANS
+
+
 def test_probe_backend_via_child(monkeypatch):
     # --isolate's parent must learn (backend, world) without initializing
     # the backend itself; the probe child reports the CPU mesh here

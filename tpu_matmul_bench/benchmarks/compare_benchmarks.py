@@ -126,12 +126,37 @@ def _probe_backend(timeout_s: float) -> tuple[str, int]:
         return "unknown", 1
 
 
+# every row key compare() can produce — the valid --only vocabulary
+ROW_KEYS = frozenset({
+    "single", "independent", "batch_parallel", "matrix_parallel",
+    "data_parallel", "model_parallel", "hybrid",
+    "no_overlap", "overlap", "pipeline",
+    "collective_matmul", "collective_matmul_bidir",
+    "collective_matmul_rs", "collective_matmul_bidir_rs",
+    "pallas_ring", "pallas_ring_hbm", "pallas_ring_bidir_hbm",
+    "pallas_ring_rs_hbm",
+    "single_float32", "single_float16", "single_bfloat16",
+    "single_float32_strict",
+})
+
+
 def compare(size: int, dtype: str, num_devices: int | None,
             iterations: int, warmup: int,
             precision: str = "default",
             isolate: bool = False,
-            mode_timeout: float = 900.0) -> dict[str, BenchmarkRecord]:
+            mode_timeout: float = 900.0,
+            only: set[str] | None = None) -> dict[str, BenchmarkRecord]:
     import jax
+
+    if only is not None:
+        only = {k.strip() for k in only if k.strip()}
+        unknown = only - ROW_KEYS
+        if unknown:
+            # a typo must not silently run zero rows (the operator would
+            # read an empty table as 'those rows produced nothing')
+            raise SystemExit(
+                f"--only: unknown row key(s) {sorted(unknown)}; "
+                f"valid keys: {', '.join(sorted(ROW_KEYS))}")
 
     from tpu_matmul_bench.benchmarks import (
         matmul_benchmark,
@@ -144,11 +169,17 @@ def compare(size: int, dtype: str, num_devices: int | None,
     if isolate:
         # the parent must stay backend-free: world/platform come from a
         # probe child, and the rank-0 report gate is forced (the compare
-        # driver is single-controller by construction)
+        # driver is single-controller by construction). Only the hybrid
+        # and pallas_ring gates consume world/platform — skip the probe
+        # (which can stall on a sick backend) when --only excludes both.
         from tpu_matmul_bench.utils.reporting import force_reporting_process
 
         force_reporting_process(True)
-        backend, probed_n = _probe_backend(min(120.0, mode_timeout))
+        needs_probe = only is None or bool(only & {"hybrid", "pallas_ring"})
+        if needs_probe:
+            backend, probed_n = _probe_backend(min(120.0, mode_timeout))
+        else:
+            backend, probed_n = "unknown", 1
         world = num_devices or probed_n
     else:
         backend = None  # resolved lazily below via jax
@@ -163,14 +194,22 @@ def compare(size: int, dtype: str, num_devices: int | None,
             return _run_isolated(module.__name__, argv, mode_timeout)
         return _run(module.main, argv)
 
+    def want(name: str) -> bool:
+        # --only: re-run a subset of rows (e.g. the ones a previous
+        # --isolate run skipped) without paying for the whole table
+        return only is None or name in only
+
     results: dict[str, BenchmarkRecord] = {}
 
     # the 'single' row is the per-chip baseline — always exactly 1 device
-    report("\n### single-device matmul " + "#" * 40)
-    for rec in run_prog(matmul_benchmark, common + ["--num-devices", "1"]):
-        results["single"] = rec
+    if want("single"):
+        report("\n### single-device matmul " + "#" * 40)
+        for rec in run_prog(matmul_benchmark, common + ["--num-devices", "1"]):
+            results["single"] = rec
 
     for mode in ("independent", "batch_parallel", "matrix_parallel"):
+        if not want(mode):
+            continue
         report(f"\n### scaling: {mode} " + "#" * 40)
         for rec in run_prog(matmul_scaling_benchmark, base + ["--mode", mode]):
             results[mode] = rec
@@ -178,6 +217,8 @@ def compare(size: int, dtype: str, num_devices: int | None,
     # the distributed-benchmark rows the reference's compare also runs
     # (backup/compare_benchmarks.py:37-49 runs its data_parallel variant)
     for mode in ("data_parallel", "model_parallel"):
+        if not want(mode):
+            continue
         report(f"\n### distributed: {mode} " + "#" * 40)
         for rec in run_prog(matmul_distributed_benchmark,
                         base + ["--mode", mode]):
@@ -187,7 +228,9 @@ def compare(size: int, dtype: str, num_devices: int | None,
     # the gate mirrors make_hybrid_mesh's requirement: dp divides the world
     # and tp = world/dp is at least 1 more axis worth of devices
     hybrid_dp = 2
-    if world > hybrid_dp and world % hybrid_dp == 0:
+    if not want("hybrid"):
+        pass
+    elif world > hybrid_dp and world % hybrid_dp == 0:
         report("\n### hybrid (dp x tp) " + "#" * 40)
         for rec in run_prog(matmul_hybrid_benchmark,
                         base + ["--dp", str(hybrid_dp)]):
@@ -199,6 +242,8 @@ def compare(size: int, dtype: str, num_devices: int | None,
     for mode in ("no_overlap", "overlap", "pipeline", "collective_matmul",
                  "collective_matmul_bidir", "collective_matmul_rs",
                  "collective_matmul_bidir_rs"):
+        if not want(mode):
+            continue
         report(f"\n### overlap: {mode} " + "#" * 40)
         for rec in run_prog(matmul_overlap_benchmark, base + ["--mode", mode]):
             results[mode] = rec
@@ -213,7 +258,9 @@ def compare(size: int, dtype: str, num_devices: int | None,
     platform = backend if backend is not None else jax.default_backend()
     ring_cap = (pallas_ring_max_size(world, dtype)
                 if platform == "tpu" else size)
-    if size <= ring_cap:
+    if not want("pallas_ring"):
+        pass
+    elif size <= ring_cap:
         report(f"\n### overlap: pallas_ring " + "#" * 40)
         for rec in run_prog(matmul_overlap_benchmark,
                         base + ["--mode", "pallas_ring"]):
@@ -226,6 +273,8 @@ def compare(size: int, dtype: str, num_devices: int | None,
     # the HBM-blocked in-kernel rings have no VMEM cap — run the full size
     for hbm_mode in ("pallas_ring_hbm", "pallas_ring_bidir_hbm",
                      "pallas_ring_rs_hbm"):
+        if not want(hbm_mode):
+            continue
         report(f"\n### overlap: {hbm_mode} " + "#" * 36)
         for rec in run_prog(matmul_overlap_benchmark,
                         base + ["--mode", hbm_mode]):
@@ -234,9 +283,13 @@ def compare(size: int, dtype: str, num_devices: int | None,
     # dtype sweep on one device ≙ the reference README's bf16-vs-fp32
     # key insight (README.md:50, ~5× on the RTX 6000 Ada)
     for dt in ("float32", "float16", "bfloat16"):
-        if dt == dtype:
-            if "single" in results:
-                results[f"single_{dt}"] = results["single"]
+        if not want(f"single_{dt}"):
+            continue
+        if dt == dtype and "single" in results:
+            # alias of the already-measured baseline row; but when --only
+            # requested this dt row WITHOUT 'single', fall through and
+            # measure it — the explicit request must produce a row
+            results[f"single_{dt}"] = results["single"]
             continue
         report(f"\n### single-device {dt} " + "#" * 40)
         sweep_args = ["--sizes", str(size), "--dtype", dt,
@@ -249,7 +302,7 @@ def compare(size: int, dtype: str, num_devices: int | None,
     # (XLA's excess-precision default otherwise routes fp32 dots onto the
     # bf16 MXU path), so the reference's bf16-vs-fp32 key insight
     # (README.md:50, ~5×) is reproducible with a real gap
-    if precision != "highest":
+    if precision != "highest" and want("single_float32_strict"):
         report("\n### single-device float32 (strict lowering) " + "#" * 26)
         strict_args = ["--sizes", str(size), "--dtype", "float32",
                        "--iterations", str(iterations),
@@ -372,6 +425,11 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
                         "running and skipped)")
     p.add_argument("--mode-timeout", type=float, default=900.0,
                    help="soft per-row timeout (seconds) under --isolate")
+    p.add_argument("--only", type=str, default=None,
+                   help="comma-separated row keys to run (e.g. "
+                        "'single,overlap,single_float32_strict') — re-run "
+                        "a subset, such as rows a previous --isolate run "
+                        "skipped, without paying for the whole table")
     args = p.parse_args(argv)
 
     from tpu_matmul_bench.utils.reporting import force_reporting_process
@@ -381,7 +439,9 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
                           args.iterations, args.warmup,
                           precision=args.precision,
                           isolate=args.isolate,
-                          mode_timeout=args.mode_timeout)
+                          mode_timeout=args.mode_timeout,
+                          only=(set(args.only.split(","))
+                                if args.only else None))
         return _finish(args, results)
     finally:
         # compare(isolate=True) forces the report gate so the parent never

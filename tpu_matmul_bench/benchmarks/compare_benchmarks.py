@@ -364,6 +364,15 @@ def summarize(results: dict[str, BenchmarkRecord]) -> str:
         sp = results["collective_matmul"].extras.get("overlap_speedup_x")
         if sp:
             lines.append(f"ppermute collective matmul: {sp}x vs gather-then-matmul")
+    if ("collective_matmul_bidir" in results
+            and "collective_matmul" in results):
+        uni, bi = t("collective_matmul"), t("collective_matmul_bidir")
+        if uni and bi:
+            gain = (uni - bi) / uni * 100
+            lines.append(
+                f"Bidirectional ring vs unidirectional: {gain:+.1f}% step "
+                "time (expect a win only when the ring is comm-bound — "
+                "both ICI directions carry half-chunks)")
     dtype_line = bf16_vs_fp32_line(results)
     if dtype_line:
         lines.append(dtype_line)

@@ -28,6 +28,38 @@ def test_dryrun_multichip_8(capsys):
     assert "passed" in capsys.readouterr().out
 
 
+def test_dryrun_decides_without_probing_the_backend(monkeypatch):
+    """The round-2 wedge lesson: the respawn decision must come from
+    config/env only — `jax.devices()` on a sick tunneled backend hangs
+    forever, which would wedge the driver's MULTICHIP artifact. With no
+    forced device count in XLA_FLAGS this process is not a valid CPU-mesh
+    host, so dryrun must take the respawn path without any backend call."""
+    import __graft_entry__ as ge
+
+    calls = []
+    monkeypatch.setattr(ge, "_respawn_dryrun", lambda n: calls.append(n))
+    monkeypatch.delenv("_GRAFT_DRYRUN_CHILD", raising=False)
+    monkeypatch.setenv("XLA_FLAGS", "")  # no force-count → not a CPU mesh
+
+    def poisoned_devices(*a, **kw):  # a sick backend hangs; raising here
+        raise AssertionError("dryrun probed the backend before deciding")
+
+    monkeypatch.setattr(jax, "devices", poisoned_devices)
+    ge.dryrun_multichip(8)
+    assert calls == [8]
+
+
+def test_cpu_mesh_available_logic(monkeypatch):
+    import __graft_entry__ as ge
+
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    assert ge._cpu_mesh_available(8)       # conftest pins platforms=cpu
+    assert not ge._cpu_mesh_available(16)  # count too small
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert not ge._cpu_mesh_available(8)   # no forced count at all
+
+
 def test_dryrun_self_bootstraps_from_short_platform():
     """The round-1 driver failure mode: the caller's process initialized JAX
     on a platform with fewer than n devices (the 1-chip tunneled TPU). The

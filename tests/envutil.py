@@ -1,0 +1,35 @@
+"""Child-process environment helper shared by the subprocess-spawning tests.
+
+The container's sitecustomize force-registers the 1-chip tunneled TPU
+platform through PALLAS_AXON_POOL_IPS (verify SKILL.md), so any test that
+spawns a real child process must scrub that (plus the platform/flag vars)
+or the child will try — and with a sick tunnel, hang — to reach the TPU.
+"""
+
+from __future__ import annotations
+
+import os
+
+_AXON_VARS = ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
+
+
+def scrubbed_env(platforms: str | None = None,
+                 device_count: int | None = None) -> dict[str, str]:
+    """A copy of os.environ with the axon vars removed; optionally pin the
+    child to `platforms` (e.g. "cpu") and a forced host device count."""
+    env = {k: v for k, v in os.environ.items() if k not in _AXON_VARS}
+    if platforms is not None:
+        env["JAX_PLATFORMS"] = platforms
+    if device_count is not None:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={device_count}")
+    return env
+
+
+def apply_cpu_child_env(monkeypatch, device_count: int = 8) -> None:
+    """monkeypatch flavor, for code that spawns children off os.environ
+    directly (compare --isolate, its backend probe)."""
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv(
+        "XLA_FLAGS", f"--xla_force_host_platform_device_count={device_count}")

@@ -3,6 +3,8 @@ records, no stdout scraping."""
 
 import json
 
+from envutil import apply_cpu_child_env as _cpu_child_env
+
 from tpu_matmul_bench.benchmarks import compare_benchmarks
 
 
@@ -41,16 +43,6 @@ def test_record_json_roundtrip():
     d = _json.loads(rec.to_json())
     d["comparison_key"] = "collective_matmul_bidir"
     assert BenchmarkRecord.from_json(_json.dumps(d)) == rec
-
-
-def _cpu_child_env(monkeypatch):
-    # children must land on the virtual CPU mesh: the container's
-    # sitecustomize forces the axon TPU backend unless the pool env is
-    # unset (verify SKILL.md)
-    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
-    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
-    monkeypatch.setenv("XLA_FLAGS",
-                       "--xla_force_host_platform_device_count=8")
 
 
 def test_run_isolated_reads_child_records(monkeypatch):

@@ -1,9 +1,10 @@
 """Driver contract: entry() compiles; dryrun_multichip runs on the CPU mesh."""
 
-import os
 import subprocess
 import sys
 from pathlib import Path
+
+from envutil import scrubbed_env
 
 import jax
 import numpy as np
@@ -65,9 +66,8 @@ def test_dryrun_self_bootstraps_from_short_platform():
     on a platform with fewer than n devices (the 1-chip tunneled TPU). The
     fixed dryrun must respawn itself on an 8-device virtual CPU mesh and
     succeed rather than assert. Simulated here with a 1-device CPU parent."""
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")}
-    env["JAX_PLATFORMS"] = "cpu"  # 1 device — too few, like the driver's TPU
+    # 1 CPU device — too few, like the driver's TPU
+    env = scrubbed_env(platforms="cpu")
     out = subprocess.run(
         [sys.executable, "-c",
          "import jax\n"

@@ -8,11 +8,12 @@ processes, runs a cross-process psum through the framework's own mesh +
 collective wrappers, and checks the rank-0 reporting gate.
 """
 
-import os
 import socket
 import subprocess
 import sys
 from pathlib import Path
+
+from envutil import scrubbed_env
 
 WORKER = Path(__file__).parent / "multihost_worker.py"
 
@@ -26,8 +27,7 @@ def _free_port() -> int:
 def test_multihost_launcher_runs_scaling_benchmark():
     """The torchrun-analogue launcher: 2 coordinated processes running the
     real scaling benchmark over a 4-device (2 hosts × 2) global mesh."""
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")}
+    env = scrubbed_env()
     out = subprocess.run(
         ["./run_multihost_benchmark.sh", "2", "independent", "bfloat16",
          "--device=cpu", "--sizes", "64", "--iterations", "2", "--warmup", "1"],
@@ -47,8 +47,7 @@ def test_multihost_launcher_runs_bidir_overlap():
     (4-device global ring spanning the process boundary) — the
     counter-rotating ppermutes must resolve across hosts, not just on the
     single-process virtual mesh."""
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")}
+    env = scrubbed_env()
     env["MULTIHOST_PROGRAM"] = "overlap"
     out = subprocess.run(
         ["./run_multihost_benchmark.sh", "2", "collective_matmul_bidir",
@@ -64,8 +63,7 @@ def test_multihost_launcher_runs_bidir_overlap():
 
 def test_two_process_psum():
     coordinator = f"127.0.0.1:{_free_port()}"
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")}
+    env = scrubbed_env()
     env["PYTHONPATH"] = str(WORKER.parent.parent)
     procs = [
         subprocess.Popen(

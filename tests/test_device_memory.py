@@ -57,11 +57,13 @@ def test_estimate_memory_scales_with_dtype():
 
 
 def test_pallas_ring_max_size_fits_budget():
+    from tpu_matmul_bench.parallel.overlap import PALLAS_RING_VMEM_BUDGET
+
     for world in (2, 4, 8):
         s = pallas_ring_max_size(world, jnp.bfloat16)
         assert s % (128 * world) == 0  # lane-aligned, divisible by world
-        # 5·s²/world elements must be within the ~14 MiB budget
-        assert 5 * s * s // world * 2 <= 14 * 2**20
+        # 5·s²/world bf16 elements must fit the residency budget
+        assert 5 * s * s // world * 2 <= PALLAS_RING_VMEM_BUDGET
         # and the next step up must exceed it (the bound is tight)
         s2 = s + 128 * world
-        assert 5 * s2 * s2 // world * 2 > 14 * 2**20
+        assert 5 * s2 * s2 // world * 2 > PALLAS_RING_VMEM_BUDGET

@@ -17,8 +17,10 @@ hand-scheduled version where the overlap is explicit rather than left to the
 XLA scheduler.
 
 Scope note: operands are VMEM-resident, so per-device shards must fit the
-~16 MB/core VMEM budget (shard_m·k + k·shard_n + buffers). Fine for the ring
-sizes this mode benchmarks per-chunk; an HBM-blocked variant is future work.
+residency budget (`parallel/overlap.py PALLAS_RING_VMEM_BUDGET`, 48 MiB
+since r2 — the kernel raises Mosaic's `vmem_limit_bytes` to match). For
+arbitrary sizes use the HBM-blocked variants: `ops/pallas_ring_hbm.py`,
+`ops/pallas_ring_bidir_hbm.py`, and the RS dual `ops/pallas_ring_rs_hbm.py`.
 """
 
 from __future__ import annotations
@@ -132,6 +134,16 @@ def ring_allgather_matmul(mesh: Mesh, axis: str = "x",
         mshard, k = x_local.shape
         nshard = w_local.shape[1]
         m = mshard * d
+        # everything is VMEM-resident: x shard + 2 comm slots + w + y out —
+        # raise Mosaic's scoped budget to fit (same mechanism as
+        # ops/pallas_matmul.py; the residency cap itself lives in
+        # parallel/overlap.py PALLAS_RING_VMEM_BUDGET)
+        from tpu_matmul_bench.ops.pallas_matmul import _vmem_limit
+
+        item = jnp.dtype(x_local.dtype).itemsize
+        out_item = jnp.dtype(matmul_out_dtype(x_local.dtype)).itemsize
+        footprint = (3 * mshard * k + k * nshard) * item \
+            + m * nshard * out_item
         kernel = functools.partial(_ring_kernel, d, axis, not interpret)
         return pl.pallas_call(
             kernel,
@@ -151,6 +163,7 @@ def ring_allgather_matmul(mesh: Mesh, axis: str = "x",
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
                 collective_id=0,
+                vmem_limit_bytes=_vmem_limit(footprint),
             ),
             interpret=interpret,
         )(x_local, w_local)

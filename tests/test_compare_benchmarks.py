@@ -121,6 +121,40 @@ def test_compare_only_isolated_e2e(monkeypatch, tmp_path):
     assert not compare_benchmarks._ORPHANS
 
 
+def test_compare_strict_row_with_highest_precision(tmp_path):
+    # ADVICE r2: --only single_float32_strict under --precision highest
+    # used to pass --only validation but then silently skip the row,
+    # yielding an empty table; now the row aliases the (already strict)
+    # fp32 row, or measures it when that row wasn't requested
+    results = compare_benchmarks.main(
+        ["--size", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", "--precision", "highest",
+         "--only", "single_float32,single_float32_strict"])
+    assert "single_float32_strict" in results
+    assert results["single_float32_strict"] is results["single_float32"]
+    # strict alone (no fp32 row to alias): measured directly, still strict
+    results = compare_benchmarks.main(
+        ["--size", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", "--precision", "highest",
+         "--only", "single_float32_strict"])
+    assert set(results) == {"single_float32_strict"}
+    assert results["single_float32_strict"].tflops_total > 0
+
+
+def test_compare_isolate_restores_reporting_override(monkeypatch):
+    # ADVICE r2: compare(isolate=True) called as a library function must
+    # not leave the process-global reporting gate permanently forced
+    from tpu_matmul_bench.utils.reporting import reporting_process_override
+
+    _cpu_child_env(monkeypatch)
+    assert reporting_process_override() is None
+    compare_benchmarks.compare(
+        size=64, dtype="float32", num_devices=1, iterations=2, warmup=1,
+        isolate=True, mode_timeout=240.0, only={"single"})
+    assert reporting_process_override() is None
+    assert not compare_benchmarks._ORPHANS
+
+
 def test_probe_backend_via_child(monkeypatch):
     # --isolate's parent must learn (backend, world) without initializing
     # the backend itself; the probe child reports the CPU mesh here

@@ -68,6 +68,30 @@ def test_collective_matmul_bidir_rs_matches_dense(mesh, size):
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
 
 
+def test_bidir_programs_reject_single_row_shards(mesh):
+    # at m/d == 1 the forward half would be empty — the ring would quietly
+    # run unidirectionally while its record still says ring=bidirectional,
+    # so both bidir programs must refuse (ADVICE r2)
+    from tpu_matmul_bench.parallel.overlap import (
+        collective_matmul_bidir_rs_program,
+    )
+
+    d = mesh.shape["x"]
+    size = d  # exactly one local row per device
+    (x,) = sharded_normal(0, (size, size), jnp.float32, mesh,
+                          P("x", None), count=1)
+    (w,) = sharded_normal(1, (size, size), jnp.float32, mesh,
+                          P(None, "x"), count=1)
+    with pytest.raises(ValueError, match="bidirectional ring"):
+        collective_matmul_bidir_program(mesh)(x, w)
+    (x2,) = sharded_normal(0, (size, size), jnp.float32, mesh,
+                           P(None, "x"), count=1)
+    (w2,) = sharded_normal(1, (size, size), jnp.float32, mesh,
+                           P("x", None), count=1)
+    with pytest.raises(ValueError, match="bidirectional RS ring"):
+        collective_matmul_bidir_rs_program(mesh)(x2, w2)
+
+
 def test_collective_matmul_rs_matches_dense(mesh):
     # the chunked ring reduce-scatter matmul must equal the dense product:
     # X k-split P(None,'x'), W row-sharded P('x',None) → Y row-sharded

@@ -114,12 +114,18 @@ def _probe_backend(timeout_s: float) -> tuple[str, int]:
     import sys
 
     try:
+        # sentinel-prefixed line: jax/absl sometimes emit warnings on
+        # stdout, so parse only the line the probe itself printed
         out = subprocess.run(
             [sys.executable, "-c",
-             "import jax; print(jax.default_backend(), len(jax.devices()))"],
+             "import jax; print('PROBE::', jax.default_backend(),"
+             " len(jax.devices()))"],
             capture_output=True, text=True, timeout=timeout_s)
-        backend, n = out.stdout.split()
-        return backend, int(n)
+        for line in out.stdout.splitlines():
+            if line.startswith("PROBE:: "):
+                _, backend, n = line.split()
+                return backend, int(n)
+        raise ValueError(f"no probe line in {out.stdout!r}")
     except Exception:  # noqa: BLE001 — probe is best-effort
         report("[compare] backend probe failed or timed out — "
                "assuming 1 device")
@@ -146,8 +152,6 @@ def compare(size: int, dtype: str, num_devices: int | None,
             isolate: bool = False,
             mode_timeout: float = 900.0,
             only: set[str] | None = None) -> dict[str, BenchmarkRecord]:
-    import jax
-
     if only is not None:
         only = {k.strip() for k in only if k.strip()}
         unknown = only - ROW_KEYS
@@ -157,6 +161,31 @@ def compare(size: int, dtype: str, num_devices: int | None,
             raise SystemExit(
                 f"--only: unknown row key(s) {sorted(unknown)}; "
                 f"valid keys: {', '.join(sorted(ROW_KEYS))}")
+
+    if isolate:
+        # scope the reporting-gate override to this call: library/test
+        # callers invoking compare() directly must not leave the
+        # process-global gate permanently forced
+        from tpu_matmul_bench.utils.reporting import (
+            force_reporting_process,
+            reporting_process_override,
+        )
+
+        prev = reporting_process_override()
+        force_reporting_process(True)
+        try:
+            return _compare_rows(size, dtype, num_devices, iterations,
+                                 warmup, precision, isolate, mode_timeout,
+                                 only)
+        finally:
+            force_reporting_process(prev)
+    return _compare_rows(size, dtype, num_devices, iterations, warmup,
+                         precision, isolate, mode_timeout, only)
+
+
+def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
+                  isolate, mode_timeout, only) -> dict[str, BenchmarkRecord]:
+    import jax
 
     from tpu_matmul_bench.benchmarks import (
         matmul_benchmark,
@@ -168,13 +197,11 @@ def compare(size: int, dtype: str, num_devices: int | None,
 
     if isolate:
         # the parent must stay backend-free: world/platform come from a
-        # probe child, and the rank-0 report gate is forced (the compare
-        # driver is single-controller by construction). Only the hybrid
-        # and pallas_ring gates consume world/platform — skip the probe
-        # (which can stall on a sick backend) when --only excludes both.
-        from tpu_matmul_bench.utils.reporting import force_reporting_process
-
-        force_reporting_process(True)
+        # probe child (the rank-0 report gate is already forced by the
+        # compare() wrapper — the driver is single-controller by
+        # construction). Only the hybrid and pallas_ring gates consume
+        # world/platform — skip the probe (which can stall on a sick
+        # backend) when --only excludes both.
         needs_probe = only is None or bool(only & {"hybrid", "pallas_ring"})
         if needs_probe:
             backend, probed_n = _probe_backend(min(120.0, mode_timeout))
@@ -302,14 +329,29 @@ def compare(size: int, dtype: str, num_devices: int | None,
     # (XLA's excess-precision default otherwise routes fp32 dots onto the
     # bf16 MXU path), so the reference's bf16-vs-fp32 key insight
     # (README.md:50, ~5×) is reproducible with a real gap
-    if precision != "highest" and want("single_float32_strict"):
-        report("\n### single-device float32 (strict lowering) " + "#" * 26)
-        strict_args = ["--sizes", str(size), "--dtype", "float32",
-                       "--iterations", str(iterations),
-                       "--warmup", str(warmup),
-                       "--precision", "highest", "--num-devices", "1"]
-        for rec in run_prog(matmul_benchmark, strict_args):
-            results["single_float32_strict"] = rec
+    if want("single_float32_strict"):
+        # under --precision highest every fp32 row is already strict; the
+        # 'single' baseline qualifies too when the table dtype is float32
+        alias = None
+        if precision == "highest":
+            alias = results.get("single_float32") or (
+                results.get("single") if dtype == "float32" else None)
+        if alias is not None:
+            # alias so an explicit --only request still yields a row
+            # (instead of a silently empty table) without re-measuring
+            # an identical benchmark
+            report("\n### single_float32_strict = the fp32 row already "
+                   "measured (--precision highest makes it strict)")
+            results["single_float32_strict"] = alias
+        else:
+            report("\n### single-device float32 (strict lowering) "
+                   + "#" * 26)
+            strict_args = ["--sizes", str(size), "--dtype", "float32",
+                           "--iterations", str(iterations),
+                           "--warmup", str(warmup),
+                           "--precision", "highest", "--num-devices", "1"]
+            for rec in run_prog(matmul_benchmark, strict_args):
+                results["single_float32_strict"] = rec
 
     return results
 
@@ -441,9 +483,18 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
                         "skipped, without paying for the whole table")
     args = p.parse_args(argv)
 
-    from tpu_matmul_bench.utils.reporting import force_reporting_process
+    from tpu_matmul_bench.utils.reporting import (
+        force_reporting_process,
+        reporting_process_override,
+    )
 
+    prev = reporting_process_override()
     try:
+        # under --isolate the CLI parent must stay backend-free through
+        # _finish's own report() calls too (compare() scopes its override
+        # to itself), so the CLI forces the gate for its whole run
+        if args.isolate:
+            force_reporting_process(True)
         results = compare(args.size, args.dtype, args.num_devices,
                           args.iterations, args.warmup,
                           precision=args.precision,
@@ -453,11 +504,9 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
                                 if args.only else None))
         return _finish(args, results)
     finally:
-        # compare(isolate=True) forces the report gate so the parent never
-        # initializes the backend; undo only after ALL parent-side
-        # reporting is done, for in-process callers that keep using this
-        # interpreter (tests)
-        force_reporting_process(None)
+        # restore (not clear) after ALL parent-side reporting is done, for
+        # in-process callers that keep using this interpreter (tests)
+        force_reporting_process(prev)
 
 
 def _finish(args, results: dict[str, BenchmarkRecord]):

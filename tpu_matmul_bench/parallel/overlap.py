@@ -388,6 +388,14 @@ def collective_matmul_bidir_program(mesh: Mesh, impl: str = "xla",
 
     def body(x_local, w_local):  # [m/d, k], [k, n/d]
         mshard = x_local.shape[0]
+        if mshard < 2:
+            # at 1 local row the forward half is empty and the mode would
+            # silently degenerate to a unidirectional ring while still
+            # reporting ring=bidirectional (matches the Pallas bidir
+            # kernel's explicit guard)
+            raise ValueError(
+                f"bidirectional ring needs ≥2 local rows per device "
+                f"(m/d = {mshard}); use collective_matmul instead")
         my = jax.lax.axis_index("x")
         m = mshard * d
         half = mshard // 2
@@ -505,6 +513,12 @@ def collective_matmul_bidir_rs_program(mesh: Mesh, impl: str = "xla",
     def body(x_local, w_local):  # [m, k/d], [k/d, n]
         m = x_local.shape[0]
         mshard = m // d
+        if mshard < 2:
+            # same degeneration as the AG form: an empty forward half
+            # silently yields a unidirectional ring mislabeled bidir
+            raise ValueError(
+                f"bidirectional RS ring needs ≥2 output rows per device "
+                f"(m/d = {mshard}); use collective_matmul_rs instead")
         h = mshard // 2
         my = jax.lax.axis_index("x")
         out_dtype = matmul_out_dtype(x_local.dtype)

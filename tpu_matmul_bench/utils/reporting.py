@@ -125,6 +125,12 @@ def force_reporting_process(value: bool | None) -> None:
     _FORCE_REPORTING = value
 
 
+def reporting_process_override() -> bool | None:
+    """Current force_reporting_process value, for callers that save and
+    restore the override around a scoped use (compare --isolate)."""
+    return _FORCE_REPORTING
+
+
 def is_reporting_process() -> bool:
     """≙ the reference's `if rank == 0:` gate — true on the controller."""
     if _FORCE_REPORTING is not None:

@@ -106,3 +106,21 @@ def test_four_device_submesh(devices):
         mesh4, block_m=16, block_n=16, block_k=16)(x, w))
     want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_wres_fits_budget_math():
+    # W-resident gating: the whole local W shard + the pipeline tile set
+    # must fit the VMEM budget. d=8 16k bf16 (W 64 MiB, (1024,2048,512)
+    # tiles) fits; the d=1 16k shard (512 MiB) never does.
+    from tpu_matmul_bench.ops.pallas_ring_hbm import (
+        WRES_VMEM_BUDGET,
+        wres_fits,
+    )
+
+    assert wres_fits(16384, 2048, jnp.bfloat16, (1024, 2048, 512),
+                     jnp.bfloat16)
+    assert not wres_fits(16384, 16384, jnp.bfloat16, (4096, 2048, 512),
+                         jnp.bfloat16)
+    # budget boundary: a shard alone over the budget can never fit
+    over = WRES_VMEM_BUDGET // 2 + 1  # bf16 items → bytes = 2*items
+    assert not wres_fits(over, 1, jnp.bfloat16, (8, 8, 8), jnp.bfloat16)

@@ -36,6 +36,7 @@ from tpu_matmul_bench.ops.pallas_matmul import (
     vmem_bytes_estimate,
 )
 from tpu_matmul_bench.ops.pallas_ring_hbm import (
+    WRES_VMEM_BUDGET,
     _chunk_pipeline,
     default_hbm_blocks,
 )
@@ -49,12 +50,14 @@ def _bidir_ring_kernel(d: int, axis: str, use_barrier: bool,
                        blocks_b: tuple[int, int, int],
                        x_hbm, w_hbm, o_hbm, fwd_buf, bwd_buf,
                        fsend, frecv, ffree, bsend, brecv, bfree,
-                       acc_f, acc_b):
+                       acc_f, acc_b, *wres_refs):
     """One device's program: two counter-rotating half-chunk rings, two
     half-chunk pipelines per step. Forward ring: top halves hop to the
     RIGHT neighbor's fwd_buf (writer = left, so fwd acks go left).
     Backward ring: bottom halves hop LEFT (writer = right, acks go right).
-    Step 0 computes and sends straight from the input ref (no seed copy)."""
+    Step 0 computes and sends straight from the input ref (no seed copy).
+    `wres_refs` (optional (w_vmem, w_load_sem)): preload the W shard into
+    VMEM once, shared by both half-pipelines — see `_hbm_ring_kernel`."""
     mshard, k = x_hbm.shape
     nshard = w_hbm.shape[1]
     hb = mshard - h  # backward-half rows (≥ h when mshard is odd)
@@ -70,10 +73,17 @@ def _bidir_ring_kernel(d: int, axis: str, use_barrier: bool,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(barrier, 2)
 
+    w_vmem = None
+    if wres_refs:
+        w_vmem, w_load_sem = wres_refs
+        load = pltpu.make_async_copy(w_hbm, w_vmem, w_load_sem)
+        load.start()
+        load.wait()
+
     run_f = _chunk_pipeline(use_barrier, h, nshard, k, blocks_f, w_hbm,
-                            o_hbm.dtype, acc_f)
+                            o_hbm.dtype, acc_f, w_vmem=w_vmem)
     run_b = _chunk_pipeline(use_barrier, hb, nshard, k, blocks_b, w_hbm,
-                            o_hbm.dtype, acc_b)
+                            o_hbm.dtype, acc_b, w_vmem=w_vmem)
 
     for t in range(d):
         cur, nxt = t % 2, (t + 1) % 2
@@ -160,6 +170,16 @@ def ring_allgather_matmul_bidir_hbm(
         blocks_f = effective_blocks(h, nshard, k, bm, bn, bk)
         blocks_b = effective_blocks(mshard - h, nshard, k, bm, bn, bk)
         acc_dtype = matmul_acc_dtype(out_dtype)
+        # W-resident mode (see ring_allgather_matmul_hbm): one VMEM copy
+        # of W serves both half-pipelines for all d steps
+        tiles_bytes = (
+            vmem_bytes_estimate(*blocks_f, x_local.dtype, out_dtype,
+                                acc_dtype)
+            + vmem_bytes_estimate(*blocks_b, x_local.dtype, out_dtype,
+                                  acc_dtype))
+        w_bytes = k * nshard * jnp.dtype(x_local.dtype).itemsize
+        wres = (not interpret and d >= 2
+                and w_bytes + tiles_bytes <= WRES_VMEM_BUDGET)
         kernel = functools.partial(_bidir_ring_kernel, d, axis,
                                    not interpret, h, blocks_f, blocks_b)
         y, _, _ = pl.pallas_call(
@@ -190,17 +210,23 @@ def ring_allgather_matmul_bidir_hbm(
                 pltpu.SemaphoreType.REGULAR((2,)),  # bwd free-acks
                 pltpu.VMEM((blocks_f[0], blocks_f[1]), acc_dtype),
                 pltpu.VMEM((blocks_b[0], blocks_b[1]), acc_dtype),
-            ],
+            ] + ([pltpu.VMEM((k, nshard), x_local.dtype),
+                  pltpu.SemaphoreType.DMA(())] if wres else []),
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
                 collective_id=3,  # distinct from the other rings' barriers
                 # both half-pipelines' tile sets + both accumulators,
-                # raised past Mosaic's default budget as in pallas_matmul
+                # raised past Mosaic's default budget as in pallas_matmul;
+                # W-resident mode adds the whole W shard on top
                 vmem_limit_bytes=_vmem_limit(
-                    vmem_bytes_estimate(*blocks_f, x_local.dtype, out_dtype,
-                                        acc_dtype)
-                    + vmem_bytes_estimate(*blocks_b, x_local.dtype,
-                                          out_dtype, acc_dtype)),
+                    tiles_bytes + (w_bytes if wres else 0)),
+            ),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * m * k * nshard,
+                bytes_accessed=(m * k + (1 if wres else d) * k * nshard)
+                * x_local.dtype.itemsize
+                + m * nshard * jnp.dtype(out_dtype).itemsize,
+                transcendentals=0,
             ),
             interpret=interpret,
         )(x_local, w_local)

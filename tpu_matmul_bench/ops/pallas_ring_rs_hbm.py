@@ -35,7 +35,11 @@ from tpu_matmul_bench.ops.pallas_matmul import (
     effective_blocks,
     vmem_bytes_estimate,
 )
-from tpu_matmul_bench.ops.pallas_ring_hbm import default_hbm_blocks
+from tpu_matmul_bench.ops.pallas_ring_hbm import (
+    WRES_VMEM_BUDGET,
+    _matmul_wres_kernel,
+    default_hbm_blocks,
+)
 from tpu_matmul_bench.parallel.mesh import smap
 from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
 from jax.sharding import Mesh, PartitionSpec as P
@@ -58,11 +62,29 @@ def _rs_acc_kernel(x_ref, b_ref, accin_ref, o_ref, acc_ref):
             .astype(o_ref.dtype)
 
 
+def _rs_acc_wres_kernel(bn, bk, x_ref, accin_ref, o_ref, acc_ref, w_ref):
+    """`_rs_acc_kernel` with B read from the VMEM-resident W shard (the
+    RS analogue of `_matmul_wres_kernel`)."""
+    j, kk = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    b = w_ref[pl.ds(kk * bk, bk), pl.ds(j * bn, bn)]
+    acc_ref[:] += jnp.dot(x_ref[:], b, preferred_element_type=acc_ref.dtype)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _store():
+        o_ref[:] = (acc_ref[:] + accin_ref[:].astype(acc_ref.dtype)) \
+            .astype(o_ref.dtype)
+
+
 def _hbm_ring_rs_kernel(d: int, axis: str, use_barrier: bool,
                         blocks: tuple[int, int, int],
                         x_hbm, w_hbm, o_hbm, comm_buf,
                         send_sem, recv_sem, free_sem,
-                        acc_ref):
+                        acc_ref, *wres_refs):
     """One device's program. comm_buf slots: [0]/[1] alternate as the recv
     ring (written only by the LEFT neighbor's RDMA); [2]/[3] alternate as
     the staging double buffer this device computes into before sending
@@ -98,18 +120,46 @@ def _hbm_ring_rs_kernel(d: int, axis: str, use_barrier: bool,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(barrier, 2)
 
+    w_vmem = None
+    if wres_refs:
+        # preload the whole W shard into VMEM once (instead of streaming
+        # its tiles on every one of the d ring steps) — see
+        # pallas_ring_hbm's W-resident mode
+        w_vmem, w_load_sem = wres_refs
+        load = pltpu.make_async_copy(w_hbm, w_vmem, w_load_sem)
+        load.start()
+        load.wait()
+
     grid = (mshard // bm, n // bn, klocal // bk)
     x_specs = pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk))
     w_specs = pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j))
     o_specs = pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))
+    par_sem = (pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)
 
-    if use_barrier:  # compiled TPU: nested VMEM pipelines
+    if use_barrier and w_vmem is not None:  # compiled, W resident in VMEM
+        pipe_first = pltpu.emit_pipeline(
+            functools.partial(_matmul_wres_kernel, bn, bk), grid=grid,
+            in_specs=[x_specs], out_specs=o_specs,
+            dimension_semantics=par_sem)
+        pipe_acc = pltpu.emit_pipeline(
+            functools.partial(_rs_acc_wres_kernel, bn, bk), grid=grid,
+            in_specs=[x_specs, o_specs], out_specs=o_specs,
+            dimension_semantics=par_sem)
+
+        def chunk_matmul(t, rows, accin, dest):
+            if t == 0:
+                pipe_first(rows, dest, scratches=(acc_ref, w_vmem))
+            else:
+                pipe_acc(rows, accin, dest, scratches=(acc_ref, w_vmem))
+    elif use_barrier:  # compiled TPU: nested VMEM pipelines
         pipe_first = pltpu.emit_pipeline(  # t=0: no accumulator to pick up
             _matmul_kernel, grid=grid,
-            in_specs=[x_specs, w_specs], out_specs=o_specs)
+            in_specs=[x_specs, w_specs], out_specs=o_specs,
+            dimension_semantics=par_sem)
         pipe_acc = pltpu.emit_pipeline(
             _rs_acc_kernel, grid=grid,
-            in_specs=[x_specs, w_specs, o_specs], out_specs=o_specs)
+            in_specs=[x_specs, w_specs, o_specs], out_specs=o_specs,
+            dimension_semantics=par_sem)
 
         def chunk_matmul(t, rows, accin, dest):
             if t == 0:
@@ -210,6 +260,16 @@ def ring_reduce_scatter_matmul_hbm(
                           default_hbm_blocks(mshard, n, klocal,
                                              x_local.dtype, interpret)))
         blocks = effective_blocks(mshard, n, klocal, bm, bn, bk)
+        acc_dtype = matmul_acc_dtype(out_dtype)
+        # W-resident mode (see pallas_ring_hbm): the RS form's W shard is
+        # [k/d, n]; the accin tile doubles the out-tile budget share
+        tile_bytes = (vmem_bytes_estimate(*blocks, x_local.dtype, out_dtype,
+                                          acc_dtype)
+                      + 2 * blocks[0] * blocks[1]
+                      * jnp.dtype(out_dtype).itemsize)
+        w_bytes = klocal * n * jnp.dtype(x_local.dtype).itemsize
+        wres = (not interpret and d >= 2
+                and w_bytes + tile_bytes <= WRES_VMEM_BUDGET)
         kernel = functools.partial(_hbm_ring_rs_kernel, d, axis,
                                    not interpret, blocks)
         y, _ = pl.pallas_call(
@@ -234,21 +294,26 @@ def ring_reduce_scatter_matmul_hbm(
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.REGULAR((2,)),
-                pltpu.VMEM((blocks[0], blocks[1]),
-                           matmul_acc_dtype(out_dtype)),
-            ],
+                pltpu.VMEM((blocks[0], blocks[1]), acc_dtype),
+            ] + ([pltpu.VMEM((klocal, n), x_local.dtype),
+                  pltpu.SemaphoreType.DMA(())] if wres else []),
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
                 collective_id=2,  # distinct from the AG rings' barriers
                 # nested-pipeline tile set + the double-buffered accin tile
                 # (the ring pickup is a third pipeline input), raised past
-                # Mosaic's default budget as in ops/pallas_matmul.py
+                # Mosaic's default budget as in ops/pallas_matmul.py;
+                # W-resident mode adds the whole W shard on top
                 vmem_limit_bytes=_vmem_limit(
-                    vmem_bytes_estimate(
-                        *blocks, x_local.dtype, out_dtype,
-                        matmul_acc_dtype(out_dtype))
-                    + 2 * blocks[0] * blocks[1]
-                    * jnp.dtype(out_dtype).itemsize),
+                    tile_bytes + (w_bytes if wres else 0)),
+            ),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * m * klocal * n,
+                bytes_accessed=(m * klocal
+                                + (1 if wres else d) * klocal * n)
+                * x_local.dtype.itemsize
+                + m * n * jnp.dtype(out_dtype).itemsize,
+                transcendentals=0,
             ),
             interpret=interpret,
         )(x_local, w_local)

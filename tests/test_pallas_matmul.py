@@ -103,3 +103,37 @@ def test_fuzz_shapes_vs_xla():
         want = np.asarray(a) @ np.asarray(b)
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
                                    err_msg=f"shape {(m, k, n)}")
+
+
+def test_rect_row_keying():
+    # aspect-aware table: rows key on (axis ≥ ratio × min(other dims)) and
+    # take precedence over the min-dim square table; empty table → square
+    from tpu_matmul_bench.ops import pallas_matmul as pm
+
+    rows = [("n", 2, 4096, (4096, 2048, 512)),
+            ("n", 4, 4096, (2048, 4096, 512))]
+    # wide-N, ratio 4: the most-specific (largest-ratio) row wins
+    assert pm._rect_row(8192, 32768, 8192, rows) == (2048, 4096, 512)
+    # wide-N, ratio 2-4: the ratio-2 row
+    assert pm._rect_row(8192, 16384, 8192, rows) == (4096, 2048, 512)
+    # square: no rect row
+    assert pm._rect_row(8192, 8192, 8192, rows) is None
+    # wide but the small dims are under min_other: no rect row
+    assert pm._rect_row(1024, 8192, 1024, rows) is None
+    # tall-M axis keys against min(n, k)
+    mrows = [("m", 2, 4096, (4096, 1024, 512))]
+    assert pm._rect_row(16384, 4096, 8192, mrows) == (4096, 1024, 512)
+    assert pm._rect_row(4096, 16384, 8192, mrows) is None
+    # tuned_blocks consults the rect table first (monkeypatch a v5e row)
+    old = pm._RECT_V5E_ROWS.get("bfloat16")
+    pm._RECT_V5E_ROWS["bfloat16"] = rows
+    try:
+        assert pm.tuned_blocks(8192, 32768, 8192, "TPU v5e",
+                               jnp.bfloat16) == (2048, 4096, 512)
+        assert pm.tuned_blocks(8192, 8192, 8192, "TPU v5e",
+                               jnp.bfloat16) == (2048, 2048, 512)
+    finally:
+        if old is None:
+            del pm._RECT_V5E_ROWS["bfloat16"]
+        else:
+            pm._RECT_V5E_ROWS["bfloat16"] = old

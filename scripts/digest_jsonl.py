@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Digest benchmark --json-out JSONL files into ranked one-line summaries.
+
+Usage: python scripts/digest_jsonl.py measurements/r3/*.jsonl
+
+Groups records by (file, shape, dtype, mode) and prints them ranked by
+per-device throughput, with the blocking (tuner records carry it in
+extras) so sweep winners can be read off and baked into
+ops/pallas_matmul.py's tuned tables with provenance.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+
+def main(paths: list[str]) -> None:
+    for path in paths:
+        p = Path(path)
+        try:
+            lines = p.read_text().splitlines()
+        except OSError as e:
+            print(f"{p}: {e}")
+            continue
+        recs = []
+        for line in lines:
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue
+        if not recs:
+            print(f"\n## {p} — no parseable records")
+            continue
+        print(f"\n## {p} ({len(recs)} records)")
+        recs.sort(key=lambda r: -(r.get("tflops_per_device") or 0))
+        for r in recs:
+            ex = r.get("extras") or {}
+            shape = ex.get("shape") or f"{r.get('size')}²"
+            blocks = ""
+            if "block_m" in ex:  # tuner records carry the blocking
+                blocks = f"({ex['block_m']},{ex['block_n']},{ex['block_k']})"
+            unit = ex.get("throughput_unit", "TFLOPS")
+            extra_bits = " ".join(
+                f"{k}={ex[k]}" for k in
+                ("overlap_speedup_x", "validation", "timing_reliable",
+                 "kernel")
+                if k in ex)
+            print(f"  {r.get('tflops_per_device', 0):8.2f} {unit:6} "
+                  f"{shape:>18} {r.get('mode', ''):24} "
+                  f"{str(blocks):>18} it={r.get('iterations')} "
+                  f"{extra_bits}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:] or ["measurements/r3"])

@@ -43,7 +43,7 @@ python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
 # 3. int8 gap close at 8k/4k (VERDICT #3): wider grid around bn=4096 and
 #    k-major orders. Standard power-of-two tiles only (exotic tile shapes
 #    triggered the r2 compile-helper crash).
-INT8_CAND="2048,4096,512 2048,4096,1024 4096,2048,512 4096,2048,1024 1024,4096,512 4096,4096,512 2048,2048,1024 2048,2048,512 1024,2048,1024"
+INT8_CAND="2048,4096,512 2048,4096,1024 4096,2048,512 4096,2048,1024 1024,4096,512 4096,4096,512 2048,2048,1024 2048,2048,512 1024,2048,1024 2048,2048,2048 1024,1024,2048"
 step "tune: int8 8k grid"
 python -m tpu_matmul_bench tune --sizes 8192 --dtype int8 \
   --iterations $ITERS --candidates $INT8_CAND --json-out $R3/tune_int8_8k.jsonl

@@ -54,4 +54,7 @@ def main(paths: list[str]) -> None:
 
 
 if __name__ == "__main__":
+    import signal
+
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)  # `| head` is fine
     main(sys.argv[1:] or ["measurements/r3"])

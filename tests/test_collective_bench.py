@@ -50,13 +50,17 @@ def test_bandwidth_conventions():
     assert COLLECTIVES["all_gather"].bus_factor(8) == pytest.approx(0.875)
     assert COLLECTIVES["reduce_scatter"].bus_factor(8) == pytest.approx(0.875)
     assert COLLECTIVES["ppermute"].bus_factor(8) == 1.0
+    # bidir: each direction carries s/2, so busbw (per-direction traffic)
+    # is half the algbw; full-duplex wins show in algbw vs ppermute's
+    assert COLLECTIVES["ppermute_bidir"].bus_factor(8) == 0.5
     assert COLLECTIVES["all_to_all"].bus_factor(8) == pytest.approx(0.875)
     # all_gather's algbw divides by the total gathered output, others by the
     # per-rank shard — so per-link traffic/time (busbw) is comparable across
     # ops: e.g. all_gather busbw = (d-1)·s/t, a full ring's worth
     s = 1000
     assert COLLECTIVES["all_gather"].conv_size(8, s) == 8 * s
-    for op in ("psum", "reduce_scatter", "ppermute", "all_to_all"):
+    for op in ("psum", "reduce_scatter", "ppermute", "ppermute_bidir",
+               "all_to_all"):
         assert COLLECTIVES[op].conv_size(8, s) == s
 
 

@@ -21,7 +21,13 @@ from typing import Any, Callable
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpu_matmul_bench.parallel.mesh import ring_perm, sharded_normal, smap, world_size
+from tpu_matmul_bench.parallel.mesh import (
+    ring_perm,
+    ring_perm_rev,
+    sharded_normal,
+    smap,
+    world_size,
+)
 from tpu_matmul_bench.parallel.modes import corner_validation
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
@@ -55,6 +61,18 @@ class CollectiveSpec:
     needs_divisible_size: bool = False
 
 
+def _ppermute_bidir_body(d: int):
+    import jax.numpy as jnp
+
+    def body(x: jax.Array) -> jax.Array:
+        h = x.shape[0] // 2
+        top = jax.lax.ppermute(x[:h], "x", ring_perm(d))
+        bot = jax.lax.ppermute(x[h:], "x", ring_perm_rev(d))
+        return jnp.concatenate([top, bot], axis=0)
+
+    return body
+
+
 COLLECTIVES: dict[str, CollectiveSpec] = {
     "psum": CollectiveSpec(
         "psum",
@@ -85,6 +103,20 @@ COLLECTIVES: dict[str, CollectiveSpec] = {
         lambda d: lambda x: jax.lax.ppermute(x, "x", ring_perm(d)),
         lambda d, s: s,
         lambda d: 1.0,
+        lambda d: 3.0,
+    ),
+    # both ring directions at once — the full-duplex-link microbenchmark
+    # behind the bidirectional collective matmuls: the top payload half
+    # hops right while the bottom half hops left, so each ICI direction
+    # carries s/2 concurrently. bus_factor 0.5 makes busbw the
+    # per-DIRECTION link traffic (comparable to link speed like the other
+    # ops); full-duplex links show up as algbw ≈ 2× the unidirectional
+    # ppermute's at the same payload.
+    "ppermute_bidir": CollectiveSpec(
+        "ppermute_bidir",
+        lambda d: _ppermute_bidir_body(d),
+        lambda d, s: s,
+        lambda d: 0.5,
         lambda d: 3.0,
     ),
     "all_to_all": CollectiveSpec(
@@ -132,6 +164,12 @@ def _collective_reference(op: str, d: int, x) -> "object":
         return shards.sum(axis=0)  # row block j lands on device j → global sum
     if op == "ppermute":
         return np.concatenate([shards[(j - 1) % d] for j in range(d)])
+    if op == "ppermute_bidir":
+        h = shards.shape[1] // 2
+        return np.concatenate(
+            [np.concatenate([shards[(j - 1) % d][:h],
+                             shards[(j + 1) % d][h:]])
+             for j in range(d)])
     if op == "all_to_all":
         rows = shards.shape[1] // d
         blocks = shards.reshape(d, d, rows, xs.shape[1])  # [src, blk, r, c]

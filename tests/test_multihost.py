@@ -61,6 +61,25 @@ def test_multihost_launcher_runs_bidir_overlap():
     assert "validation: ok" in out.stdout
 
 
+def test_multihost_launcher_runs_bidir_rs_overlap():
+    """The RS dual of the bidirectional collective matmul over the same
+    real 2-process cluster: the counter-rotating half-ACCUMULATOR rings
+    (partial sums hopping in both directions) must resolve across the
+    process boundary too."""
+    env = scrubbed_env()
+    env["MULTIHOST_PROGRAM"] = "overlap"
+    out = subprocess.run(
+        ["./run_multihost_benchmark.sh", "2", "collective_matmul_bidir_rs",
+         "bfloat16", "--device=cpu", "--sizes", "64", "--iterations", "2",
+         "--warmup", "1", "--validate"],
+        cwd=str(WORKER.parent.parent), env=env, text=True,
+        capture_output=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Results for 64x64 [collective_matmul_bidir_rs]" in out.stdout
+    assert "validation: ok" in out.stdout
+
+
 def test_two_process_psum():
     coordinator = f"127.0.0.1:{_free_port()}"
     env = scrubbed_env()

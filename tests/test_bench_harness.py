@@ -242,3 +242,26 @@ def test_parent_never_calls_jax():
         capture_output=True, text=True, timeout=60,
     )
     assert out.stdout.strip() == "loaded", out.stderr
+
+
+def test_grace_drain_collects_late_result():
+    # a child that lands its result AFTER the internal budget (tunnel
+    # recovery) is still captured by the grace drain before exit
+    import os
+
+    writer = (
+        "import json,sys,time; time.sleep(4); "
+        "open(sys.argv[1],'w').write("
+        "json.dumps({'mode':'single','tflops_per_device':191.5})+'\\n')"
+    )
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env={**os.environ,
+             "BENCH_TIMEOUT_S": "31",   # deadline ≈ now+1s: child is late
+             "BENCH_HARD_CAP_S": "120",
+             "BENCH_CHILD_CMD": json.dumps(
+                 [sys.executable, "-c", writer, "{out}"])},
+        capture_output=True, text=True, timeout=180, cwd=str(REPO),
+    )
+    lines = [json.loads(l) for l in out.stdout.splitlines() if l.strip()]
+    assert lines[-1]["value"] == 191.5, out.stdout

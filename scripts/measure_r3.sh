@@ -18,6 +18,14 @@ mkdir -p measurements/r3
 R3=measurements/r3
 ITERS=20
 
+# Persistent compilation cache: compare --isolate spawns a fresh child per
+# row, and without this every child re-compiles its 16k program through
+# the remote compile service — the exact load pattern that preceded the
+# r2 wedge. With the cache, repeat compiles are local disk hits.
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
 step() { echo; echo "=== [$(date +%H:%M:%S)] $*"; }
 
 # 1. Headline confirmations, 50-iter protocol, artifact-backed (VERDICT

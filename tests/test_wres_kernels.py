@@ -1,0 +1,116 @@
+"""W-resident ring inner kernels (`_matmul_wres_kernel`,
+`_rs_acc_wres_kernel`) — the only path the ring tests' interpret mode
+doesn't reach (the compiled rings select it on TPU when the W shard fits
+VMEM). Drive the kernels' blocked-indexing math directly through an
+interpret-mode `pallas_call` whose grid matches the nested pipeline's,
+with W fed as a whole-array block (standing in for the VMEM-resident
+scratch) — the dynamic-slice tile reads must reproduce the dense product."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_matmul_bench.ops.pallas_ring_hbm import _matmul_wres_kernel
+from tpu_matmul_bench.ops.pallas_ring_rs_hbm import _rs_acc_wres_kernel
+
+M = N = K = 64
+BM, BN, BK = 16, 32, 16
+
+
+def _grid():
+    return (M // BM, N // BN, K // BK)
+
+
+def test_matmul_wres_kernel_matches_dense():
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((M, K)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((K, N)), jnp.float32)
+
+    def adapter(a_ref, w_ref, o_ref, acc_ref):
+        _matmul_wres_kernel(BN, BK, a_ref, o_ref, acc_ref, w_ref)
+
+    out = pl.pallas_call(
+        adapter,
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.float32),
+        grid=_grid(),
+        in_specs=[
+            pl.BlockSpec((BM, BK), lambda i, j, kk: (i, kk)),
+            # whole W every step — the stand-in for the VMEM-resident copy
+            pl.BlockSpec((K, N), lambda i, j, kk: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((BM, BN), lambda i, j, kk: (i, j)),
+        scratch_shapes=[pltpu.VMEM((BM, BN), jnp.float32)],
+        interpret=True,
+    )(a, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(a) @ np.asarray(w),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_rs_acc_wres_kernel_adds_ring_pickup():
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.standard_normal((M, K)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((K, N)), jnp.float32)
+    accin = jnp.asarray(rng.standard_normal((M, N)), jnp.float32)
+
+    def adapter(a_ref, w_ref, accin_ref, o_ref, acc_ref):
+        _rs_acc_wres_kernel(BN, BK, a_ref, accin_ref, o_ref, acc_ref, w_ref)
+
+    out = pl.pallas_call(
+        adapter,
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.float32),
+        grid=_grid(),
+        in_specs=[
+            pl.BlockSpec((BM, BK), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((K, N), lambda i, j, kk: (0, 0)),
+            pl.BlockSpec((BM, BN), lambda i, j, kk: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((BM, BN), lambda i, j, kk: (i, j)),
+        scratch_shapes=[pltpu.VMEM((BM, BN), jnp.float32)],
+        interpret=True,
+    )(a, w, accin)
+    want = np.asarray(a) @ np.asarray(w) + np.asarray(accin)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype,out_dtype",
+                         [(jnp.bfloat16, jnp.bfloat16),
+                          (jnp.int8, jnp.int32)])
+def test_matmul_wres_kernel_dtypes(dtype, out_dtype):
+    # the ring kernels run the wres path for bf16 and int8 too: int8
+    # accumulates exactly in int32, bf16 accumulates in f32
+    rng = np.random.default_rng(2)
+    if dtype == jnp.int8:
+        a = jnp.asarray(rng.integers(-5, 5, (M, K)), jnp.int8)
+        w = jnp.asarray(rng.integers(-5, 5, (K, N)), jnp.int8)
+        acc_dtype = jnp.int32
+    else:
+        a = jnp.asarray(rng.standard_normal((M, K)), dtype)
+        w = jnp.asarray(rng.standard_normal((K, N)), dtype)
+        acc_dtype = jnp.float32
+
+    def adapter(a_ref, w_ref, o_ref, acc_ref):
+        _matmul_wres_kernel(BN, BK, a_ref, o_ref, acc_ref, w_ref)
+
+    out = pl.pallas_call(
+        adapter,
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        grid=_grid(),
+        in_specs=[
+            pl.BlockSpec((BM, BK), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((K, N), lambda i, j, kk: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((BM, BN), lambda i, j, kk: (i, j)),
+        scratch_shapes=[pltpu.VMEM((BM, BN), acc_dtype)],
+        interpret=True,
+    )(a, w)
+    want = np.asarray(a, np.float64) @ np.asarray(w, np.float64)
+    got = np.asarray(out, np.float64)
+    if dtype == jnp.int8:
+        np.testing.assert_array_equal(got, want)
+    else:
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)

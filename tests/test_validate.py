@@ -95,6 +95,43 @@ def test_quantized_comm_validates_and_tolerance_scales(mesh, table, mode):
     assert rec.extras["validation_tolerance"] >= 2 * d / 254
 
 
+def test_quantized_allgather_matrix_parallel_validates(mesh):
+    # matrix_parallel's C-shard gather rides the int8 wire under
+    # --comm-quant int8 (r3): a single quantization, so the result must
+    # still validate and the record must carry the comm_quant marker
+    cfg = _cfg(extra=["--comm-quant", "int8"])
+    rec = run_mode_benchmark(SCALING_MODES["matrix_parallel"](cfg, mesh,
+                                                              SIZE), cfg)
+    assert rec.extras["validation"] == "ok", rec.extras
+    assert rec.extras["comm_quant"] == "int8"
+
+
+def test_quantized_allgather_semantics(mesh):
+    # the primitive itself: column-axis gather reassembles each device's
+    # block with its own scales; integer payloads pass through exactly
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_matmul_bench.parallel.mesh import sharded_normal, smap
+    from tpu_matmul_bench.parallel.quantized import quantized_all_gather
+
+    d = mesh.shape["x"]
+    (x,) = sharded_normal(3, (32, 8 * d), jnp.bfloat16, mesh, P(None, "x"),
+                          count=1)
+    fn = smap(lambda v: quantized_all_gather(v, "x", axis=1), mesh,
+              in_specs=P(None, "x"), out_specs=P(), check_vma=False)
+    got = np.asarray(fn(x), np.float32)
+    want = np.asarray(x, np.float32)
+    # one symmetric-int8 rounding: ≤ (1/254) of each row-block's max
+    assert np.abs(got - want).max() <= np.abs(want).max() / 127
+    (xi,) = sharded_normal(4, (8 * d, 16), jnp.int8, mesh, P("x", None),
+                           count=1)
+    fni = smap(lambda v: quantized_all_gather(v, "x", axis=0), mesh,
+               in_specs=P("x", None), out_specs=P(), check_vma=False)
+    np.testing.assert_array_equal(np.asarray(fni(xi)), np.asarray(xi))
+
+
 def test_int8_dtype_with_quantized_comm_is_exact(mesh):
     # integer inputs bypass the quantized wire (summed exactly via lax.psum)
     # — and that exact path must still satisfy the sharded out_specs' vma

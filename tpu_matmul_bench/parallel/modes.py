@@ -29,7 +29,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_matmul_bench.ops.matmul import matmul_2d
 from tpu_matmul_bench.parallel.mesh import sharded_normal, smap as _smap, world_size
-from tpu_matmul_bench.parallel.quantized import psum_impl, uses_quantized_comm
+from tpu_matmul_bench.parallel.quantized import (
+    allgather_impl,
+    psum_impl,
+    uses_quantized_comm,
+)
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.metrics import calculate_tflops, matmul_out_dtype
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
@@ -372,13 +376,15 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
                           P(None, "x"), count=1)
 
     mm = matmul_2d(config.matmul_impl, config.blocks)
+    # --comm-quant int8: the C-shard gather carries int8 + per-row scales
+    # (the AG analogue of the gradient-sync modes' quantized psum)
+    ag = allgather_impl(config.comm_quant)
     compute = _smap(
         mm,
         mesh, in_specs=(P(), P(None, "x")), out_specs=P(None, "x"),
     )
     full = _smap(
-        lambda x, y: jax.lax.all_gather(
-            _barrier(mm(x, y)), "x", axis=1, tiled=True),
+        lambda x, y: ag(_barrier(mm(x, y)), "x", axis=1),
         mesh, in_specs=(P(), P(None, "x")), out_specs=P(), check_vma=False,
     )
 
@@ -386,6 +392,9 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
         total_s = t_full.avg_s if t_full else t_compute.avg_s
         actual = calculate_tflops(size, total_s)  # full op / time (:334)
         per_dev = actual / d  # effective per-device (:233)
+        extras = {"portion_per_device": f"1/{d} of B's columns"}
+        if uses_quantized_comm(config):
+            extras["comm_quant"] = config.comm_quant
         return _record_base(
             config, benchmark, "matrix_parallel", size, d, t_full or t_compute,
             avg_time_s=total_s,
@@ -393,7 +402,7 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
             tflops_total=actual,
             compute_time_s=t_compute.avg_s,
             comm_time_s=comm_s,
-            extras={"portion_per_device": f"1/{d} of B's columns"},
+            extras=extras,
         )
 
     return ModeSetup("matrix_parallel", (a, b), compute, full, build,
@@ -401,7 +410,9 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
                          "matrix_parallel", config, d, size),
                      validate=make_corner_validate(
                          full, (a, b), lambda: expected_corner(a, b),
-                         config.dtype))
+                         config.dtype,
+                         quantized_comm=uses_quantized_comm(config),
+                         world=d))
 
 
 # ---------------------------------------------------------------------------

@@ -211,3 +211,16 @@ def test_summarize_table():
         {"no_overlap": rec("no_overlap", 0.2), "overlap": rec("overlap", 0.1)}
     )
     assert "Overlap hides 50.0%" in s
+
+
+def test_compare_comm_quant_threads_to_rows(tmp_path):
+    # --comm-quant int8 rides the psum/all_gather rows; the extras marker
+    # proves the child/in-process programs actually received the flag
+    results = compare_benchmarks.main(
+        ["--size", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", "--comm-quant", "int8",
+         "--only", "batch_parallel,matrix_parallel,single"])
+    assert results["batch_parallel"].extras.get("comm_quant") == "int8"
+    assert results["matrix_parallel"].extras.get("comm_quant") == "int8"
+    # rows without a quantizable collective are unaffected
+    assert "comm_quant" not in results["single"].extras

@@ -151,7 +151,8 @@ def compare(size: int, dtype: str, num_devices: int | None,
             precision: str = "default",
             isolate: bool = False,
             mode_timeout: float = 900.0,
-            only: set[str] | None = None) -> dict[str, BenchmarkRecord]:
+            only: set[str] | None = None,
+            comm_quant: str | None = None) -> dict[str, BenchmarkRecord]:
     if only is not None:
         only = {k.strip() for k in only if k.strip()}
         unknown = only - ROW_KEYS
@@ -176,15 +177,16 @@ def compare(size: int, dtype: str, num_devices: int | None,
         try:
             return _compare_rows(size, dtype, num_devices, iterations,
                                  warmup, precision, isolate, mode_timeout,
-                                 only)
+                                 only, comm_quant)
         finally:
             force_reporting_process(prev)
     return _compare_rows(size, dtype, num_devices, iterations, warmup,
-                         precision, isolate, mode_timeout, only)
+                         precision, isolate, mode_timeout, only, comm_quant)
 
 
 def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
-                  isolate, mode_timeout, only) -> dict[str, BenchmarkRecord]:
+                  isolate, mode_timeout, only,
+                  comm_quant=None) -> dict[str, BenchmarkRecord]:
     import jax
 
     from tpu_matmul_bench.benchmarks import (
@@ -214,6 +216,10 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
     common = ["--sizes", str(size), "--dtype", dtype,
               "--iterations", str(iterations), "--warmup", str(warmup),
               "--precision", precision]
+    if comm_quant and comm_quant != "none":
+        # rides every psum/all_gather-carrying row; rows without a
+        # quantizable collective ignore the flag
+        common = common + ["--comm-quant", comm_quant]
     base = common + (["--num-devices", str(num_devices)] if num_devices else [])
 
     def run_prog(module, argv: list[str]) -> list[BenchmarkRecord]:
@@ -464,6 +470,10 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
                    help="matmul precision for every row incl. the dtype "
                         "sweep — 'highest' makes the fp32 rows strict-fp32 "
                         "so the bf16-vs-fp32 line shows the real gap")
+    p.add_argument("--comm-quant", type=str, default=None,
+                   choices=["none", "int8"],
+                   help="int8-wire collectives for every row that has a "
+                        "quantizable psum/all_gather leg")
     p.add_argument("--json-out", type=str, default=None,
                    help="write the comparison table as JSON lines")
     p.add_argument("--markdown-out", type=str, default=None,
@@ -501,7 +511,8 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
                           isolate=args.isolate,
                           mode_timeout=args.mode_timeout,
                           only=(set(args.only.split(","))
-                                if args.only else None))
+                                if args.only else None),
+                          comm_quant=args.comm_quant)
         return _finish(args, results)
     finally:
         # restore (not clear) after ALL parent-side reporting is done, for

@@ -1,0 +1,40 @@
+"""Scaling-curve driver (one command → the reference README's
+devices-vs-throughput table) on the CPU mesh."""
+
+import json
+
+import pytest
+
+from tpu_matmul_bench.benchmarks import scaling_curve
+
+
+def test_curve_sweeps_device_counts(tmp_path):
+    md = tmp_path / "curve.md"
+    out = tmp_path / "curve.jsonl"
+    recs = scaling_curve.main(
+        ["--mode", "independent", "--sizes", "64", "--iterations", "2",
+         "--warmup", "1", "--dtype", "float32",
+         "--device-counts", "1,2,4",
+         "--markdown-out", str(md), "--json-out", str(out)])
+    assert [r.world for r in recs] == [1, 2, 4]
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert [l["extras"]["curve_devices"] for l in lines] == [1, 2, 4]
+    # multi-device independent rows carry scaling vs the measured 1-device
+    # baseline (the README table's third column)
+    assert lines[1]["scaling_efficiency_pct"] is not None
+    table = md.read_text()
+    assert table.count("\n") >= 4  # header + separator + 3 rows
+    assert "| Devices |" in table and "| 4 |" in table
+
+
+def test_curve_rejects_multi_size():
+    with pytest.raises(SystemExit, match="ONE size"):
+        scaling_curve.main(
+            ["--mode", "independent", "--sizes", "64", "128",
+             "--iterations", "1", "--warmup", "0", "--dtype", "float32"])
+
+
+def test_default_counts_powers_of_two():
+    assert scaling_curve.default_counts(8) == [1, 2, 4, 8]
+    assert scaling_curve.default_counts(6) == [1, 2, 4, 6]
+    assert scaling_curve.default_counts(1) == [1]

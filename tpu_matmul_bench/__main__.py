@@ -17,6 +17,7 @@ _PROGRAMS = {
     "overlap": "tpu_matmul_bench.benchmarks.matmul_overlap_benchmark",
     "collectives": "tpu_matmul_bench.benchmarks.collective_benchmark",
     "tune": "tpu_matmul_bench.benchmarks.pallas_tune",
+    "curve": "tpu_matmul_bench.benchmarks.scaling_curve",
     "hybrid": "tpu_matmul_bench.benchmarks.matmul_hybrid_benchmark",
     "compare": "tpu_matmul_bench.benchmarks.compare_benchmarks",
 }

@@ -1,0 +1,121 @@
+"""Scaling-curve driver — one command, one mode, a sweep of device counts.
+
+The reference publishes its scaling story as a table over device counts
+(1 vs 2 GPUs — `README.md:39-47`: total TFLOPS and scaling % per count),
+assembled by hand from separate `run_scaling_benchmark.sh N ...` runs.
+This driver produces that table in one invocation: it re-runs the scaling
+benchmark at each device count (powers of two up to the world size, or an
+explicit `--device-counts` list) and renders the per-count totals with
+scaling efficiency against the measured 1-device baseline.
+
+Run: python -m tpu_matmul_bench curve --mode batch_parallel \
+        --sizes 16384 [--device-counts 1,2,4,8] [--markdown-out t.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Sequence
+
+from tpu_matmul_bench.benchmarks import matmul_scaling_benchmark as scaling
+from tpu_matmul_bench.parallel.modes import SCALING_MODES
+from tpu_matmul_bench.utils.config import build_parser, config_from_args
+from tpu_matmul_bench.utils.reporting import (
+    BenchmarkRecord,
+    JsonWriter,
+    report,
+)
+
+
+def _parse_counts(text: str) -> list[int]:
+    try:
+        counts = sorted({int(p) for p in text.split(",") if p.strip()})
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--device-counts must be comma-separated ints, got {text!r}")
+    if not counts or any(c <= 0 for c in counts):
+        raise argparse.ArgumentTypeError(
+            f"--device-counts must be positive, got {text!r}")
+    return counts
+
+
+def default_counts(world: int) -> list[int]:
+    """1, 2, 4, ... up to the world size (always including the world)."""
+    counts = []
+    c = 1
+    while c < world:
+        counts.append(c)
+        c *= 2
+    counts.append(world)
+    return counts
+
+
+def render_curve(mode: str, size: int,
+                 rows: list[tuple[int, BenchmarkRecord]]) -> str:
+    """≙ the reference README table shape, one row per device count."""
+    lines = [
+        f"| Devices | Total TFLOPS ({size}x{size}, {mode}) | "
+        "TFLOPS/device | Scaling |",
+        "|---|---|---|---|",
+    ]
+    for n, rec in rows:
+        scaling_pct = (f"{rec.scaling_efficiency_pct:.0f}%"
+                       if rec.scaling_efficiency_pct is not None else "N/A")
+        lines.append(f"| {n} | {rec.tflops_total:.1f} | "
+                     f"{rec.tflops_per_device:.1f} | {scaling_pct} |")
+    return "\n".join(lines)
+
+
+def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
+    parser = build_parser(__doc__ or "scaling curve",
+                          modes=list(SCALING_MODES),
+                          default_mode="independent",
+                          extra_dtypes=("int8",))
+    parser.add_argument(
+        "--device-counts", type=_parse_counts, default=None,
+        help="comma-separated device counts to sweep (default: powers of "
+             "two up to the available world size)")
+    parser.add_argument(
+        "--markdown-out", type=str, default=None,
+        help="write the README-style curve table here")
+    args = parser.parse_args(argv)
+    config = config_from_args(args)
+    if len(config.sizes) != 1:
+        raise SystemExit("curve sweeps device counts at ONE size; "
+                         "pass a single --sizes value")
+    size = config.sizes[0]
+
+    if args.device_counts is not None:
+        counts = args.device_counts
+    else:
+        from tpu_matmul_bench.utils.device import resolve_devices
+
+        counts = default_counts(
+            len(resolve_devices(config.device, config.num_devices)))
+
+    rows: list[tuple[int, BenchmarkRecord]] = []
+    for n in counts:
+        report(f"\n### scaling curve: {config.mode} at {n} device(s) "
+               + "#" * 30)
+        # each count is a full scaling-benchmark run at --num-devices n;
+        # the child writes no JSONL of its own (this driver aggregates)
+        sub = dataclasses.replace(config, num_devices=n, json_out=None)
+        recs = scaling.run(sub)
+        if recs:
+            rows.append((n, recs[-1]))
+
+    table = render_curve(config.mode, size, rows)
+    report("\n" + table)
+    if args.markdown_out:
+        with open(args.markdown_out, "w") as fh:
+            fh.write(table + "\n")
+    with JsonWriter(config.json_out) as jw:
+        for n, rec in rows:
+            rec.extras.setdefault("curve_devices", n)
+            jw.write(rec)
+    return [rec for _, rec in rows]
+
+
+if __name__ == "__main__":
+    main()

@@ -97,6 +97,12 @@ python -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \
   --num-devices 1 --mode pallas_ring --validate \
   --json-out $R3/pallas_ring_cap.jsonl
 
+# 7b. HBM bandwidth (grounds the roofline denominator with a measured
+#     number; spec v5e ~819 GB/s).
+step "membw: STREAM ops at 8k/16k"
+python -m tpu_matmul_bench membw --sizes 8192 16384 --dtype bfloat16 \
+  --iterations 50 --warmup 5 --json-out $R3/membw.jsonl
+
 # 8. Full-mode compare at 16k with --isolate (VERDICT #2) — every row
 #    incl. the bidir forms and single_float32_strict; one wedged row is
 #    skipped, not fatal.

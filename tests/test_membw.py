@@ -1,0 +1,34 @@
+"""STREAM-style HBM bandwidth microbenchmark (CPU numbers are meaningless
+but the records' math and schema must hold)."""
+
+import json
+
+from tpu_matmul_bench.benchmarks import membw_benchmark
+
+
+def test_membw_records(tmp_path):
+    out = tmp_path / "bw.jsonl"
+    recs = membw_benchmark.main(
+        ["--sizes", "128", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", "--json-out", str(out)])
+    assert [r.mode for r in recs] == list(membw_benchmark.STREAM_OPS)
+    for r in recs:
+        assert r.benchmark == "membw"
+        assert r.algbw_gbps and r.algbw_gbps > 0
+        assert r.tflops_total == 0.0  # bandwidth, not FLOPs
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == len(recs)
+    # STREAM byte conventions: copy/scale/dot move 2 arrays, add/triad 3
+    per = 128 * 128 * 4
+    by_mode = {l["mode"]: l for l in lines}
+    assert by_mode["copy"]["bytes_per_device"] == 2 * per
+    assert by_mode["triad"]["bytes_per_device"] == 3 * per
+    assert by_mode["dot"]["bytes_per_device"] == 2 * per
+
+
+def test_membw_single_op():
+    recs = membw_benchmark.main(
+        ["--sizes", "128", "--iterations", "2", "--warmup", "1",
+         "--dtype", "bfloat16", "--mode", "triad"])
+    assert [r.mode for r in recs] == ["triad"]
+    assert recs[0].bytes_per_device == 3 * 128 * 128 * 2  # bf16 items

@@ -18,6 +18,7 @@ _PROGRAMS = {
     "collectives": "tpu_matmul_bench.benchmarks.collective_benchmark",
     "tune": "tpu_matmul_bench.benchmarks.pallas_tune",
     "curve": "tpu_matmul_bench.benchmarks.scaling_curve",
+    "membw": "tpu_matmul_bench.benchmarks.membw_benchmark",
     "hybrid": "tpu_matmul_bench.benchmarks.matmul_hybrid_benchmark",
     "compare": "tpu_matmul_bench.benchmarks.compare_benchmarks",
 }

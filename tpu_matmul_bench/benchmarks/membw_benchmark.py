@@ -1,0 +1,141 @@
+"""HBM bandwidth microbenchmark — STREAM-style, per chip.
+
+The roofline column on matmul records divides by the chip's *published*
+HBM bandwidth (`utils/metrics.py _HBM_GBPS`); this program measures the
+achievable number on the actual device so the roofline denominator is
+grounded: classic STREAM kernels (copy / scale / add / triad) plus a
+reduction, timed by the shared engine, reported as GB/s with the
+measured-vs-spec ratio in extras. No reference analogue (the reference
+never measures memory bandwidth; its closest is the README's "memory per
+matrix" accounting, `matmul_benchmark.py:99-103`).
+
+Run: python -m tpu_matmul_bench membw [--sizes 8192 16384] [--op triad]
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from tpu_matmul_bench.benchmarks.runner import run_sizes
+from tpu_matmul_bench.utils.config import BenchConfig, parse_config
+from tpu_matmul_bench.utils.device import (
+    collect_device_info,
+    device_banner,
+    resolve_devices,
+)
+from tpu_matmul_bench.utils.metrics import hbm_bandwidth_gbps
+from tpu_matmul_bench.utils.reporting import (
+    BenchmarkRecord,
+    header,
+    report,
+)
+from tpu_matmul_bench.utils.timing import time_jitted
+
+# STREAM convention: name -> (program(a, b, s), bytes moved per element
+# slot — reads + writes of n²-element arrays). The scalar rides as a
+# traced argument so XLA cannot constant-fold any kernel away.
+STREAM_OPS: dict[str, tuple[Callable, int]] = {
+    "copy": (lambda a, b, s: a + 0 * s, 2),  # read a, write out
+    "scale": (lambda a, b, s: a * s, 2),
+    "add": (lambda a, b, s: a + b + 0 * s, 3),  # read a+b, write out
+    "triad": (lambda a, b, s: a + s * b, 3),
+    "dot": (lambda a, b, s: jnp.sum(a * b) * s, 2),  # reads only
+}
+
+
+def bench_membw(config: BenchConfig, size: int, op: str,
+                device) -> BenchmarkRecord:
+    fn, bytes_factor = STREAM_OPS[op]
+    key = jax.random.PRNGKey(config.seed)
+    ka, kb = jax.random.split(key)
+    a = jax.device_put(
+        jax.random.normal(ka, (size, size), jnp.float32).astype(config.dtype),
+        device)
+    b = jax.device_put(
+        jax.random.normal(kb, (size, size), jnp.float32).astype(config.dtype),
+        device)
+    s = jax.device_put(jnp.asarray(1.0001, config.dtype), device)
+    jitted = jax.jit(fn)  # operands are committed to `device` above
+    t = time_jitted(jitted, (a, b, s), iterations=config.iterations,
+                    warmup=config.warmup)
+    moved = bytes_factor * size * size * jnp.dtype(config.dtype).itemsize
+    gbps = moved / t.avg_s / 1e9
+    info = collect_device_info([device])
+    spec = hbm_bandwidth_gbps(info.device_kind)
+    rec = BenchmarkRecord(
+        benchmark="membw",
+        mode=op,
+        size=size,
+        dtype=config.dtype_name,
+        world=1,
+        iterations=t.iterations,
+        warmup=config.warmup,
+        avg_time_s=t.avg_s,
+        tflops_per_device=0.0,  # not a FLOP benchmark
+        tflops_total=0.0,
+        device_kind=info.device_kind,
+        bytes_per_device=moved,
+        algbw_gbps=gbps,
+        extras={"stream_op": op, "bytes_factor": bytes_factor},
+    )
+    if spec:
+        rec.extras["pct_of_spec_hbm_bw"] = round(100.0 * gbps / spec, 1)
+    if not t.reliable:
+        rec.extras["timing_reliable"] = False
+    return rec
+
+
+def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
+    config = parse_config(
+        argv,
+        description=__doc__ or "HBM bandwidth benchmark",
+        modes=list(STREAM_OPS) + ["all"],
+        default_mode="all",
+    )
+    devices = resolve_devices(config.device, 1)
+    device = devices[0]
+    info = collect_device_info(devices)
+    report(device_banner(info))
+    ops = list(STREAM_OPS) if config.mode == "all" else [config.mode]
+    report(header(
+        "HBM Bandwidth Microbenchmark (STREAM-style)",
+        {
+            "Ops": ", ".join(ops),
+            "Sizes": config.sizes,
+            "Data type": config.dtype_name,
+            "Iterations per test": config.iterations,
+        },
+    ))
+
+    import dataclasses
+
+    from tpu_matmul_bench.utils.reporting import JsonWriter
+
+    records: list[BenchmarkRecord] = []
+    # run_sizes opens config.json_out in "w" mode per call, so per-op calls
+    # run with it cleared and this driver writes the one aggregate file
+    sub = dataclasses.replace(config, json_out=None)
+    for op in ops:
+        report(f"\n### membw: {op} " + "#" * 40)
+
+        def bench_one(size: int, _op=op) -> BenchmarkRecord:
+            return bench_membw(config, size, _op, device)
+
+        records += run_sizes(
+            sub, bench_one,
+            memory_gib=lambda s: 3 * s * s
+            * jnp.dtype(config.dtype).itemsize / 2**30,
+            memory_limit_gib=info.memory_gib,
+        )
+    with JsonWriter(config.json_out) as jw:
+        for rec in records:
+            jw.write(rec)
+    report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)
+    return records
+
+
+if __name__ == "__main__":
+    main()

@@ -24,16 +24,32 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _run_launcher(args: list[str], env: dict, attempts: int = 2):
+    """Run the multihost launcher, retrying once on the known Gloo
+    transport race: under heavy host load jax's experimental CPU
+    collectives can drop a TCP pair mid-benchmark ('Connection closed by
+    peer'); both ranks then skip the size via the OOM backstop and exit 0
+    with no results block. The benchmark ends with a cluster exit barrier
+    (teardown-race fix); the remaining mid-run rendezvous race is
+    jax-internal and load-dependent, so the test retries once."""
+    for attempt in range(attempts):
+        out = subprocess.run(
+            args, cwd=str(WORKER.parent.parent), env=env, text=True,
+            capture_output=True, timeout=300,
+        )
+        if out.returncode == 0 and "Results for" in out.stdout:
+            return out
+    return out
+
+
 def test_multihost_launcher_runs_scaling_benchmark():
     """The torchrun-analogue launcher: 2 coordinated processes running the
     real scaling benchmark over a 4-device (2 hosts × 2) global mesh."""
     env = scrubbed_env()
-    out = subprocess.run(
+    out = _run_launcher(
         ["./run_multihost_benchmark.sh", "2", "independent", "bfloat16",
          "--device=cpu", "--sizes", "64", "--iterations", "2", "--warmup", "1"],
-        cwd=str(WORKER.parent.parent), env=env, text=True,
-        capture_output=True, timeout=300,
-    )
+        env)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "Number of devices: 4" in out.stdout
     assert "Processes: 2 (this is process 0)" in out.stdout
@@ -49,13 +65,11 @@ def test_multihost_launcher_runs_bidir_overlap():
     single-process virtual mesh."""
     env = scrubbed_env()
     env["MULTIHOST_PROGRAM"] = "overlap"
-    out = subprocess.run(
+    out = _run_launcher(
         ["./run_multihost_benchmark.sh", "2", "collective_matmul_bidir",
          "bfloat16", "--device=cpu", "--sizes", "64", "--iterations", "2",
          "--warmup", "1", "--validate"],
-        cwd=str(WORKER.parent.parent), env=env, text=True,
-        capture_output=True, timeout=300,
-    )
+        env)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "Results for 64x64 [collective_matmul_bidir]" in out.stdout
     assert "validation: ok" in out.stdout
@@ -68,13 +82,11 @@ def test_multihost_launcher_runs_bidir_rs_overlap():
     process boundary too."""
     env = scrubbed_env()
     env["MULTIHOST_PROGRAM"] = "overlap"
-    out = subprocess.run(
+    out = _run_launcher(
         ["./run_multihost_benchmark.sh", "2", "collective_matmul_bidir_rs",
          "bfloat16", "--device=cpu", "--sizes", "64", "--iterations", "2",
          "--warmup", "1", "--validate"],
-        cwd=str(WORKER.parent.parent), env=env, text=True,
-        capture_output=True, timeout=300,
-    )
+        env)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "Results for 64x64 [collective_matmul_bidir_rs]" in out.stdout
     assert "validation: ok" in out.stdout

@@ -17,6 +17,9 @@ import sys
 from typing import Sequence
 
 from tpu_matmul_bench.benchmarks.runner import run_sizes
+from tpu_matmul_bench.benchmarks.matmul_scaling_benchmark import (
+    cluster_exit_barrier,
+)
 from tpu_matmul_bench.parallel.collective_bench import (
     COLLECTIVES,
     run_collective_benchmark,
@@ -84,6 +87,7 @@ def run(config: BenchConfig) -> list[BenchmarkRecord]:
                                                    count=mem_factor),
             memory_limit_gib=info.memory_gib,
         )
+    cluster_exit_barrier()
     report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)
     return records
 

@@ -215,6 +215,11 @@ def run(config: BenchConfig, mkn: tuple[int, int, int] | None = None
             memory_gib=lambda s: MatmulWorkload(s, config.dtype).memory_gib,
             memory_limit_gib=info.memory_gib,
         )
+    from tpu_matmul_bench.benchmarks.matmul_scaling_benchmark import (
+        cluster_exit_barrier,
+    )
+
+    cluster_exit_barrier()
     report("\n" + "=" * 60, "Benchmark completed!", "=" * 60)
     return records
 

@@ -14,6 +14,9 @@ from __future__ import annotations
 from typing import Sequence
 
 from tpu_matmul_bench.benchmarks.runner import run_sizes
+from tpu_matmul_bench.benchmarks.matmul_scaling_benchmark import (
+    cluster_exit_barrier,
+)
 from tpu_matmul_bench.parallel.collectives import verify_collectives
 from tpu_matmul_bench.parallel.hybrid import hybrid_mode, make_hybrid_mesh
 from tpu_matmul_bench.parallel.mesh import make_mesh
@@ -65,6 +68,7 @@ def run(config: BenchConfig, dp: int, batch: int) -> list[BenchmarkRecord]:
                 "hybrid", config, len(devices), s, batch=batch, dp=dp),
             memory_limit_gib=info.memory_gib,
         )
+    cluster_exit_barrier()
     report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)
     return records
 

@@ -106,8 +106,24 @@ def run(
             memory_gib=lambda s: estimate_memory_gib(config.mode, config, d, s),
             memory_limit_gib=info.memory_gib,
         )
+    cluster_exit_barrier()
     report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)
     return records
+
+
+def cluster_exit_barrier() -> None:
+    """Park every process at a barrier before teardown — the
+    `destroy_process_group` analogue. Gloo/ICI op *completion* is not a
+    barrier: a fast process can finish its half of the final collective
+    and exit, tearing down its transport while a slower peer's side still
+    has in-flight reads — observed as 'Gloo ReduceScatter failed:
+    Connection closed by peer' under host load. No-op single-process."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("benchmark_exit")
 
 
 def _single_device_tflops(config: BenchConfig, device, size: int) -> float:

@@ -17,7 +17,14 @@ from pathlib import Path
 
 
 def main(paths: list[str]) -> None:
+    # a directory argument (incl. the no-args default) digests its JSONLs
+    expanded: list[str] = []
     for path in paths:
+        if Path(path).is_dir():
+            expanded += sorted(str(f) for f in Path(path).glob("*.jsonl"))
+        else:
+            expanded.append(path)
+    for path in expanded:
         p = Path(path)
         try:
             lines = p.read_text().splitlines()

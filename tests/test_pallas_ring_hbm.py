@@ -124,3 +124,10 @@ def test_wres_fits_budget_math():
     # budget boundary: a shard alone over the budget can never fit
     over = WRES_VMEM_BUDGET // 2 + 1  # bf16 items → bytes = 2*items
     assert not wres_fits(over, 1, jnp.bfloat16, (8, 8, 8), jnp.bfloat16)
+    # extra_tile_bytes (the bidir second half-pipeline / RS accin pair)
+    # counts against the same budget
+    assert wres_fits(16384, 2048, jnp.bfloat16, (1024, 2048, 512),
+                     jnp.bfloat16, extra_tile_bytes=1 << 20)
+    assert not wres_fits(16384, 2048, jnp.bfloat16, (1024, 2048, 512),
+                         jnp.bfloat16,
+                         extra_tile_bytes=WRES_VMEM_BUDGET)

@@ -86,6 +86,14 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                          "pass a single --sizes value")
     size = config.sizes[0]
 
+    # cluster join must precede ANY backend-initializing call — the
+    # default-counts path below resolves devices (jax.devices()), which
+    # would otherwise pin a local-only backend before scaling.run() gets
+    # to initialize the multihost cluster
+    from tpu_matmul_bench.utils.device import maybe_init_multihost
+
+    maybe_init_multihost()
+
     if args.device_counts is not None:
         counts = args.device_counts
     else:

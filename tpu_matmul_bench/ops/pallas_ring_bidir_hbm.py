@@ -36,9 +36,10 @@ from tpu_matmul_bench.ops.pallas_matmul import (
     vmem_bytes_estimate,
 )
 from tpu_matmul_bench.ops.pallas_ring_hbm import (
-    WRES_VMEM_BUDGET,
     _chunk_pipeline,
     default_hbm_blocks,
+    wres_fits,
+    wres_tile_bytes,
 )
 from tpu_matmul_bench.parallel.mesh import smap
 from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
@@ -171,15 +172,21 @@ def ring_allgather_matmul_bidir_hbm(
         blocks_b = effective_blocks(mshard - h, nshard, k, bm, bn, bk)
         acc_dtype = matmul_acc_dtype(out_dtype)
         # W-resident mode (see ring_allgather_matmul_hbm): one VMEM copy
-        # of W serves both half-pipelines for all d steps
-        tiles_bytes = (
-            vmem_bytes_estimate(*blocks_f, x_local.dtype, out_dtype,
-                                acc_dtype)
-            + vmem_bytes_estimate(*blocks_b, x_local.dtype, out_dtype,
-                                  acc_dtype))
+        # of W serves both half-pipelines for all d steps; the fit and
+        # footprint math is the shared wres_fits/wres_tile_bytes
         w_bytes = k * nshard * jnp.dtype(x_local.dtype).itemsize
         wres = (not interpret and d >= 2
-                and w_bytes + tiles_bytes <= WRES_VMEM_BUDGET)
+                and wres_fits(k, nshard, x_local.dtype, blocks_f, out_dtype,
+                              extra_tile_bytes=wres_tile_bytes(
+                                  blocks_b, x_local.dtype, out_dtype)))
+        tiles_bytes = (
+            (wres_tile_bytes(blocks_f, x_local.dtype, out_dtype)
+             + wres_tile_bytes(blocks_b, x_local.dtype, out_dtype))
+            if wres else
+            (vmem_bytes_estimate(*blocks_f, x_local.dtype, out_dtype,
+                                 acc_dtype)
+             + vmem_bytes_estimate(*blocks_b, x_local.dtype, out_dtype,
+                                   acc_dtype)))
         kernel = functools.partial(_bidir_ring_kernel, d, axis,
                                    not interpret, h, blocks_f, blocks_b)
         y, _, _ = pl.pallas_call(

@@ -62,17 +62,27 @@ def _matmul_wres_kernel(bn, bk, a_ref, o_ref, acc_ref, w_ref):
 WRES_VMEM_BUDGET = 100 * 1024 * 1024
 
 
-def wres_fits(k: int, nshard: int, dtype,
-              blocks: tuple[int, int, int], out_dtype) -> bool:
-    """True when the W-resident layout fits the VMEM budget: the whole
-    [k, nshard] W shard + the A/out pipeline tiles + the accumulator."""
+def wres_tile_bytes(blocks: tuple[int, int, int], in_dtype,
+                    out_dtype) -> int:
+    """One W-resident pipeline's VMEM tile set: double-buffered A tiles,
+    double-buffered out tiles, and the accumulator (no B-stream buffers —
+    W is resident). The ONE tile formula all three ring kernels share."""
     bm, bn, bk = blocks
-    in_sz = jnp.dtype(dtype).itemsize
-    w_bytes = k * nshard * in_sz
-    tiles = (2 * bm * bk * in_sz
-             + 2 * bm * bn * jnp.dtype(out_dtype).itemsize
-             + bm * bn * jnp.dtype(matmul_acc_dtype(out_dtype)).itemsize)
-    return w_bytes + tiles <= WRES_VMEM_BUDGET
+    return (2 * bm * bk * jnp.dtype(in_dtype).itemsize
+            + 2 * bm * bn * jnp.dtype(out_dtype).itemsize
+            + bm * bn * jnp.dtype(matmul_acc_dtype(out_dtype)).itemsize)
+
+
+def wres_fits(k: int, nshard: int, dtype,
+              blocks: tuple[int, int, int], out_dtype,
+              extra_tile_bytes: int = 0) -> bool:
+    """True when the W-resident layout fits the VMEM budget: the whole
+    [k, nshard] W shard + the pipeline tile set (+ any extra tiles a
+    specific ring streams — the bidir form's second half-pipeline, the RS
+    form's accin pair)."""
+    w_bytes = k * nshard * jnp.dtype(dtype).itemsize
+    return (w_bytes + wres_tile_bytes(blocks, dtype, out_dtype)
+            + extra_tile_bytes <= WRES_VMEM_BUDGET)
 
 
 def _chunk_pipeline(use_barrier, rows, nshard, k, blocks, w_hbm, o_dtype,
@@ -275,8 +285,12 @@ def ring_allgather_matmul_hbm(
                 and wres_fits(k, nshard, x_local.dtype, blocks, out_dtype))
         kernel = functools.partial(_hbm_ring_kernel, d, axis, not interpret,
                                    blocks)
-        tile_bytes = vmem_bytes_estimate(*blocks, x_local.dtype, out_dtype,
-                                         acc_dtype)
+        # resident footprint: B-stream tiles when streaming W, the W shard
+        # + the slimmer wres tile set when resident
+        tile_bytes = (wres_tile_bytes(blocks, x_local.dtype, out_dtype)
+                      if wres else
+                      vmem_bytes_estimate(*blocks, x_local.dtype, out_dtype,
+                                          acc_dtype))
         w_bytes = k * nshard * jnp.dtype(x_local.dtype).itemsize
         y, _ = pl.pallas_call(
             kernel,

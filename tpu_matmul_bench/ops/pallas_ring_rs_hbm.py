@@ -36,9 +36,10 @@ from tpu_matmul_bench.ops.pallas_matmul import (
     vmem_bytes_estimate,
 )
 from tpu_matmul_bench.ops.pallas_ring_hbm import (
-    WRES_VMEM_BUDGET,
     _matmul_wres_kernel,
     default_hbm_blocks,
+    wres_fits,
+    wres_tile_bytes,
 )
 from tpu_matmul_bench.parallel.mesh import smap
 from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
@@ -261,15 +262,19 @@ def ring_reduce_scatter_matmul_hbm(
                                              x_local.dtype, interpret)))
         blocks = effective_blocks(mshard, n, klocal, bm, bn, bk)
         acc_dtype = matmul_acc_dtype(out_dtype)
-        # W-resident mode (see pallas_ring_hbm): the RS form's W shard is
-        # [k/d, n]; the accin tile doubles the out-tile budget share
-        tile_bytes = (vmem_bytes_estimate(*blocks, x_local.dtype, out_dtype,
-                                          acc_dtype)
-                      + 2 * blocks[0] * blocks[1]
-                      * jnp.dtype(out_dtype).itemsize)
+        # W-resident mode (see pallas_ring_hbm; shared wres_fits math):
+        # the RS form's W shard is [k/d, n] and its pipelines stream an
+        # extra double-buffered accin tile (the ring pickup)
+        accin_bytes = 2 * blocks[0] * blocks[1] * jnp.dtype(out_dtype).itemsize
         w_bytes = klocal * n * jnp.dtype(x_local.dtype).itemsize
         wres = (not interpret and d >= 2
-                and w_bytes + tile_bytes <= WRES_VMEM_BUDGET)
+                and wres_fits(klocal, n, x_local.dtype, blocks, out_dtype,
+                              extra_tile_bytes=accin_bytes))
+        tile_bytes = accin_bytes + (
+            wres_tile_bytes(blocks, x_local.dtype, out_dtype)
+            if wres else
+            vmem_bytes_estimate(*blocks, x_local.dtype, out_dtype,
+                                acc_dtype))
         kernel = functools.partial(_hbm_ring_rs_kernel, d, axis,
                                    not interpret, blocks)
         y, _ = pl.pallas_call(

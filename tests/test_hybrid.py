@@ -82,3 +82,19 @@ def test_hybrid_cli(capsys):
     out = capsys.readouterr().out
     assert "dp=4 x tp=2" in out
     assert len(records) == 1 and records[0].extras["dp"] == 4
+
+
+def test_hybrid_quantized_comm_validates(mesh2x4):
+    # --comm-quant int8 rides BOTH hybrid collectives (tp column gather +
+    # dp gradient psum); the composed step must still validate
+    from tpu_matmul_bench.parallel.hybrid import hybrid_mode
+    from tpu_matmul_bench.parallel.modes import run_mode_benchmark
+    from tpu_matmul_bench.utils.config import parse_config
+
+    cfg = parse_config(
+        ["--sizes", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "bfloat16", "--comm-quant", "int8", "--validate"],
+        "t")
+    rec = run_mode_benchmark(hybrid_mode(cfg, mesh2x4, 64), cfg)
+    assert rec.extras["validation"] == "ok", rec.extras
+    assert rec.extras["comm_quant"] == "int8"

@@ -28,6 +28,11 @@ from tpu_matmul_bench.parallel.modes import (
     expected_corner,
     make_corner_validate,
 )
+from tpu_matmul_bench.parallel.quantized import (
+    allgather_impl,
+    psum_impl,
+    uses_quantized_comm,
+)
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.metrics import calculate_tflops
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
@@ -45,9 +50,14 @@ def make_hybrid_mesh(devices, dp: int) -> Mesh:
 
 
 def hybrid_programs(mesh: Mesh, impl: str = "xla",
-                    blocks: tuple[int, int, int] | None = None):
-    """(compute, full) shard_map programs for the composed dp×tp step."""
+                    blocks: tuple[int, int, int] | None = None,
+                    comm_quant: str | None = None):
+    """(compute, full) shard_map programs for the composed dp×tp step.
+    `comm_quant="int8"` routes BOTH collectives over the int8 wire (the
+    tp column gather and the dp gradient-sync psum)."""
     mm = matmul_2d(impl, blocks)
+    ag = allgather_impl(comm_quant)
+    psum = psum_impl(comm_quant, varying_out=True)
 
     def compute_body(x, w):  # x: [batch/dp, n, n], w: [n, n/tp]
         return jnp.stack([mm(x[i], w) for i in range(x.shape[0])])
@@ -55,10 +65,12 @@ def hybrid_programs(mesh: Mesh, impl: str = "xla",
     def full_body(x, w):
         y = jax.lax.optimization_barrier(compute_body(x, w))
         # tp leg: assemble full output columns on every tp rank
-        y = jax.lax.all_gather(y, "tp", axis=2, tiled=True)
+        y = ag(y, "tp", axis=2)
         # dp leg: gradient-sync-style reduction of the batch shard sum
-        g = jax.lax.psum(jnp.sum(y, axis=0), "dp")
-        return jax.lax.pcast(g, ("dp", "tp"), to="varying")
+        # (psum_impl's varying_out covers the 'dp' axis; the quantized
+        # ring's output is varying already, exact psum gets a pcast)
+        g = psum(jnp.sum(y, axis=0), "dp")
+        return jax.lax.pcast(g, "tp", to="varying")
 
     compute = smap(compute_body, mesh,
                    in_specs=(P("dp"), P(None, "tp")),
@@ -80,7 +92,8 @@ def hybrid_mode(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
                         P("dp"), count=1)
     w, = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
                         P(None, "tp"), count=1)
-    compute, full = hybrid_programs(mesh, config.matmul_impl, config.blocks)
+    compute, full = hybrid_programs(mesh, config.matmul_impl, config.blocks,
+                                    comm_quant=config.comm_quant)
 
     def build(t_compute: Timing, t_full: Timing | None, comm_s: float) -> BenchmarkRecord:
         total_s = t_full.avg_s if t_full else t_compute.avg_s
@@ -88,6 +101,8 @@ def hybrid_mode(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
         total = calculate_tflops(size, total_s, num_ops=g)
         extras = {"dp": dp, "tp": tp, "global_batch": g,
                   "local_batch": local_batch}
+        if uses_quantized_comm(config):
+            extras["comm_quant"] = config.comm_quant
         if g != batch:
             extras["note"] = f"global batch grown from {batch} to {g} to cover dp={dp}"
         return BenchmarkRecord(
@@ -113,4 +128,7 @@ def hybrid_mode(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
                      validate=make_corner_validate(
                          lambda xx, ww: full(xx, ww)[:size], (x, w),
                          lambda: expected_corner(jnp.sum(x, axis=0), w),
-                         config.dtype))
+                         config.dtype,
+                         quantized_comm=uses_quantized_comm(config),
+                         # dp psum hops + one AG rounding drive the error
+                         world=dp + 1))

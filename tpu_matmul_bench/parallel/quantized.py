@@ -103,6 +103,17 @@ def quantized_all_gather(x: jax.Array, axis_name: str,
     """
     if jnp.issubdtype(x.dtype, jnp.integer):
         return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    if x.ndim > 2:
+        # N-D last-axis gather (e.g. the hybrid step's [batch, n, n/tp]
+        # column gather): flatten the leading dims — per-row scales then
+        # mean per (leading..., row)
+        if axis != x.ndim - 1:
+            raise ValueError(
+                f"unsupported gather axis {axis} for rank {x.ndim}")
+        lead = x.shape[:-1]
+        out = quantized_all_gather(x.reshape(-1, x.shape[-1]), axis_name,
+                                   axis=1)
+        return out.reshape(*lead, -1)
     q, s = _quantize(x)
     q_all = lax.all_gather(q, axis_name, axis=axis, tiled=True)
     s_all = lax.all_gather(s, axis_name, axis=axis, tiled=True)

@@ -52,26 +52,45 @@ RETRY_BACKOFF_S = 120.0   # between retries when the backend errors fast
 POLL_S = 10.0
 
 _best = 0.0  # best TFLOPS seen so far; what every emit reports
+# backend-health state carried into every emit so a 0.0 artifact diagnoses
+# itself without the reader excavating the stderr tail (r3 lesson: the
+# driver's BENCH_r03.json recorded 0.0 with the dead-tunnel traceback
+# buried in `tail`): "pending" = no attempt finished yet, "unavailable" =
+# an attempt exited nonzero, "slow" = an attempt blew its soft deadline,
+# "ok" = a measurement landed
+_health = {"backend": "pending", "attempts": 0, "last_rc": None}
 
 
 def _emit() -> None:
-    line = json.dumps(
-        {
-            "metric": "bf16_matmul_16k_tflops_per_chip",
-            "value": round(_best, 2),
-            "unit": "TFLOPS",
-            "vs_baseline": round(_best / BASELINE_TFLOPS, 4),
-        }
-    ) + "\n"
+    rec = {
+        "metric": "bf16_matmul_16k_tflops_per_chip",
+        "value": round(_best, 2),
+        "unit": "TFLOPS",
+        "vs_baseline": round(_best / BASELINE_TFLOPS, 4),
+        "backend": "ok" if _best > 0.0 else _health["backend"],
+        "attempts": _health["attempts"],
+    }
+    if _best == 0.0 and _health["last_rc"] is not None:
+        rec["last_rc"] = _health["last_rc"]
+    line = json.dumps(rec) + "\n"
     # one os.write of a <PIPE_BUF line is atomic: a SIGTERM-handler emit
     # can never interleave mid-line with a main-thread emit (print() would
     # buffer body and newline separately, risking a garbled last line)
     try:
-        sys.stdout.flush()
+        try:
+            sys.stdout.flush()
+        except RuntimeError:
+            # signal-handler emit re-entered a buffered flush mid-operation
+            # (CPython: 'reentrant call'); os.write below is
+            # async-signal-safe and must still land
+            pass
         os.write(sys.stdout.fileno(), line.encode())
     except (OSError, ValueError, AttributeError):
         # captured pseudo-stdout without a real fd (test harnesses)
-        print(line, end="", flush=True)
+        try:
+            print(line, end="", flush=True)
+        except RuntimeError:
+            pass
 
 
 def _note_results(outputs: list[str]) -> bool:
@@ -123,6 +142,7 @@ def _run_attempts(deadline: float,
     while (time.time() < deadline and i < MAX_SPAWNS
            and (i < len(ATTEMPTS) or not _note_results(outputs))):
         impl = ATTEMPTS[i % len(ATTEMPTS)]
+        _health["attempts"] = i + 1
         out_path = os.path.join(tmpdir, f"attempt_{i}_{impl}.jsonl")
         outputs.append(out_path)
         print(f"[bench] attempt {i}: {impl}", file=sys.stderr, flush=True)
@@ -164,9 +184,21 @@ def _run_attempts(deadline: float,
             # tunnel client mid-RPC strands the relay grant for everyone —
             # see .claude/skills/verify/SKILL.md) and move on; its late
             # records are still collected in the drain window below
+            _health["backend"] = "slow"
+            _emit()  # health change → refresh the parseable last line
             print(f"[bench] attempt {i} ({impl}) slow — continuing "
                   "without killing it", file=sys.stderr, flush=True)
         else:
+            if procs[-1].returncode != 0:
+                _health["backend"] = "unavailable"
+                _health["last_rc"] = procs[-1].returncode
+                _emit()
+            elif not has_result:
+                # clean exit but no parseable record landed (write failed,
+                # schema drift): distinct from "pending"/"unavailable" so
+                # the 0.0 artifact doesn't contradict its attempt count
+                _health["backend"] = "no_result"
+                _emit()
             # back off only in RETRY mode (past the best-of-3 protocol):
             # protocol attempts use distinct impls, so an impl-specific
             # fast failure shouldn't delay the next impl's attempt

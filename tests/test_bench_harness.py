@@ -37,6 +37,7 @@ def test_collect_reads_only_valid_attempts(tmp_path):
 def test_emit_schema(capfd):  # capfd: _emit writes the raw fd atomically
     bench = _load_bench()
     bench._best = 194.41
+    bench._health["attempts"] = 3
     bench._emit()
     line = capfd.readouterr().out.strip()
     rec = json.loads(line)
@@ -45,7 +46,42 @@ def test_emit_schema(capfd):  # capfd: _emit writes the raw fd atomically
         "value": 194.41,
         "unit": "TFLOPS",
         "vs_baseline": round(194.41 / 140.0, 4),
+        "backend": "ok",   # value > 0 ⇒ a measurement landed
+        "attempts": 3,
     }
+
+
+def test_dead_backend_line_self_describes(monkeypatch, capfd):
+    # r3 regression: BENCH_r03.json's 0.0 was indistinguishable from a
+    # genuine zero-perf regression without excavating the stderr tail.
+    # Now the 0.0 line itself carries the backend-health diagnosis.
+    import time
+
+    bench = _load_bench()
+
+    class FailProc:
+        returncode = 1
+
+        def wait(self, timeout=None):
+            return 1
+
+        def poll(self):
+            return 1
+
+    monkeypatch.setattr(bench, "RETRY_BACKOFF_S", 0.0)
+    monkeypatch.setattr(bench.subprocess, "Popen",
+                        lambda args, **kw: FailProc())
+    bench._run_attempts(deadline=time.time() + 30)
+    bench._emit()
+    lines = [json.loads(l) for l in capfd.readouterr().out.splitlines()
+             if l.strip()]
+    rec = lines[-1]
+    assert rec["value"] == 0.0
+    assert rec["backend"] == "unavailable"
+    assert rec["last_rc"] == 1
+    assert rec["attempts"] == bench.MAX_SPAWNS
+    # driver contract unchanged: the four original keys are all present
+    assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys()
 
 
 def test_always_emits_json_last_line():

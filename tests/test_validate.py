@@ -132,6 +132,31 @@ def test_quantized_allgather_semantics(mesh):
     np.testing.assert_array_equal(np.asarray(fni(xi)), np.asarray(xi))
 
 
+def test_quantized_collectives_d1_exact():
+    # ADVICE r3: on a 1-device axis the gather/psum are no-ops, so both
+    # quantized collectives must short-circuit and introduce zero rounding
+    # error (previously quantized_all_gather still round-tripped int8)
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_matmul_bench.parallel.mesh import smap
+    from tpu_matmul_bench.parallel.quantized import (
+        quantized_all_gather,
+        quantized_psum,
+    )
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("x",))
+    x = jnp.linspace(0.1, 1.7, 64, dtype=jnp.float32).reshape(8, 8)
+    ag = smap(lambda v: quantized_all_gather(v, "x", axis=1), mesh1,
+              in_specs=P(None, "x"), out_specs=P(), check_vma=False)
+    np.testing.assert_array_equal(np.asarray(ag(x)), np.asarray(x))
+    ps = smap(lambda v: quantized_psum(v, "x"), mesh1,
+              in_specs=P(), out_specs=P(), check_vma=False)
+    np.testing.assert_array_equal(np.asarray(ps(x)), np.asarray(x))
+
+
 def test_int8_dtype_with_quantized_comm_is_exact(mesh):
     # integer inputs bypass the quantized wire (summed exactly via lax.psum)
     # — and that exact path must still satisfy the sharded out_specs' vma

@@ -24,6 +24,7 @@ from tpu_matmul_bench.utils.config import build_parser, config_from_args
 from tpu_matmul_bench.utils.reporting import (
     BenchmarkRecord,
     JsonWriter,
+    is_reporting_process,
     report,
 )
 
@@ -115,7 +116,10 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
 
     table = render_curve(config.mode, size, rows)
     report("\n" + table)
-    if args.markdown_out:
+    if args.markdown_out and is_reporting_process():
+        # rank-0-gated like the JSONL sink and report(): in a multihost
+        # run every process reaches here, and ungated opens would race on
+        # the same table file
         with open(args.markdown_out, "w") as fh:
             fh.write(table + "\n")
     with JsonWriter(config.json_out) as jw:

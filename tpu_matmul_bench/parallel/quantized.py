@@ -103,6 +103,10 @@ def quantized_all_gather(x: jax.Array, axis_name: str,
     """
     if jnp.issubdtype(x.dtype, jnp.integer):
         return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    if lax.axis_size(axis_name) == 1:
+        # the gather is a no-op; skip the avoidable int8 rounding error
+        # (mirrors quantized_psum's d==1 short-circuit)
+        return x
     if x.ndim > 2:
         # N-D last-axis gather (e.g. the hybrid step's [batch, n, n/tp]
         # column gather): flatten the leading dims — per-row scales then

@@ -38,6 +38,7 @@ from tpu_matmul_bench.ops.pallas_matmul import (
 from tpu_matmul_bench.ops.pallas_ring_hbm import (
     _chunk_pipeline,
     default_hbm_blocks,
+    resolve_wres,
     wres_fits,
     wres_tile_bytes,
 )
@@ -143,13 +144,15 @@ def ring_allgather_matmul_bidir_hbm(
     block_n: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    wres: bool | None = None,
 ):
     """Build the jitted shard_map'd bidirectional HBM ring kernel.
 
     fn(x, w) with x sharded P(axis, None), w P(None, axis) → y P(None, axis).
     Per-device VMEM footprint is the two half-pipelines' tile sets —
     independent of the problem size, so any HBM-sized operands work.
-    Requires ≥ 2 rows per shard (a 1-row chunk cannot split)."""
+    Requires ≥ 2 rows per shard (a 1-row chunk cannot split).
+    `wres`: W-resident mode override (see `resolve_wres`)."""
     d = mesh.shape[axis]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -175,14 +178,15 @@ def ring_allgather_matmul_bidir_hbm(
         # of W serves both half-pipelines for all d steps; the fit and
         # footprint math is the shared wres_fits/wres_tile_bytes
         w_bytes = k * nshard * jnp.dtype(x_local.dtype).itemsize
-        wres = (not interpret and d >= 2
-                and wres_fits(k, nshard, x_local.dtype, blocks_f, out_dtype,
-                              extra_tile_bytes=wres_tile_bytes(
-                                  blocks_b, x_local.dtype, out_dtype)))
+        use_wres = resolve_wres(
+            wres, d,
+            wres_fits(k, nshard, x_local.dtype, blocks_f, out_dtype,
+                      extra_tile_bytes=wres_tile_bytes(
+                          blocks_b, x_local.dtype, out_dtype)))
         tiles_bytes = (
             (wres_tile_bytes(blocks_f, x_local.dtype, out_dtype)
              + wres_tile_bytes(blocks_b, x_local.dtype, out_dtype))
-            if wres else
+            if use_wres else
             (vmem_bytes_estimate(*blocks_f, x_local.dtype, out_dtype,
                                  acc_dtype)
              + vmem_bytes_estimate(*blocks_b, x_local.dtype, out_dtype,
@@ -218,7 +222,7 @@ def ring_allgather_matmul_bidir_hbm(
                 pltpu.VMEM((blocks_f[0], blocks_f[1]), acc_dtype),
                 pltpu.VMEM((blocks_b[0], blocks_b[1]), acc_dtype),
             ] + ([pltpu.VMEM((k, nshard), x_local.dtype),
-                  pltpu.SemaphoreType.DMA(())] if wres else []),
+                  pltpu.SemaphoreType.DMA(())] if use_wres else []),
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
                 collective_id=3,  # distinct from the other rings' barriers
@@ -226,11 +230,11 @@ def ring_allgather_matmul_bidir_hbm(
                 # raised past Mosaic's default budget as in pallas_matmul;
                 # W-resident mode adds the whole W shard on top
                 vmem_limit_bytes=_vmem_limit(
-                    tiles_bytes + (w_bytes if wres else 0)),
+                    tiles_bytes + (w_bytes if use_wres else 0)),
             ),
             cost_estimate=pl.CostEstimate(
                 flops=2 * m * k * nshard,
-                bytes_accessed=(m * k + (1 if wres else d) * k * nshard)
+                bytes_accessed=(m * k + (1 if use_wres else d) * k * nshard)
                 * x_local.dtype.itemsize
                 + m * nshard * jnp.dtype(out_dtype).itemsize,
                 transcendentals=0,

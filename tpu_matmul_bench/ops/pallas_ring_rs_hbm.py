@@ -38,6 +38,7 @@ from tpu_matmul_bench.ops.pallas_matmul import (
 from tpu_matmul_bench.ops.pallas_ring_hbm import (
     _matmul_wres_kernel,
     default_hbm_blocks,
+    resolve_wres,
     wres_fits,
     wres_tile_bytes,
 )
@@ -169,8 +170,11 @@ def _hbm_ring_rs_kernel(d: int, axis: str, use_barrier: bool,
                 pipe_acc(rows, w_hbm, accin, dest, scratches=(acc_ref,))
     else:
         # interpreter path (emit_pipeline needs real TPU device info): the
-        # identical blocked accumulation, addressed directly
+        # identical blocked accumulation, addressed directly; W-resident
+        # mode reads B from the preloaded VMEM copy so the interpreter
+        # executes the same preload + resident-slicing control flow
         acc_dtype = matmul_acc_dtype(o_hbm.dtype)
+        b_src = w_hbm if w_vmem is None else w_vmem
 
         def chunk_matmul(t, rows, accin, dest):
             for i in range(mshard // bm):
@@ -179,7 +183,7 @@ def _hbm_ring_rs_kernel(d: int, axis: str, use_barrier: bool,
                     for kk in range(klocal // bk):
                         acc += jnp.dot(
                             rows[i * bm:(i + 1) * bm, kk * bk:(kk + 1) * bk],
-                            w_hbm[kk * bk:(kk + 1) * bk, j * bn:(j + 1) * bn],
+                            b_src[kk * bk:(kk + 1) * bk, j * bn:(j + 1) * bn],
                             preferred_element_type=acc_dtype,
                         )
                     if t > 0:
@@ -239,6 +243,7 @@ def ring_reduce_scatter_matmul_hbm(
     block_n: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    wres: bool | None = None,
 ):
     """Build the jitted shard_map'd HBM ring reduce-scatter matmul.
 
@@ -246,6 +251,7 @@ def ring_reduce_scatter_matmul_hbm(
     — same contract as `collective_matmul_rs_program`. Per-hop rounding
     matches the lax form: intermediate sums are carried at the matmul
     output dtype (int8 operands carry exact int32 partials).
+    `wres`: W-resident mode override (see `resolve_wres`).
     """
     d = mesh.shape[axis]
     if interpret is None:
@@ -267,12 +273,12 @@ def ring_reduce_scatter_matmul_hbm(
         # extra double-buffered accin tile (the ring pickup)
         accin_bytes = 2 * blocks[0] * blocks[1] * jnp.dtype(out_dtype).itemsize
         w_bytes = klocal * n * jnp.dtype(x_local.dtype).itemsize
-        wres = (not interpret and d >= 2
-                and wres_fits(klocal, n, x_local.dtype, blocks, out_dtype,
-                              extra_tile_bytes=accin_bytes))
+        use_wres = resolve_wres(
+            wres, d, wres_fits(klocal, n, x_local.dtype, blocks, out_dtype,
+                               extra_tile_bytes=accin_bytes))
         tile_bytes = accin_bytes + (
             wres_tile_bytes(blocks, x_local.dtype, out_dtype)
-            if wres else
+            if use_wres else
             vmem_bytes_estimate(*blocks, x_local.dtype, out_dtype,
                                 acc_dtype))
         kernel = functools.partial(_hbm_ring_rs_kernel, d, axis,
@@ -301,7 +307,7 @@ def ring_reduce_scatter_matmul_hbm(
                 pltpu.SemaphoreType.REGULAR((2,)),
                 pltpu.VMEM((blocks[0], blocks[1]), acc_dtype),
             ] + ([pltpu.VMEM((klocal, n), x_local.dtype),
-                  pltpu.SemaphoreType.DMA(())] if wres else []),
+                  pltpu.SemaphoreType.DMA(())] if use_wres else []),
             compiler_params=pltpu.CompilerParams(
                 has_side_effects=True,
                 collective_id=2,  # distinct from the AG rings' barriers
@@ -310,12 +316,12 @@ def ring_reduce_scatter_matmul_hbm(
                 # Mosaic's default budget as in ops/pallas_matmul.py;
                 # W-resident mode adds the whole W shard on top
                 vmem_limit_bytes=_vmem_limit(
-                    tile_bytes + (w_bytes if wres else 0)),
+                    tile_bytes + (w_bytes if use_wres else 0)),
             ),
             cost_estimate=pl.CostEstimate(
                 flops=2 * m * klocal * n,
                 bytes_accessed=(m * klocal
-                                + (1 if wres else d) * klocal * n)
+                                + (1 if use_wres else d) * klocal * n)
                 * x_local.dtype.itemsize
                 + m * n * jnp.dtype(out_dtype).itemsize,
                 transcendentals=0,

@@ -640,6 +640,12 @@ def _explicit_blocks(config: BenchConfig) -> dict:
             if v is not None}
 
 
+def _hbm_ring_kwargs(config: BenchConfig) -> dict:
+    """Kernel kwargs the HBM ring builders share: explicit block overrides
+    + the --wres tri-state."""
+    return {**_explicit_blocks(config), "wres": config.wres_override}
+
+
 def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
                          benchmark: str = "overlap") -> ModeSetup:
     """The HBM-blocked in-kernel ring (`ops/pallas_ring_hbm.py`): same
@@ -650,7 +656,7 @@ def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
     (defaults are the kernel's measured table)."""
     from tpu_matmul_bench.ops.pallas_ring_hbm import ring_allgather_matmul_hbm
 
-    kw = _explicit_blocks(config)
+    kw = _hbm_ring_kwargs(config)
     return _vs_baseline_mode(
         config, mesh, size, "pallas_ring_hbm",
         collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl,
@@ -672,7 +678,7 @@ def pallas_ring_bidir_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
         ring_allgather_matmul_bidir_hbm,
     )
 
-    kw = _explicit_blocks(config)
+    kw = _hbm_ring_kwargs(config)
     return _vs_baseline_mode(
         config, mesh, size, "pallas_ring_bidir_hbm",
         collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl,
@@ -694,7 +700,7 @@ def pallas_ring_rs_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
         ring_reduce_scatter_matmul_hbm,
     )
 
-    kw = _explicit_blocks(config)
+    kw = _hbm_ring_kwargs(config)
     return _vs_baseline_mode(
         config, mesh, size, "pallas_ring_rs_hbm",
         collective_matmul_rs_program(mesh, overlap=False,

@@ -65,6 +65,15 @@ class BenchConfig:
     block_m: int | None = None
     block_n: int | None = None
     block_k: int | None = None
+    # HBM ring kernels' W-resident VMEM mode: auto (engage when the shard
+    # fits), on (error if it cannot), off (always stream W tiles)
+    wres: str = "auto"
+
+    @property
+    def wres_override(self) -> bool | None:
+        """--wres as the ring builders' tri-state kwarg (see
+        ops/pallas_ring_hbm.resolve_wres)."""
+        return {"auto": None, "on": True, "off": False}[self.wres]
 
     @property
     def dtype(self) -> Any:
@@ -168,6 +177,14 @@ def build_parser(
                  "'tune' program.",
         )
     p.add_argument(
+        "--wres", type=str, default="auto", choices=["auto", "on", "off"],
+        help="W-resident VMEM mode for the HBM ring kernels: preload the "
+             "whole W shard into VMEM once per ring instead of streaming "
+             "its tiles every step. auto = engage when it fits the budget; "
+             "on = require it (error if it cannot fit); off = always "
+             "stream (A/B lever).",
+    )
+    p.add_argument(
         "--profile-dir", type=str, default=None,
         help="Write a jax.profiler trace of the benchmark here (view with "
              "TensorBoard / Perfetto). The reference's nearest analogue is "
@@ -197,6 +214,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         block_m=getattr(args, "block_m", None),
         block_n=getattr(args, "block_n", None),
         block_k=getattr(args, "block_k", None),
+        wres=getattr(args, "wres", "auto"),
     )
 
 

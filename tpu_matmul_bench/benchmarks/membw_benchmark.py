@@ -9,7 +9,7 @@ measured-vs-spec ratio in extras. No reference analogue (the reference
 never measures memory bandwidth; its closest is the README's "memory per
 matrix" accounting, `matmul_benchmark.py:99-103`).
 
-Run: python -m tpu_matmul_bench membw [--sizes 8192 16384] [--op triad]
+Run: python -m tpu_matmul_bench membw [--sizes 8192 16384] [--mode triad]
 """
 
 from __future__ import annotations

@@ -1,0 +1,135 @@
+"""Bidirectional HBM ring reduce-scatter matmul
+(`ops/pallas_ring_bidir_rs_hbm.py`): the counter-rotating half-accumulator
+rings exercised in interpreter mode on the 8-device CPU mesh. The
+unidirectional RS kernel's tests cover the shared staging/recv flow
+control; these pin what the bidirectional form adds — the mirrored origin
+walks in BOTH ring directions, the top/bottom output row split (including
+uneven halves from odd-row chunks), and the per-direction staging rings.
+Completes the in-kernel ring matrix: AG×{uni,bidir} + RS×{uni,bidir}."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+from tpu_matmul_bench.ops.pallas_ring_bidir_rs_hbm import (
+    ring_reduce_scatter_matmul_bidir_hbm,
+)
+from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
+from tpu_matmul_bench.parallel.modes import run_mode_benchmark
+from tpu_matmul_bench.parallel.overlap import OVERLAP_MODES
+from tpu_matmul_bench.utils.config import parse_config
+
+
+@pytest.mark.parametrize("m,k,n,blocks", [
+    (64, 32, 64, (4, 8, 8)),        # several blocks per half in every dim
+    (128, 128, 128, (8, 64, 32)),   # uneven blocking, m/d=16 rows per chunk
+])
+def test_matches_dense(mesh, m, k, n, blocks):
+    (x,) = sharded_normal(0, (m, k), jnp.float32, mesh, P(None, "x"), count=1)
+    (w,) = sharded_normal(1, (k, n), jnp.float32, mesh, P("x", None), count=1)
+    bm, bn, bk = blocks
+    fn = ring_reduce_scatter_matmul_bidir_hbm(mesh, block_m=bm, block_n=bn,
+                                              block_k=bk)
+    got = np.asarray(fn(x, w))
+    want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_odd_half_split(mesh):
+    # 72 rows / 8 devices = 9-row output chunks: forward half 4 rows,
+    # backward 5 — the two accumulator streams carry unequal heights
+    m = k = n = 72
+    (x,) = sharded_normal(0, (m, k), jnp.float32, mesh, P(None, "x"), count=1)
+    (w,) = sharded_normal(1, (k, n), jnp.float32, mesh, P("x", None), count=1)
+    fn = ring_reduce_scatter_matmul_bidir_hbm(mesh, block_m=1, block_n=8,
+                                              block_k=8)
+    got = np.asarray(fn(x, w))
+    want = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_every_device_contributes(mesh):
+    # W = identity on every shard makes Y row block r equal the SUM over
+    # devices of X's rows for chunk r — any dropped hop in either
+    # direction loses a device's contribution
+    d, m = 8, 64
+    k = 64 * d  # k/d = 64 per device
+    x = jnp.ones((m, k), jnp.float32)
+    w = jnp.tile(jnp.eye(64, dtype=jnp.float32), (d, 1))  # [k, 64]
+    fn = ring_reduce_scatter_matmul_bidir_hbm(mesh, block_m=4, block_n=32,
+                                              block_k=16)
+    got = np.asarray(fn(x, w))
+    want = np.asarray(x) @ np.asarray(w)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_int8_exact(mesh):
+    size = 64
+    xi = jnp.arange(size * size, dtype=jnp.int32).reshape(size, size) % 13 - 6
+    wi = jnp.arange(size * size, dtype=jnp.int32).reshape(size, size) % 7 - 3
+    xi, wi = xi.astype(jnp.int8), wi.astype(jnp.int8)
+    y = ring_reduce_scatter_matmul_bidir_hbm(mesh, block_m=4, block_n=8,
+                                             block_k=8)(xi, wi)
+    assert y.dtype == jnp.int32
+    np.testing.assert_array_equal(
+        np.asarray(y), np.asarray(xi, np.int32) @ np.asarray(wi, np.int32))
+
+
+@pytest.mark.parametrize("nd", [2, 4])
+def test_small_rings(devices, nd):
+    mesh_n = make_mesh(devices[:nd])
+    m = k = n = 64
+    (x,) = sharded_normal(0, (m, k), jnp.float32, mesh_n, P(None, "x"),
+                          count=1)
+    (w,) = sharded_normal(1, (k, n), jnp.float32, mesh_n, P("x", None),
+                          count=1)
+    fn = ring_reduce_scatter_matmul_bidir_hbm(mesh_n, block_m=8, block_n=16,
+                                              block_k=16)
+    np.testing.assert_allclose(
+        np.asarray(fn(x, w)),
+        np.asarray(x, np.float32) @ np.asarray(w, np.float32),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_single_row_shard_rejected():
+    # a 1-row output chunk cannot split into two accumulator halves
+    import jax
+
+    mesh8 = make_mesh(jax.devices()[:8])
+    (x,) = sharded_normal(0, (8, 64), jnp.float32, mesh8, P(None, "x"),
+                          count=1)
+    (w,) = sharded_normal(1, (64, 64), jnp.float32, mesh8, P("x", None),
+                          count=1)
+    fn = ring_reduce_scatter_matmul_bidir_hbm(mesh8)
+    with pytest.raises(ValueError, match="2 output rows"):
+        fn(x, w)
+
+
+@pytest.mark.parametrize("wres", [True, False])
+def test_wres_matches_dense(mesh, wres):
+    (x,) = sharded_normal(0, (64, 64), jnp.float32, mesh, P(None, "x"),
+                          count=1)
+    (w,) = sharded_normal(1, (64, 64), jnp.float32, mesh, P("x", None),
+                          count=1)
+    fn = ring_reduce_scatter_matmul_bidir_hbm(mesh, block_m=4, block_n=16,
+                                              block_k=8, wres=wres)
+    np.testing.assert_allclose(
+        np.asarray(fn(x, w)),
+        np.asarray(x, np.float32) @ np.asarray(w, np.float32),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_mode_runs_and_reports(mesh):
+    cfg = parse_config(
+        ["--sizes", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32"],
+        "t", modes=list(OVERLAP_MODES))
+    setup = OVERLAP_MODES["pallas_ring_bidir_rs_hbm"](cfg, mesh, 64)
+    rec = run_mode_benchmark(setup, cfg)
+    assert rec.mode == "pallas_ring_bidir_rs_hbm"
+    assert rec.world == 8
+    assert rec.tflops_total > 0
+    assert "overlap_speedup_x" in rec.extras
+    assert rec.extras["kernel"].startswith("pallas bidirectional HBM ring")

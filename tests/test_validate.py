@@ -56,7 +56,8 @@ def test_scaling_distributed_modes_validate(mesh, table, mode):
 
 @pytest.mark.parametrize("mode", ["collective_matmul", "collective_matmul_rs",
                                   "pallas_ring", "pallas_ring_hbm",
-                                  "pallas_ring_rs_hbm"])
+                                  "pallas_ring_rs_hbm",
+                                  "pallas_ring_bidir_rs_hbm"])
 def test_collective_matmul_modes_validate(mesh, mode):
     cfg = _cfg(extra=["--block-m", "16", "--block-n", "16", "--block-k", "16"])
     rec = run_mode_benchmark(OVERLAP_MODES[mode](cfg, mesh, SIZE), cfg)

@@ -140,7 +140,7 @@ ROW_KEYS = frozenset({
     "collective_matmul", "collective_matmul_bidir",
     "collective_matmul_rs", "collective_matmul_bidir_rs",
     "pallas_ring", "pallas_ring_hbm", "pallas_ring_bidir_hbm",
-    "pallas_ring_rs_hbm",
+    "pallas_ring_rs_hbm", "pallas_ring_bidir_rs_hbm",
     "single_float32", "single_float16", "single_bfloat16",
     "single_float32_strict",
 })
@@ -305,7 +305,7 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
 
     # the HBM-blocked in-kernel rings have no VMEM cap — run the full size
     for hbm_mode in ("pallas_ring_hbm", "pallas_ring_bidir_hbm",
-                     "pallas_ring_rs_hbm"):
+                     "pallas_ring_rs_hbm", "pallas_ring_bidir_rs_hbm"):
         if not want(hbm_mode):
             continue
         report(f"\n### overlap: {hbm_mode} " + "#" * 36)

@@ -8,9 +8,10 @@ matmul, the form BASELINE.json's north star names),
 `collective_matmul_bidir` (counter-rotating half-chunks riding both
 directions of each full-duplex ICI link), `collective_matmul_rs`
 (its reduce-scatter dual), `pallas_ring` (in-kernel ring RDMA,
-VMEM-resident), and `pallas_ring_hbm` / `pallas_ring_rs_hbm` (in-kernel
-gather/reduce-scatter rings with HBM operands + a nested VMEM pipeline —
-no size cap) — where ICI transfers hide behind MXU work.
+VMEM-resident), and `pallas_ring_hbm` / `pallas_ring_rs_hbm` and their
+bidirectional forms `pallas_ring_bidir_hbm` / `pallas_ring_bidir_rs_hbm`
+(in-kernel gather/reduce-scatter rings with HBM operands + a nested VMEM
+pipeline — no size cap) — where ICI transfers hide behind MXU work.
 Default mode `overlap` ≙ reference `backup/matmul_overlap_benchmark.py:369-371`.
 
 Run: python -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \

@@ -1,0 +1,273 @@
+"""HBM-blocked Pallas BIDIRECTIONAL ring reduce-scatter matmul.
+
+Completes the in-kernel ring matrix — AG×{uni,bidir} + RS×{uni} existed;
+this is RS×{bidir}: the hand-scheduled analogue of
+`parallel/overlap.py collective_matmul_bidir_rs_program` (as
+`ops/pallas_ring_rs_hbm.py` is to `collective_matmul_rs_program`).
+
+Y = X·W with the contraction dim sharded (X [m, k/D] column-sharded, W
+[k/D, n] row-sharded → Y [m/D, n] row-sharded). Each output chunk's
+accumulator splits into two half-row streams that counter-rotate: the
+TOP h rows' accumulator hops RIGHT through `fwd_buf` (origin walk
+(my−1−t) mod d, as in the unidirectional RS ring), the BOTTOM rows'
+accumulator hops LEFT through `bwd_buf` (mirror walk (my+1+t) mod d) —
+so BOTH directions of every full-duplex ICI link carry half-accumulator
+RDMA concurrently and the per-step, per-direction transfer is half the
+unidirectional RS ring's. Per step the MXU runs two half-chunk nested
+`emit_pipeline` matmuls with the ring pickup fused into the last K step
+(= one chunk of work, same as the unidirectional form). After D−1 hops
+both halves of chunk `my` are home, fully summed, and the final step
+writes them straight into the output rows. The reference's CUDA streams
+overlap a single NCCL direction (`backup/matmul_overlap_benchmark.py:
+93-180`); link-direction scheduling like this has no CUDA-stream
+expression — it is the TPU-native refinement, hand-scheduled.
+
+Per-direction flow control is the unidirectional RS kernel's (2 recv
+slots + 2 staging slots, read-then-ack-your-writer free semaphores,
+send waited two steps later when the staging slot is reused — see
+`_hbm_ring_rs_kernel`'s WAR argument): the forward stream's writer is
+the LEFT neighbor (acks go left), the backward stream's writer is the
+RIGHT neighbor (acks go right).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_matmul_bench.ops.pallas_matmul import (
+    _vmem_limit,
+    effective_blocks,
+    vmem_bytes_estimate,
+)
+from tpu_matmul_bench.ops.pallas_ring_hbm import (
+    default_hbm_blocks,
+    resolve_wres,
+    wres_fits,
+    wres_tile_bytes,
+)
+from tpu_matmul_bench.ops.pallas_ring_rs_hbm import _rs_chunk_pipeline
+from tpu_matmul_bench.parallel.mesh import smap
+from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _bidir_rs_kernel(d: int, axis: str, use_barrier: bool,
+                     h: int, blocks_f: tuple[int, int, int],
+                     blocks_b: tuple[int, int, int],
+                     x_hbm, w_hbm, o_hbm, fwd_buf, bwd_buf,
+                     fsend, frecv, ffree, bsend, brecv, bfree,
+                     acc_f, acc_b, *wres_refs):
+    """One device's program: two counter-rotating half-accumulator RS
+    rings. Buffer slots per direction: [0]/[1] alternate as the recv ring,
+    [2]/[3] as the staging double buffer this device computes into before
+    sending. Forward stream: recv written by the LEFT neighbor, sends go
+    RIGHT (acks left). Backward stream: mirror (recv written by RIGHT,
+    sends go LEFT, acks right). `wres_refs` (optional (w_vmem,
+    w_load_sem)): preload the W shard into VMEM once, shared by both
+    half-pipelines."""
+    m, klocal = x_hbm.shape
+    n = w_hbm.shape[1]
+    mshard = m // d
+    hb = mshard - h  # backward-half rows (≥ h when mshard is odd)
+    my = jax.lax.axis_index(axis)
+    right = jax.lax.rem(my + 1, d)
+    left = jax.lax.rem(my + d - 1, d)
+
+    if use_barrier:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    w_vmem = None
+    if wres_refs:
+        w_vmem, w_load_sem = wres_refs
+        load = pltpu.make_async_copy(w_hbm, w_vmem, w_load_sem)
+        load.start()
+        load.wait()
+
+    run_f = _rs_chunk_pipeline(use_barrier, h, n, klocal, blocks_f, w_hbm,
+                               o_hbm.dtype, acc_f, w_vmem=w_vmem)
+    run_b = _rs_chunk_pipeline(use_barrier, hb, n, klocal, blocks_b, w_hbm,
+                               o_hbm.dtype, acc_b, w_vmem=w_vmem)
+
+    prev_f = prev2_f = prev_b = prev2_b = None
+    for t in range(d):
+        cur, nxt = t % 2, (t + 1) % 2
+        stage = 2 + t % 2
+        # resident top-half accumulator belongs to chunk (my − 1 − t) mod d
+        # (the unidirectional RS origin walk); the bottom half mirrors it
+        cf = jax.lax.rem(my + 2 * d - 1 - t, d)
+        cb = jax.lax.rem(my + 1 + t, d)
+        rows_f = x_hbm.at[pl.ds(cf * mshard, h), :]
+        rows_b = x_hbm.at[pl.ds(cb * mshard + h, hb), :]
+        last = t + 1 == d
+
+        if prev_f is not None:
+            prev_f.wait_recv()   # this step's accins arrived in `cur`
+            prev_b.wait_recv()
+        if prev2_f is not None:
+            prev2_f.wait_send()  # staging slot `stage` drained, reusable
+            prev2_b.wait_send()
+
+        dest_f = o_hbm.at[pl.ds(0, h), :] if last else fwd_buf.at[stage]
+        dest_b = o_hbm.at[pl.ds(h, hb), :] if last else bwd_buf.at[stage]
+        # the pipelines run while the previous step's sends still drain —
+        # the ICI transfers hide under this MXU work
+        run_f(t, rows_f, fwd_buf.at[cur], dest_f)
+        run_b(t, rows_b, bwd_buf.at[cur], dest_b)
+
+        if 1 <= t <= d - 3 and use_barrier:
+            # done reading slot `cur` — each stream's writer may overwrite
+            # it (fwd writer = left neighbor, bwd writer = right neighbor)
+            pltpu.semaphore_signal(ffree.at[cur], inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_signal(bfree.at[cur], inc=1, device_id=right,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        if not last:
+            if t >= 2 and use_barrier:
+                # the neighbor we write read slot `nxt` during step t−1;
+                # wait for its ack before overwriting (WAR hazard — see
+                # _hbm_ring_rs_kernel)
+                pltpu.semaphore_wait(ffree.at[nxt], 1)
+                pltpu.semaphore_wait(bfree.at[nxt], 1)
+            rdma_f = pltpu.make_async_remote_copy(
+                src_ref=fwd_buf.at[stage], dst_ref=fwd_buf.at[nxt],
+                send_sem=fsend.at[cur], recv_sem=frecv.at[nxt],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma_b = pltpu.make_async_remote_copy(
+                src_ref=bwd_buf.at[stage], dst_ref=bwd_buf.at[nxt],
+                send_sem=bsend.at[cur], recv_sem=brecv.at[nxt],
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma_f.start()
+            rdma_b.start()
+            prev2_f, prev_f = prev_f, rdma_f
+            prev2_b, prev_b = prev_b, rdma_b
+        elif prev_f is not None:
+            prev_f.wait_send()  # drain the final outstanding sends
+            prev_b.wait_send()
+
+
+def ring_reduce_scatter_matmul_bidir_hbm(
+    mesh: Mesh, axis: str = "x",
+    block_m: int | None = None,
+    block_n: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+    wres: bool | None = None,
+):
+    """Build the jitted shard_map'd bidirectional HBM ring RS matmul.
+
+    fn(x, w) with x sharded P(None, axis), w P(axis, None) → y
+    P(axis, None) — same contract as `ring_reduce_scatter_matmul_hbm` and
+    `collective_matmul_bidir_rs_program`. Per-hop rounding matches the lax
+    form: intermediate sums are carried at the matmul output dtype (int8
+    operands carry exact int32 partials). Requires ≥ 2 output rows per
+    device (a 1-row accumulator cannot split).
+    `wres`: W-resident mode override (see `resolve_wres`)."""
+    d = mesh.shape[axis]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def per_device(x_local, w_local):
+        m, klocal = x_local.shape
+        n = w_local.shape[1]
+        mshard = m // d
+        if mshard < 2:
+            raise ValueError(
+                f"bidirectional RS ring needs ≥ 2 output rows per device "
+                f"(m/d = {mshard}) — use ring_reduce_scatter_matmul_hbm")
+        h = mshard // 2
+        hb = mshard - h
+        out_dtype = matmul_out_dtype(x_local.dtype)
+        bm, bn, bk = (v if v is not None else dflt for v, dflt in
+                      zip((block_m, block_n, block_k),
+                          default_hbm_blocks(h, n, klocal,
+                                             x_local.dtype, interpret)))
+        blocks_f = effective_blocks(h, n, klocal, bm, bn, bk)
+        blocks_b = effective_blocks(hb, n, klocal, bm, bn, bk)
+        acc_dtype = matmul_acc_dtype(out_dtype)
+        # W-resident fit: one VMEM copy of the [k/d, n] shard serves both
+        # half-pipelines; each streams its own double-buffered accin tile
+        # pair (the ring pickup) on top of its wres tile set
+        accin_bytes = (2 * blocks_f[0] * blocks_f[1]
+                       + 2 * blocks_b[0] * blocks_b[1]) \
+            * jnp.dtype(out_dtype).itemsize
+        w_bytes = klocal * n * jnp.dtype(x_local.dtype).itemsize
+        use_wres = resolve_wres(
+            wres, d,
+            wres_fits(klocal, n, x_local.dtype, blocks_f, out_dtype,
+                      extra_tile_bytes=accin_bytes + wres_tile_bytes(
+                          blocks_b, x_local.dtype, out_dtype)))
+        tiles_bytes = accin_bytes + (
+            (wres_tile_bytes(blocks_f, x_local.dtype, out_dtype)
+             + wres_tile_bytes(blocks_b, x_local.dtype, out_dtype))
+            if use_wres else
+            (vmem_bytes_estimate(*blocks_f, x_local.dtype, out_dtype,
+                                 acc_dtype)
+             + vmem_bytes_estimate(*blocks_b, x_local.dtype, out_dtype,
+                                   acc_dtype)))
+        kernel = functools.partial(_bidir_rs_kernel, d, axis,
+                                   not interpret, h, blocks_f, blocks_b)
+        y, _, _ = pl.pallas_call(
+            kernel,
+            out_shape=[
+                jax.ShapeDtypeStruct((mshard, n), out_dtype),
+                # per-direction recv ring [0]/[1] + staging [2]/[3], in HBM
+                # as discarded outputs (Mosaic forbids HBM scratch); carried
+                # at the matmul OUTPUT dtype — these hold partial sums
+                jax.ShapeDtypeStruct((4, h, n), out_dtype),
+                jax.ShapeDtypeStruct((4, hb, n), out_dtype),
+            ],
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),      # fwd send
+                pltpu.SemaphoreType.DMA((2,)),      # fwd recv
+                pltpu.SemaphoreType.REGULAR((2,)),  # fwd free-acks
+                pltpu.SemaphoreType.DMA((2,)),      # bwd send
+                pltpu.SemaphoreType.DMA((2,)),      # bwd recv
+                pltpu.SemaphoreType.REGULAR((2,)),  # bwd free-acks
+                pltpu.VMEM((blocks_f[0], blocks_f[1]), acc_dtype),
+                pltpu.VMEM((blocks_b[0], blocks_b[1]), acc_dtype),
+            ] + ([pltpu.VMEM((klocal, n), x_local.dtype),
+                  pltpu.SemaphoreType.DMA(())] if use_wres else []),
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                collective_id=4,  # distinct from the other rings' barriers
+                vmem_limit_bytes=_vmem_limit(
+                    tiles_bytes + (w_bytes if use_wres else 0)),
+            ),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * m * klocal * n,
+                bytes_accessed=(m * klocal
+                                + (1 if use_wres else d) * klocal * n)
+                * x_local.dtype.itemsize
+                + m * n * jnp.dtype(out_dtype).itemsize,
+                transcendentals=0,
+            ),
+            interpret=interpret,
+        )(x_local, w_local)
+        return y
+
+    return smap(per_device, mesh, in_specs=(P(None, axis), P(axis, None)),
+                out_specs=P(axis, None), check_vma=False)

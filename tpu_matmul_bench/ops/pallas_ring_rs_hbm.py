@@ -82,6 +82,79 @@ def _rs_acc_wres_kernel(bn, bk, x_ref, accin_ref, o_ref, acc_ref, w_ref):
             .astype(o_ref.dtype)
 
 
+def _rs_chunk_pipeline(use_barrier, nrows, n, klocal, blocks, w_hbm, o_dtype,
+                       acc_ref, w_vmem=None):
+    """One RS ring step's blocked matmul-with-pickup as a callable
+    `run(t, rows, accin, dest)`: rows × W (+ accin when t > 0) → dest.
+    The RS analogue of `pallas_ring_hbm._chunk_pipeline`, shared by the
+    unidirectional RS kernel (whole-chunk rows) and each half of the
+    bidirectional RS kernel. Compiled path = nested `emit_pipeline`
+    (streaming W tiles, or reading a VMEM-resident `w_vmem` via the wres
+    kernels); interpreter path = the identical blocked accumulation
+    addressed directly (emit_pipeline needs real TPU device info)."""
+    bm, bn, bk = blocks
+    grid = (nrows // bm, n // bn, klocal // bk)
+    x_specs = pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk))
+    w_specs = pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j))
+    o_specs = pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))
+    par_sem = (pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)
+
+    if use_barrier and w_vmem is not None:  # compiled, W resident in VMEM
+        pipe_first = pltpu.emit_pipeline(
+            functools.partial(_matmul_wres_kernel, bn, bk), grid=grid,
+            in_specs=[x_specs], out_specs=o_specs,
+            dimension_semantics=par_sem)
+        pipe_acc = pltpu.emit_pipeline(
+            functools.partial(_rs_acc_wres_kernel, bn, bk), grid=grid,
+            in_specs=[x_specs, o_specs], out_specs=o_specs,
+            dimension_semantics=par_sem)
+
+        def run(t, rows, accin, dest):
+            if t == 0:
+                pipe_first(rows, dest, scratches=(acc_ref, w_vmem))
+            else:
+                pipe_acc(rows, accin, dest, scratches=(acc_ref, w_vmem))
+    elif use_barrier:  # compiled TPU: nested VMEM pipelines
+        pipe_first = pltpu.emit_pipeline(  # t=0: no accumulator to pick up
+            _matmul_kernel, grid=grid,
+            in_specs=[x_specs, w_specs], out_specs=o_specs,
+            dimension_semantics=par_sem)
+        pipe_acc = pltpu.emit_pipeline(
+            _rs_acc_kernel, grid=grid,
+            in_specs=[x_specs, w_specs, o_specs], out_specs=o_specs,
+            dimension_semantics=par_sem)
+
+        def run(t, rows, accin, dest):
+            if t == 0:
+                pipe_first(rows, w_hbm, dest, scratches=(acc_ref,))
+            else:
+                pipe_acc(rows, w_hbm, accin, dest, scratches=(acc_ref,))
+    else:
+        # interpreter path: the identical blocked accumulation, addressed
+        # directly; W-resident mode reads B from the preloaded VMEM copy so
+        # the interpreter executes the same preload + resident-slicing
+        # control flow
+        acc_dtype = matmul_acc_dtype(o_dtype)
+        b_src = w_hbm if w_vmem is None else w_vmem
+
+        def run(t, rows, accin, dest):
+            for i in range(nrows // bm):
+                for j in range(n // bn):
+                    acc = jnp.zeros((bm, bn), acc_dtype)
+                    for kk in range(klocal // bk):
+                        acc += jnp.dot(
+                            rows[i * bm:(i + 1) * bm, kk * bk:(kk + 1) * bk],
+                            b_src[kk * bk:(kk + 1) * bk, j * bn:(j + 1) * bn],
+                            preferred_element_type=acc_dtype,
+                        )
+                    if t > 0:
+                        acc += accin[i * bm:(i + 1) * bm,
+                                     j * bn:(j + 1) * bn].astype(acc_dtype)
+                    dest[i * bm:(i + 1) * bm, j * bn:(j + 1) * bn] = \
+                        acc.astype(o_dtype)
+    return run
+
+
 def _hbm_ring_rs_kernel(d: int, axis: str, use_barrier: bool,
                         blocks: tuple[int, int, int],
                         x_hbm, w_hbm, o_hbm, comm_buf,
@@ -132,65 +205,9 @@ def _hbm_ring_rs_kernel(d: int, axis: str, use_barrier: bool,
         load.start()
         load.wait()
 
-    grid = (mshard // bm, n // bn, klocal // bk)
-    x_specs = pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk))
-    w_specs = pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j))
-    o_specs = pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))
-    par_sem = (pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY)
-
-    if use_barrier and w_vmem is not None:  # compiled, W resident in VMEM
-        pipe_first = pltpu.emit_pipeline(
-            functools.partial(_matmul_wres_kernel, bn, bk), grid=grid,
-            in_specs=[x_specs], out_specs=o_specs,
-            dimension_semantics=par_sem)
-        pipe_acc = pltpu.emit_pipeline(
-            functools.partial(_rs_acc_wres_kernel, bn, bk), grid=grid,
-            in_specs=[x_specs, o_specs], out_specs=o_specs,
-            dimension_semantics=par_sem)
-
-        def chunk_matmul(t, rows, accin, dest):
-            if t == 0:
-                pipe_first(rows, dest, scratches=(acc_ref, w_vmem))
-            else:
-                pipe_acc(rows, accin, dest, scratches=(acc_ref, w_vmem))
-    elif use_barrier:  # compiled TPU: nested VMEM pipelines
-        pipe_first = pltpu.emit_pipeline(  # t=0: no accumulator to pick up
-            _matmul_kernel, grid=grid,
-            in_specs=[x_specs, w_specs], out_specs=o_specs,
-            dimension_semantics=par_sem)
-        pipe_acc = pltpu.emit_pipeline(
-            _rs_acc_kernel, grid=grid,
-            in_specs=[x_specs, w_specs, o_specs], out_specs=o_specs,
-            dimension_semantics=par_sem)
-
-        def chunk_matmul(t, rows, accin, dest):
-            if t == 0:
-                pipe_first(rows, w_hbm, dest, scratches=(acc_ref,))
-            else:
-                pipe_acc(rows, w_hbm, accin, dest, scratches=(acc_ref,))
-    else:
-        # interpreter path (emit_pipeline needs real TPU device info): the
-        # identical blocked accumulation, addressed directly; W-resident
-        # mode reads B from the preloaded VMEM copy so the interpreter
-        # executes the same preload + resident-slicing control flow
-        acc_dtype = matmul_acc_dtype(o_hbm.dtype)
-        b_src = w_hbm if w_vmem is None else w_vmem
-
-        def chunk_matmul(t, rows, accin, dest):
-            for i in range(mshard // bm):
-                for j in range(n // bn):
-                    acc = jnp.zeros((bm, bn), acc_dtype)
-                    for kk in range(klocal // bk):
-                        acc += jnp.dot(
-                            rows[i * bm:(i + 1) * bm, kk * bk:(kk + 1) * bk],
-                            b_src[kk * bk:(kk + 1) * bk, j * bn:(j + 1) * bn],
-                            preferred_element_type=acc_dtype,
-                        )
-                    if t > 0:
-                        acc += accin[i * bm:(i + 1) * bm,
-                                     j * bn:(j + 1) * bn].astype(acc_dtype)
-                    dest[i * bm:(i + 1) * bm, j * bn:(j + 1) * bn] = \
-                        acc.astype(o_hbm.dtype)
+    chunk_matmul = _rs_chunk_pipeline(use_barrier, mshard, n, klocal, blocks,
+                                      w_hbm, o_hbm.dtype, acc_ref,
+                                      w_vmem=w_vmem)
 
     rdma_prev = rdma_prev2 = None
     for t in range(d):

@@ -228,10 +228,12 @@ def estimate_memory_gib(
         # gathered X); applies at every d — the d=1 sanity config still
         # allocates the comm buffer
         return gib(4.0 / d, 2)
-    if mode == "pallas_ring_rs_hbm":
+    if mode in ("pallas_ring_rs_hbm", "pallas_ring_bidir_rs_hbm"):
         # sharded operands (2/d) + full partial product and scatter temp
         # (the baseline leg, out dtype) + the 4 comm slots (4/d, out dtype
-        # — 2-slot recv ring + double-buffered staging, all partial sums)
+        # — 2-slot recv ring + double-buffered staging, all partial sums;
+        # the bidir form's two per-direction 4-slot half-buffers total the
+        # same 4/d)
         return gib(2.0 / d, 2 + 4.0 / d)
     if mode in ("matrix_parallel", "model_parallel", "collective_matmul",
                 "collective_matmul_bidir", "collective_matmul_rs",

@@ -45,6 +45,9 @@ collectives + latency-hiding scheduler inside ONE compiled program:
   (`ops/pallas_ring_bidir_hbm.py`) — two counter-rotating half-chunk RDMA
   streams per step, the hand-scheduled analogue of
   ``collective_matmul_bidir``.
+- ``pallas_ring_bidir_rs_hbm``: the RS dual of that
+  (`ops/pallas_ring_bidir_rs_hbm.py`) — counter-rotating half-accumulator
+  streams, completing the in-kernel matrix AG×{uni,bidir} + RS×{uni,bidir}.
 
 Every variant times ONE jitted scan program of `steps_per_call` steps, so the
 host never intervenes mid-pipeline (the scan is the stream). The ring-buffer
@@ -690,6 +693,33 @@ def pallas_ring_bidir_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
     )
 
 
+def pallas_ring_bidir_rs_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
+                                  benchmark: str = "overlap") -> ModeSetup:
+    """The bidirectional in-kernel RS ring
+    (`ops/pallas_ring_bidir_rs_hbm.py`): counter-rotating half-accumulator
+    RDMA streams riding both directions of each full-duplex ICI link, the
+    hand-scheduled analogue of `collective_matmul_bidir_rs` — completes
+    the kernel matrix (AG×{uni,bidir} + RS×{uni,bidir}). Baseline leg =
+    XLA matmul-then-psum_scatter."""
+    from tpu_matmul_bench.ops.pallas_ring_bidir_rs_hbm import (
+        ring_reduce_scatter_matmul_bidir_hbm,
+    )
+
+    kw = _hbm_ring_kwargs(config)
+    return _vs_baseline_mode(
+        config, mesh, size, "pallas_ring_bidir_rs_hbm",
+        collective_matmul_rs_program(mesh, overlap=False,
+                                     impl=config.matmul_impl,
+                                     blocks=config.blocks),
+        ring_reduce_scatter_matmul_bidir_hbm(mesh, **kw),
+        "matmul-then-psum_scatter",
+        {"kernel":
+         "pallas bidirectional HBM ring RDMA reduce-scatter matmul"},
+        benchmark,
+        x_spec=P(None, "x"), w_spec=P("x", None),
+    )
+
+
 def pallas_ring_rs_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
                             benchmark: str = "overlap") -> ModeSetup:
     """The reduce-scatter dual of `pallas_ring_hbm`
@@ -725,4 +755,5 @@ OVERLAP_MODES = {
     "pallas_ring_hbm": pallas_ring_hbm_mode,
     "pallas_ring_bidir_hbm": pallas_ring_bidir_hbm_mode,
     "pallas_ring_rs_hbm": pallas_ring_rs_hbm_mode,
+    "pallas_ring_bidir_rs_hbm": pallas_ring_bidir_rs_hbm_mode,
 }

@@ -1,5 +1,5 @@
 #!/bin/bash
-# Round-3 measurement playbook — run when the tunnel is healthy.
+# Round-4 measurement playbook — run when the tunnel is healthy.
 #
 # One long sequential session (verify-skill gotchas: never kill a TPU
 # client mid-RPC; two processes contend the one chip, so strictly one at
@@ -9,13 +9,13 @@
 # rect candidate wedges the backend again, every higher-value artifact is
 # already on disk.
 #
-# Usage:  nohup bash scripts/measure_r3.sh > /tmp/measure_r3.log 2>&1 &
-# Watch:  tail -f /tmp/measure_r3.log   (and measurements/r3/*.jsonl)
+# Usage:  nohup bash scripts/measure_r4.sh > /tmp/measure_r4.log 2>&1 &
+# Watch:  tail -f /tmp/measure_r4.log   (and measurements/r4/*.jsonl)
 
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p measurements/r3
-R3=measurements/r3
+mkdir -p measurements/r4
+R4=measurements/r4
 ITERS=20
 
 # Persistent compilation cache: compare --isolate spawns a fresh child per
@@ -33,20 +33,20 @@ step() { echo; echo "=== [$(date +%H:%M:%S)] $*"; }
 step "headline: 16k bf16 x50 pallas"
 python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
   --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
-  --num-devices 1 --matmul-impl pallas --json-out $R3/headline_pallas.jsonl
+  --num-devices 1 --matmul-impl pallas --json-out $R4/headline_pallas.jsonl
 step "headline: 16k bf16 x50 xla"
 python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
   --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
-  --num-devices 1 --matmul-impl xla --json-out $R3/headline_xla.jsonl
+  --num-devices 1 --matmul-impl xla --json-out $R4/headline_xla.jsonl
 
 # 2. int8 headline confirm at 16k (both impls, 50 iters).
 step "headline: 16k int8 x50 pallas + xla"
 python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
   --sizes 16384 --dtype int8 --iterations 50 --warmup 10 \
-  --num-devices 1 --matmul-impl pallas --json-out $R3/headline_int8_pallas.jsonl
+  --num-devices 1 --matmul-impl pallas --json-out $R4/headline_int8_pallas.jsonl
 python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
   --sizes 16384 --dtype int8 --iterations 50 --warmup 10 \
-  --num-devices 1 --matmul-impl xla --json-out $R3/headline_int8_xla.jsonl
+  --num-devices 1 --matmul-impl xla --json-out $R4/headline_int8_xla.jsonl
 
 # 3. int8 gap close at 8k/4k (VERDICT #3): wider grid around bn=4096 and
 #    k-major orders. Standard power-of-two tiles only (exotic tile shapes
@@ -54,38 +54,38 @@ python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
 INT8_CAND="2048,4096,512 2048,4096,1024 4096,2048,512 4096,2048,1024 1024,4096,512 4096,4096,512 2048,2048,1024 2048,2048,512 1024,2048,1024 2048,2048,2048 1024,1024,2048"
 step "tune: int8 8k grid"
 python -m tpu_matmul_bench tune --sizes 8192 --dtype int8 \
-  --iterations $ITERS --candidates $INT8_CAND --json-out $R3/tune_int8_8k.jsonl
+  --iterations $ITERS --candidates $INT8_CAND --json-out $R4/tune_int8_8k.jsonl
 step "tune: int8 4k grid"
 python -m tpu_matmul_bench tune --sizes 4096 --dtype int8 \
-  --iterations $ITERS --candidates $INT8_CAND --json-out $R3/tune_int8_4k.jsonl
+  --iterations $ITERS --candidates $INT8_CAND --json-out $R4/tune_int8_4k.jsonl
 step "tune: int8 16k check (current row vs 8k winners)"
 python -m tpu_matmul_bench tune --sizes 16384 --dtype int8 \
   --iterations $ITERS \
   --candidates 2048,2048,1024 2048,4096,512 2048,4096,1024 4096,2048,1024 \
-  --json-out $R3/tune_int8_16k.jsonl
+  --json-out $R4/tune_int8_16k.jsonl
 
 # 4. int8 ring-chunk row (VERDICT #6): the d=8 16k chunk shape.
 step "tune: int8 ring chunk 2048x16384x2048"
 python -m tpu_matmul_bench tune --mkn 2048 16384 2048 --dtype int8 \
   --iterations $ITERS \
   --candidates 2048,2048,1024 1024,2048,512 2048,2048,512 1024,1024,512 2048,1024,1024 \
-  --json-out $R3/tune_int8_chunk.jsonl
+  --json-out $R4/tune_int8_chunk.jsonl
 
 # 5. strict-fp32 rows at 4k/16k (VERDICT #6; 8k was measured in r2).
 step "tune: strict fp32 4k + 16k"
 python -m tpu_matmul_bench tune --sizes 4096 16384 --dtype float32 \
   --precision highest --iterations $ITERS \
   --candidates 1024,1024,512 512,1024,512 1024,2048,512 2048,1024,512 512,512,512 \
-  --json-out $R3/tune_fp32_strict.jsonl
+  --json-out $R4/tune_fp32_strict.jsonl
 
 # 6. Ring kernels at d=1 16k (VERDICT #5): measures the r3
 #    dimension-semantics/cost-estimate changes against the 187.0 r2 mark.
-for mode in pallas_ring_hbm pallas_ring_rs_hbm pallas_ring_bidir_hbm; do
+for mode in pallas_ring_hbm pallas_ring_rs_hbm pallas_ring_bidir_hbm pallas_ring_bidir_rs_hbm; do
   step "ring d=1 16k: $mode"
   python -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \
     --sizes 16384 --dtype bfloat16 --iterations $ITERS --warmup 5 \
     --num-devices 1 --mode $mode --validate \
-    --json-out $R3/ring16k_$mode.jsonl
+    --json-out $R4/ring16k_$mode.jsonl
 done
 
 # 7. pallas_ring (VMEM-resident) at its lifted d=1 cap — validates the
@@ -95,13 +95,13 @@ step "pallas_ring at lifted VMEM cap (d=1)"
 python -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \
   --sizes 2176 --dtype bfloat16 --iterations 200 --warmup 20 \
   --num-devices 1 --mode pallas_ring --validate \
-  --json-out $R3/pallas_ring_cap.jsonl
+  --json-out $R4/pallas_ring_cap.jsonl
 
 # 7b. HBM bandwidth (grounds the roofline denominator with a measured
 #     number; spec v5e ~819 GB/s).
 step "membw: STREAM ops at 8k/16k"
 python -m tpu_matmul_bench membw --sizes 8192 16384 --dtype bfloat16 \
-  --iterations 50 --warmup 5 --json-out $R3/membw.jsonl
+  --iterations 50 --warmup 5 --json-out $R4/membw.jsonl
 
 # 8. Full-mode compare at 16k with --isolate (VERDICT #2) — every row
 #    incl. the bidir forms and single_float32_strict; one wedged row is
@@ -109,13 +109,13 @@ python -m tpu_matmul_bench membw --sizes 8192 16384 --dtype bfloat16 \
 step "compare: 16k full table (isolate)"
 python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
   --size 16384 --iterations $ITERS --warmup 5 --isolate --mode-timeout 900 \
-  --json-out $R3/compare_r3_16k.jsonl --markdown-out $R3/compare_r3_16k.md
+  --json-out $R4/compare_r4_16k.jsonl --markdown-out $R4/compare_r4_16k.md
 
 # 9. 8k refresh with the late-r2 rows included.
 step "compare: 8k refresh (isolate)"
 python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
   --size 8192 --iterations $ITERS --warmup 5 --isolate --mode-timeout 900 \
-  --json-out $R3/compare_r3_8k.jsonl --markdown-out $R3/compare_r3_8k.md
+  --json-out $R4/compare_r4_8k.jsonl --markdown-out $R4/compare_r4_8k.md
 
 # 10. Rectangular sweeps LAST (r2's wedge trigger): the MLP wide-N shape
 #     and its tall-M dual (VERDICT #4).
@@ -123,11 +123,11 @@ step "tune: rect MLP 8192x4096x28672"
 python -m tpu_matmul_bench tune --mkn 8192 4096 28672 --dtype bfloat16 \
   --iterations $ITERS \
   --candidates 4096,2048,512 2048,4096,512 1024,4096,512 2048,2048,512 4096,4096,512 1024,2048,512 \
-  --json-out $R3/tune_rect_mlp.jsonl
+  --json-out $R4/tune_rect_mlp.jsonl
 step "tune: rect tall-M 28672x4096x8192"
 python -m tpu_matmul_bench tune --mkn 28672 4096 8192 --dtype bfloat16 \
   --iterations $ITERS \
   --candidates 4096,2048,512 2048,2048,512 1024,2048,512 2048,4096,512 4096,1024,512 \
-  --json-out $R3/tune_rect_tallm.jsonl
+  --json-out $R4/tune_rect_tallm.jsonl
 
 step "ALL DONE"

@@ -88,6 +88,16 @@ for mode in pallas_ring_hbm pallas_ring_rs_hbm pallas_ring_bidir_hbm pallas_ring
     --json-out $R4/ring16k_$mode.jsonl
 done
 
+# 6b. Ring-kernel block sweep at d=1 16k (new r4 `tune --ring`): the
+#     rings inherit the plain kernel's tuned table but their chunk
+#     problem differs — this sweep attacks the measured d=1 ring deficit
+#     (188 vs 194 TFLOPS, RESULTS_TPU.md).
+step "tune --ring pallas_ring_hbm 16k d=1"
+python -m tpu_matmul_bench tune --ring pallas_ring_hbm --sizes 16384 \
+  --dtype bfloat16 --iterations $ITERS --num-devices 1 --validate \
+  --candidates 4096,2048,512 2048,2048,512 2048,4096,512 2048,2048,1024 1024,2048,512 \
+  --json-out $R4/tune_ring_hbm_16k.jsonl
+
 # 7. pallas_ring (VMEM-resident) at its lifted d=1 cap — validates the
 #    48 MiB residency budget on silicon (VERDICT weak #5; cap bf16 d=1 is
 #    2176 per parallel/overlap.py pallas_ring_max_size).

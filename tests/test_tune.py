@@ -110,3 +110,47 @@ def test_tune_rejects_bad_candidate():
 
     with pytest.raises(SystemExit):
         main(["--candidates", "64,64"])
+
+
+def test_tune_ring_end_to_end(tmp_path, capsys):
+    # --ring sweeps the in-kernel HBM ring matmul over the 8-device mesh
+    # with sharded operands; records carry the ring/wres provenance
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    records = main([
+        "--sizes", "64", "--iterations", "2", "--warmup", "1",
+        "--dtype", "float32", "--ring", "pallas_ring_hbm", "--validate",
+        "--candidates", "8,16,8", "16,16,16",
+        "--json-out", str(tmp_path / "ringtune.jsonl"),
+    ])
+    out = capsys.readouterr().out
+    assert "BEST: --block-m" in out
+    assert len(records) == 2
+    for r in records:
+        assert r.mode == "tune_pallas_ring_hbm"
+        assert r.world == 8
+        assert r.extras["ring"] == "pallas_ring_hbm"
+        assert r.extras["validation"] == "ok"
+    lines = (tmp_path / "ringtune.jsonl").read_text().splitlines()
+    assert len(lines) == 2
+
+
+def test_tune_ring_rejects_mkn():
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    with pytest.raises(SystemExit, match="cannot combine"):
+        main(["--ring", "pallas_ring_hbm", "--mkn", "64", "64", "64"])
+
+
+def test_tune_ring_indivisible_size_skipped(capsys):
+    # a size that does not divide the ring is reported and skipped, not
+    # a crash mid-sweep
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    records = main([
+        "--sizes", "100", "--iterations", "1", "--warmup", "0",
+        "--dtype", "float32", "--ring", "pallas_ring_hbm",
+        "--candidates", "8,8,8",
+    ])
+    assert records == []
+    assert "skip: size must divide" in capsys.readouterr().out

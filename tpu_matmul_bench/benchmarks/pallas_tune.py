@@ -9,6 +9,14 @@ by every benchmark program).
 Run: python -m tpu_matmul_bench tune --sizes 16384 --iterations 10 \
         [--candidates 512,512,512 512,1024,512 ...]
 
+`--ring MODE` sweeps the same grid over one of the in-kernel HBM ring
+matmuls instead of the plain kernel (the rings' nested pipelines inherit
+the plain kernel's tuned table by default, but their per-step chunk
+problem is d× narrower in one dim, so their winners can differ — the
+measured d=1 ring deficit, RESULTS_TPU.md). Operands are sharded per the
+ring's contract over all resolved devices; combine with `--wres on/off`
+to A/B the W-resident mode.
+
 Progress prints *before* each compile so a slow/hung backend is visible
 (each candidate's first call can take minutes on a tunneled TPU).
 """
@@ -80,6 +88,98 @@ def _parse_candidate(text: str) -> tuple[int, int, int]:
     return parts
 
 
+def _ring_builders() -> dict:
+    """--ring vocabulary → (builder, operand-sharding kind). Imported
+    lazily so the plain tune path never loads the ring modules."""
+    from tpu_matmul_bench.ops.pallas_ring_bidir_hbm import (
+        ring_allgather_matmul_bidir_hbm,
+    )
+    from tpu_matmul_bench.ops.pallas_ring_bidir_rs_hbm import (
+        ring_reduce_scatter_matmul_bidir_hbm,
+    )
+    from tpu_matmul_bench.ops.pallas_ring_hbm import ring_allgather_matmul_hbm
+    from tpu_matmul_bench.ops.pallas_ring_rs_hbm import (
+        ring_reduce_scatter_matmul_hbm,
+    )
+
+    return {
+        "pallas_ring_hbm": (ring_allgather_matmul_hbm, "ag"),
+        "pallas_ring_bidir_hbm": (ring_allgather_matmul_bidir_hbm, "ag"),
+        "pallas_ring_rs_hbm": (ring_reduce_scatter_matmul_hbm, "rs"),
+        "pallas_ring_bidir_rs_hbm":
+            (ring_reduce_scatter_matmul_bidir_hbm, "rs"),
+    }
+
+
+def _tune_ring(ring: str, candidates, config, devices, info,
+               jw) -> list[BenchmarkRecord]:
+    """Sweep blockings over one in-kernel HBM ring matmul: operands are
+    sharded per the ring's contract over all resolved devices (d=1 on the
+    single real chip tunes the d=1 ring path directly)."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
+
+    builder, kind = _ring_builders()[ring]
+    mesh = make_mesh(devices)
+    d = mesh.shape["x"]
+    x_spec, w_spec = ((P("x", None), P(None, "x")) if kind == "ag"
+                      else (P(None, "x"), P("x", None)))
+    records: list[BenchmarkRecord] = []
+    for size in config.sizes:
+        if size % d:
+            report(f"\n[{size}] skip: size must divide the {d}-device ring")
+            continue
+        label = f"{ring}:{size}"
+        (a,) = sharded_normal(config.seed, (size, size), config.dtype,
+                              mesh, x_spec, count=1)
+        (b,) = sharded_normal(config.seed + 1, (size, size), config.dtype,
+                              mesh, w_spec, count=1)
+        results: list[tuple[tuple[int, int, int], float]] = []
+        for bm, bn, bk in candidates:
+            report(f"\n[{label}] compiling + timing bm={bm} bn={bn} "
+                   f"bk={bk} ...")
+            try:
+                fn = builder(mesh, block_m=bm, block_n=bn, block_k=bk,
+                             wres=config.wres_override)
+                verdict: dict = {}
+                if config.validate:  # a wrong blocking fails fast
+                    c = min(VALIDATION_CORNER, size)
+                    got = fn(a, b)[:c, :c]
+                    verdict = corner_validation(
+                        got, expected_corner(a, b, corner=c), config.dtype)
+                    if verdict["validation"] != "ok":
+                        report(f"  VALIDATION FAILED: {verdict}")
+                        continue
+                t = time_jitted(fn, (a, b), iterations=config.iterations,
+                                warmup=config.warmup)
+            except Exception as e:  # noqa: BLE001 — a bad blocking skips
+                report(f"  FAILED: {type(e).__name__}: {str(e)[:160]}")
+                continue
+            tflops = calculate_tflops(size, t.avg_s)
+            results.append(((bm, bn, bk), tflops))
+            unit = throughput_unit(config.dtype)
+            report(f"  {tflops:.2f} {unit} total ({t.avg_ms:.3f} ms)")
+            rec = BenchmarkRecord(
+                benchmark="tune", mode=f"tune_{ring}", size=size,
+                dtype=config.dtype_name, world=d,
+                iterations=t.iterations, warmup=config.warmup,
+                avg_time_s=t.avg_s, tflops_per_device=tflops / d,
+                tflops_total=tflops, device_kind=info.device_kind,
+                extras={"block_m": bm, "block_n": bn, "block_k": bk,
+                        "ring": ring, "wres": config.wres, **verdict},
+            ).finalize()
+            records.append(rec)
+            jw.write(rec)
+        if results:
+            results.sort(key=lambda r: -r[1])
+            (bm, bn, bk), best = results[0]
+            report(f"\n[{label}] BEST: --block-m {bm} --block-n {bn} "
+                   f"--block-k {bk}  ({best:.2f} "
+                   f"{throughput_unit(config.dtype)} total)")
+    return records
+
+
 def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     parser = build_parser(__doc__ or "pallas block tuner",
                           extra_dtypes=("int8",))
@@ -94,8 +194,20 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
              "--sizes sweep (rectangulars with extreme aspect ratios want "
              "different tiles than the square-keyed tuned table bakes in)",
     )
+    parser.add_argument(
+        "--ring", type=str, default=None,
+        choices=["pallas_ring_hbm", "pallas_ring_bidir_hbm",
+                 "pallas_ring_rs_hbm", "pallas_ring_bidir_rs_hbm"],
+        help="Sweep the candidates over this in-kernel HBM ring matmul "
+             "instead of the plain kernel (operands sharded over all "
+             "resolved devices; combine with --wres on/off to A/B the "
+             "W-resident mode)",
+    )
     args = parser.parse_args(argv)
     config = config_from_args(args)
+    if args.ring and args.mkn:
+        raise SystemExit("--ring tunes the square --sizes sweep; "
+                         "it cannot combine with --mkn")
 
     # must precede tracing, same as runner.run_sizes: the jit cache keys on
     # the precision config (the tuner has its own loop, so it applies the
@@ -106,7 +218,8 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     info = collect_device_info(devices)
     report(device_banner(info))
     report(header(
-        "Pallas Matmul Block Tuner",
+        "Pallas Matmul Block Tuner"
+        + (f" — ring {args.ring}" if args.ring else ""),
         {
             ("Shape" if args.mkn else "Sizes"):
                 ("x".join(map(str, args.mkn)) if args.mkn
@@ -123,6 +236,11 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     candidates = list(args.candidates)
     if config.blocks is not None:
         candidates.insert(0, config.blocks)
+
+    if args.ring:
+        with JsonWriter(config.json_out) as jw:
+            return _tune_ring(args.ring, candidates, config, devices, info,
+                              jw)
 
     # --mkn tunes one rectangular shape; otherwise the square --sizes sweep
     shapes: list[tuple[int, int, int]] = (

@@ -21,6 +21,7 @@ case "${MULTIHOST_PROGRAM:-scaling}" in
   distributed) DEFAULT_MODE=data_parallel ;;
   overlap) DEFAULT_MODE=overlap ;;
   collectives) DEFAULT_MODE=psum ;;
+  curve) DEFAULT_MODE=independent ;;
   *) DEFAULT_MODE=independent ;;
 esac
 MODE=${2:-$DEFAULT_MODE}
@@ -65,6 +66,7 @@ case "${MULTIHOST_PROGRAM:-scaling}" in
   distributed) MODULE=tpu_matmul_bench.benchmarks.matmul_distributed_benchmark ;;
   overlap) MODULE=tpu_matmul_bench.benchmarks.matmul_overlap_benchmark ;;
   collectives) MODULE=tpu_matmul_bench.benchmarks.collective_benchmark ;;
+  curve) MODULE=tpu_matmul_bench.benchmarks.scaling_curve ;;
   *) echo "ERROR: unknown MULTIHOST_PROGRAM '${MULTIHOST_PROGRAM}'" >&2; exit 2 ;;
 esac
 CMD=(python3 -m "$MODULE"

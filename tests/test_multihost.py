@@ -92,6 +92,34 @@ def test_multihost_launcher_runs_bidir_rs_overlap():
     assert "validation: ok" in out.stdout
 
 
+def test_multihost_curve_balanced_submeshes(tmp_path):
+    """The scaling `curve` over a REAL 2-process cluster (4 global devices).
+    Counts must be swept as multiples of the process count with BALANCED
+    per-process truncation — a submesh excluding one process's devices
+    crashed that worker (r4 fix: resolve_devices balanced mode +
+    idempotent maybe_init_multihost) — and --markdown-out must be written
+    by the reporting process only (r3 advisor fix)."""
+    md = tmp_path / "curve.md"
+    env = scrubbed_env()
+    env["MULTIHOST_PROGRAM"] = "curve"
+    out = _run_launcher(
+        ["./run_multihost_benchmark.sh", "2", "independent", "bfloat16",
+         "--device=cpu", "--sizes", "64", "--iterations", "2",
+         "--warmup", "1", "--markdown-out", str(md)],
+        env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    # the default counts in a 2-process cluster are multiples of 2 only
+    assert "scaling curve: independent at 2 device(s)" in out.stdout
+    assert "scaling curve: independent at 4 device(s)" in out.stdout
+    assert "at 1 device(s)" not in out.stdout
+    # no spurious re-init warnings from the per-count sub-runs
+    assert "multi-host init failed" not in out.stderr, out.stderr[-2000:]
+    table = md.read_text()
+    assert "| 2 |" in table and "| 4 |" in table
+    # rank-0-only: exactly one table in stdout (workers suppressed)
+    assert out.stdout.count("| Devices | Total TFLOPS") == 1
+
+
 def test_two_process_psum():
     coordinator = f"127.0.0.1:{_free_port()}"
     env = scrubbed_env()

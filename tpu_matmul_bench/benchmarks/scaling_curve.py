@@ -98,10 +98,20 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     if args.device_counts is not None:
         counts = args.device_counts
     else:
+        import jax
+
         from tpu_matmul_bench.utils.device import resolve_devices
 
-        counts = default_counts(
-            len(resolve_devices(config.device, config.num_devices)))
+        world = len(resolve_devices(config.device, config.num_devices))
+        nprocs = jax.process_count()
+        if nprocs > 1:
+            # multi-controller cluster: every count must keep all processes
+            # represented (resolve_devices truncates BALANCED and rejects
+            # counts that don't divide the cluster), so sweep multiples of
+            # the process count up to the world
+            counts = [c * nprocs for c in default_counts(world // nprocs)]
+        else:
+            counts = default_counts(world)
 
     rows: list[tuple[int, BenchmarkRecord]] = []
     for n in counts:

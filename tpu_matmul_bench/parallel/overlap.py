@@ -666,7 +666,8 @@ def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
                                   blocks=config.blocks),
         ring_allgather_matmul_hbm(mesh, **kw),
         "all_gather-then-matmul",
-        {"kernel": "pallas HBM ring RDMA all-gather matmul"}, benchmark,
+        {"kernel": "pallas HBM ring RDMA all-gather matmul",
+         "wres": config.wres}, benchmark,
     )
 
 
@@ -688,7 +689,8 @@ def pallas_ring_bidir_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
                                   blocks=config.blocks),
         ring_allgather_matmul_bidir_hbm(mesh, **kw),
         "all_gather-then-matmul",
-        {"kernel": "pallas bidirectional HBM ring RDMA all-gather matmul"},
+        {"kernel": "pallas bidirectional HBM ring RDMA all-gather matmul",
+         "wres": config.wres},
         benchmark,
     )
 
@@ -714,7 +716,8 @@ def pallas_ring_bidir_rs_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
         ring_reduce_scatter_matmul_bidir_hbm(mesh, **kw),
         "matmul-then-psum_scatter",
         {"kernel":
-         "pallas bidirectional HBM ring RDMA reduce-scatter matmul"},
+         "pallas bidirectional HBM ring RDMA reduce-scatter matmul",
+         "wres": config.wres},
         benchmark,
         x_spec=P(None, "x"), w_spec=P("x", None),
     )
@@ -738,7 +741,8 @@ def pallas_ring_rs_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
                                      blocks=config.blocks),
         ring_reduce_scatter_matmul_hbm(mesh, **kw),
         "matmul-then-psum_scatter",
-        {"kernel": "pallas HBM ring RDMA reduce-scatter matmul"}, benchmark,
+        {"kernel": "pallas HBM ring RDMA reduce-scatter matmul",
+         "wres": config.wres}, benchmark,
         x_spec=P(None, "x"), w_spec=P("x", None),
     )
 

@@ -76,7 +76,28 @@ def resolve_devices(
                 f"requested {num_devices} devices but only {len(devices)} "
                 f"{platform_name(devices)} device(s) are available"
             )
-        devices = devices[:num_devices]
+        nprocs = jax.process_count()
+        if nprocs > 1:
+            # multi-controller cluster: every process must keep addressable
+            # devices in the benchmark mesh (a mesh excluding a process's
+            # devices cannot be executed by that process — observed as a
+            # worker crash, not a clean error), so truncate BALANCED: the
+            # first num_devices/nprocs devices of each process
+            if num_devices % nprocs:
+                raise ValueError(
+                    f"--num-devices {num_devices} must be a multiple of the "
+                    f"{nprocs}-process cluster size: every process must "
+                    f"keep an equal share of the mesh")
+            per = num_devices // nprocs
+            kept: dict[int, int] = {}
+            picked = []
+            for d in devices:
+                if kept.get(d.process_index, 0) < per:
+                    picked.append(d)
+                    kept[d.process_index] = kept.get(d.process_index, 0) + 1
+            devices = picked
+        else:
+            devices = devices[:num_devices]
     return list(devices)
 
 
@@ -155,6 +176,13 @@ def maybe_init_multihost() -> None:
     managed = any(v in os.environ for v in
                   ("COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS"))
     if explicit is None and not managed:
+        return
+    if jax.distributed.is_initialized():
+        # idempotent: drivers that re-enter run() per sub-config (the
+        # scaling `curve`) call this once per sub-run; re-initializing an
+        # already-joined cluster raised and printed a spurious warning
+        # (jax's message says "must be called before any JAX calls", which
+        # the benign-catch below doesn't match)
         return
     num_procs = os.environ.get("JAX_NUM_PROCESSES")
     proc_id = os.environ.get("JAX_PROCESS_ID")

@@ -62,3 +62,29 @@ def test_verify_collectives_world4(mesh4):
     from tpu_matmul_bench.parallel.collectives import verify_collectives
 
     assert verify_collectives(mesh4, verbose=False)
+
+
+def test_resolve_devices_balanced_in_multiprocess_cluster(monkeypatch):
+    # r4: in a multi-controller cluster --num-devices must keep every
+    # process represented (balanced truncation); counts that cannot divide
+    # the cluster are rejected with a clear error instead of crashing a
+    # worker whose devices fell outside the mesh
+    from tpu_matmul_bench.utils import device as dev
+
+    class FakeDev:
+        platform = "cpu"
+
+        def __init__(self, pid):
+            self.process_index = pid
+
+    devs = [FakeDev(0), FakeDev(0), FakeDev(1), FakeDev(1)]
+    monkeypatch.setattr(dev.jax, "devices", lambda *a: list(devs))
+    monkeypatch.setattr(dev.jax, "process_count", lambda: 2)
+    got = dev.resolve_devices(None, 2)
+    assert [d.process_index for d in got] == [0, 1]
+    got = dev.resolve_devices(None, 4)
+    assert [d.process_index for d in got] == [0, 0, 1, 1]
+    with pytest.raises(ValueError, match="multiple of"):
+        dev.resolve_devices(None, 3)
+    with pytest.raises(ValueError, match="multiple of"):
+        dev.resolve_devices(None, 1)

@@ -301,3 +301,46 @@ def test_grace_drain_collects_late_result():
     )
     lines = [json.loads(l) for l in out.stdout.splitlines() if l.strip()]
     assert lines[-1]["value"] == 191.5, out.stdout
+
+
+def test_slow_state_does_not_carry_stale_rc(monkeypatch, capfd):
+    # attempt 0 fails rc=1, attempt 1 blows its soft deadline: the 'slow'
+    # line must not carry attempt 0's rc (that attempt has not exited)
+    import time
+
+    bench = _load_bench()
+    calls = []
+
+    class FailProc:
+        returncode = 1
+
+        def wait(self, timeout=None):
+            return 1
+
+        def poll(self):
+            return 1
+
+    class HungProc:
+        returncode = None
+
+        def wait(self, timeout=None):
+            raise bench.subprocess.TimeoutExpired("x", timeout)
+
+        def poll(self):
+            return None
+
+    def popen(args, **kw):
+        calls.append(args)
+        return FailProc() if len(calls) == 1 else HungProc()
+
+    monkeypatch.setattr(bench, "RETRY_BACKOFF_S", 0.0)
+    monkeypatch.setattr(bench, "SOFT_DEADLINE_S", 0.5)
+    monkeypatch.setattr(bench, "STRAGGLER_GRACE_S", 0.0)
+    monkeypatch.setattr(bench.subprocess, "Popen", popen)
+    bench._run_attempts(deadline=time.time() + 6)
+    bench._emit()
+    lines = [json.loads(l) for l in capfd.readouterr().out.splitlines()
+             if l.strip()]
+    rec = lines[-1]
+    assert rec["backend"] == "slow"
+    assert "last_rc" not in rec

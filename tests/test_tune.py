@@ -154,3 +154,39 @@ def test_tune_ring_indivisible_size_skipped(capsys):
     ])
     assert records == []
     assert "skip: size must divide" in capsys.readouterr().out
+
+
+def test_tune_ring_dedupes_clamped_candidates(capsys):
+    # oversized candidates clamp to the per-step chunk problem inside the
+    # builder; the sweep must dedupe on the effective blocks and report
+    # what actually ran, not time the same kernel twice
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    records = main([
+        "--sizes", "64", "--iterations", "1", "--warmup", "0",
+        "--dtype", "float32", "--ring", "pallas_ring_hbm",
+        "--candidates", "512,512,512", "1024,512,512",
+    ])
+    out = capsys.readouterr().out
+    assert len(records) == 1
+    assert "skip (1024, 512, 512)" in out or "skip" in out
+    # the record carries effective (clamped) blocks, not the request
+    assert records[0].extras["block_m"] == 8  # chunk is 64/8 rows
+    # per-candidate A/B provenance: the ACTUAL wres decision, not the flag
+    assert records[0].extras["wres_engaged"] in (True, False)
+
+
+def test_tune_ring_bidir_min_rows_skipped(capsys):
+    # 8/8 devices = 1-row chunks: the bidirectional ring cannot split
+    # them; one clean skip, not one ValueError per candidate
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    records = main([
+        "--sizes", "8", "--iterations", "1", "--warmup", "0",
+        "--dtype", "float32", "--ring", "pallas_ring_bidir_hbm",
+        "--candidates", "8,8,8",
+    ])
+    out = capsys.readouterr().out
+    assert records == []
+    assert "need ≥ 2 rows" in out or "2 rows" in out
+    assert "FAILED" not in out

@@ -111,6 +111,26 @@ def _ring_builders() -> dict:
     }
 
 
+def _ring_effective_blocks(kind: str, bidir: bool, size: int, d: int,
+                           want: tuple[int, int, int]):
+    """The per-step chunk problem a ring candidate actually runs (mirrors
+    each builder's internal effective_blocks call), as a dedupe/report
+    key: AG rings multiply [rows, k]×[k, nshard] chunks, RS rings
+    [rows, klocal]×[klocal, n]; bidirectional forms halve the rows (the
+    odd-row backward half can clamp differently, so its blocks join the
+    key)."""
+    mshard = size // d
+    rows = mshard // 2 if bidir else mshard
+    if kind == "ag":
+        dims = lambda r: (r, size // d, size)  # noqa: E731
+    else:
+        dims = lambda r: (r, size, size // d)  # noqa: E731
+    key = effective_blocks(*dims(rows), *want)
+    if bidir and mshard - rows != rows:
+        key = (key, effective_blocks(*dims(mshard - rows), *want))
+    return key
+
+
 def _tune_ring(ring: str, candidates, config, devices, info,
                jw) -> list[BenchmarkRecord]:
     """Sweep blockings over one in-kernel HBM ring matmul: operands are
@@ -118,9 +138,11 @@ def _tune_ring(ring: str, candidates, config, devices, info,
     single real chip tunes the d=1 ring path directly)."""
     from jax.sharding import PartitionSpec as P
 
+    from tpu_matmul_bench.ops.pallas_ring_hbm import last_wres_engaged
     from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
 
     builder, kind = _ring_builders()[ring]
+    bidir = "bidir" in ring
     mesh = make_mesh(devices)
     d = mesh.shape["x"]
     x_spec, w_spec = ((P("x", None), P(None, "x")) if kind == "ag"
@@ -130,18 +152,35 @@ def _tune_ring(ring: str, candidates, config, devices, info,
         if size % d:
             report(f"\n[{size}] skip: size must divide the {d}-device ring")
             continue
+        if bidir and size // d < 2:
+            report(f"\n[{size}] skip: bidirectional rings need ≥ 2 rows "
+                   f"per {d}-device chunk (have {size // d})")
+            continue
         label = f"{ring}:{size}"
         (a,) = sharded_normal(config.seed, (size, size), config.dtype,
                               mesh, x_spec, count=1)
         (b,) = sharded_normal(config.seed + 1, (size, size), config.dtype,
                               mesh, w_spec, count=1)
         results: list[tuple[tuple[int, int, int], float]] = []
-        for bm, bn, bk in candidates:
+        seen: set = set()
+        for want in candidates:
+            # candidates are clamped to the chunk problem by the builder —
+            # dedupe and report on what actually runs (as the plain sweep
+            # does)
+            eff_key = _ring_effective_blocks(kind, bidir, size, d, want)
+            if eff_key in seen:
+                report(f"\n[{label}] skip {want}: clamps to already-"
+                       f"measured {eff_key}")
+                continue
+            seen.add(eff_key)
+            eff = eff_key[0] if isinstance(eff_key[0], tuple) else eff_key
+            bm, bn, bk = eff
+            note = "" if eff == tuple(want) else f" (requested {want})"
             report(f"\n[{label}] compiling + timing bm={bm} bn={bn} "
-                   f"bk={bk} ...")
+                   f"bk={bk}{note} ...")
             try:
-                fn = builder(mesh, block_m=bm, block_n=bn, block_k=bk,
-                             wres=config.wres_override)
+                fn = builder(mesh, block_m=want[0], block_n=want[1],
+                             block_k=want[2], wres=config.wres_override)
                 verdict: dict = {}
                 if config.validate:  # a wrong blocking fails fast
                     c = min(VALIDATION_CORNER, size)
@@ -157,7 +196,7 @@ def _tune_ring(ring: str, candidates, config, devices, info,
                 report(f"  FAILED: {type(e).__name__}: {str(e)[:160]}")
                 continue
             tflops = calculate_tflops(size, t.avg_s)
-            results.append(((bm, bn, bk), tflops))
+            results.append((eff, tflops))
             unit = throughput_unit(config.dtype)
             report(f"  {tflops:.2f} {unit} total ({t.avg_ms:.3f} ms)")
             rec = BenchmarkRecord(
@@ -167,7 +206,11 @@ def _tune_ring(ring: str, candidates, config, devices, info,
                 avg_time_s=t.avg_s, tflops_per_device=tflops / d,
                 tflops_total=tflops, device_kind=info.device_kind,
                 extras={"block_m": bm, "block_n": bn, "block_k": bk,
-                        "ring": ring, "wres": config.wres, **verdict},
+                        "ring": ring, "wres": config.wres,
+                        # the ACTUAL per-candidate decision (auto depends
+                        # on the candidate's tile set), read from the
+                        # trace — the A/B provenance the record exists for
+                        "wres_engaged": last_wres_engaged(), **verdict},
             ).finalize()
             records.append(rec)
             jw.write(rec)

@@ -253,20 +253,36 @@ def default_hbm_blocks(
     return tuned_blocks(mshard, nshard, k, kind, dtype)
 
 
+# Trace-time record of the most recent wres decision: the selection
+# happens inside per_device during tracing (it depends on the candidate
+# blocks and local shapes), where the caller can't see it — this hook
+# gives records/tuners the ACTUAL engagement instead of the flag string.
+_LAST_WRES: dict = {"engaged": None}
+
+
+def last_wres_engaged() -> bool | None:
+    """Whether the most recently traced ring kernel selected the
+    W-resident mode (None before any ring trace). Tracing is
+    single-threaded; read right after building/eval_shape-ing a kernel."""
+    return _LAST_WRES["engaged"]
+
+
 def resolve_wres(wres: bool | None, d: int, fits: bool) -> bool:
-    """The ONE wres-selection rule the three HBM ring builders share:
+    """The ONE wres-selection rule the four HBM ring builders share:
     None = auto (engage on ≥2-step rings whose layout fits the budget —
     in compiled AND interpret mode, so the CPU-mesh tests execute the same
     control flow the TPU runs); False = force streaming; True = force
     resident (error when the layout cannot fit)."""
     auto = d >= 2 and fits
     if wres is None:
+        _LAST_WRES["engaged"] = auto
         return auto
     if wres and not auto:
         raise ValueError(
             "wres=True but the W-resident layout is unavailable: "
             + ("rings need ≥ 2 devices" if d < 2 else
                f"W shard + tile set exceeds WRES_VMEM_BUDGET ({WRES_VMEM_BUDGET} B)"))
+    _LAST_WRES["engaged"] = wres
     return wres
 
 

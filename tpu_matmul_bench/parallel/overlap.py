@@ -649,6 +649,25 @@ def _hbm_ring_kwargs(config: BenchConfig) -> dict:
     return {**_explicit_blocks(config), "wres": config.wres_override}
 
 
+def _wres_extras(config: BenchConfig, fn, size: int) -> dict:
+    """Record extras for a ring mode's W-resident provenance: the flag AND
+    the actual engagement — under auto the decision depends on the tile
+    set and local shapes, resolved inside per_device during tracing, so
+    trace once via eval_shape (no compile; the jit cache reuses it) and
+    read the hook. None when the trace fails (the real run will surface
+    the same error)."""
+    from tpu_matmul_bench.ops.pallas_ring_hbm import last_wres_engaged
+
+    engaged = None
+    try:
+        s = jax.ShapeDtypeStruct((size, size), config.dtype)
+        jax.eval_shape(fn, s, s)
+        engaged = last_wres_engaged()
+    except Exception:  # noqa: BLE001 — provenance must not mask the run
+        pass
+    return {"wres": config.wres, "wres_engaged": engaged}
+
+
 def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
                          benchmark: str = "overlap") -> ModeSetup:
     """The HBM-blocked in-kernel ring (`ops/pallas_ring_hbm.py`): same
@@ -660,14 +679,15 @@ def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
     from tpu_matmul_bench.ops.pallas_ring_hbm import ring_allgather_matmul_hbm
 
     kw = _hbm_ring_kwargs(config)
+    fn = ring_allgather_matmul_hbm(mesh, **kw)
     return _vs_baseline_mode(
         config, mesh, size, "pallas_ring_hbm",
         collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl,
                                   blocks=config.blocks),
-        ring_allgather_matmul_hbm(mesh, **kw),
+        fn,
         "all_gather-then-matmul",
         {"kernel": "pallas HBM ring RDMA all-gather matmul",
-         "wres": config.wres}, benchmark,
+         **_wres_extras(config, fn, size)}, benchmark,
     )
 
 
@@ -683,14 +703,15 @@ def pallas_ring_bidir_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
     )
 
     kw = _hbm_ring_kwargs(config)
+    fn = ring_allgather_matmul_bidir_hbm(mesh, **kw)
     return _vs_baseline_mode(
         config, mesh, size, "pallas_ring_bidir_hbm",
         collective_matmul_program(mesh, overlap=False, impl=config.matmul_impl,
                                   blocks=config.blocks),
-        ring_allgather_matmul_bidir_hbm(mesh, **kw),
+        fn,
         "all_gather-then-matmul",
         {"kernel": "pallas bidirectional HBM ring RDMA all-gather matmul",
-         "wres": config.wres},
+         **_wres_extras(config, fn, size)},
         benchmark,
     )
 
@@ -708,16 +729,17 @@ def pallas_ring_bidir_rs_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
     )
 
     kw = _hbm_ring_kwargs(config)
+    fn = ring_reduce_scatter_matmul_bidir_hbm(mesh, **kw)
     return _vs_baseline_mode(
         config, mesh, size, "pallas_ring_bidir_rs_hbm",
         collective_matmul_rs_program(mesh, overlap=False,
                                      impl=config.matmul_impl,
                                      blocks=config.blocks),
-        ring_reduce_scatter_matmul_bidir_hbm(mesh, **kw),
+        fn,
         "matmul-then-psum_scatter",
         {"kernel":
          "pallas bidirectional HBM ring RDMA reduce-scatter matmul",
-         "wres": config.wres},
+         **_wres_extras(config, fn, size)},
         benchmark,
         x_spec=P(None, "x"), w_spec=P("x", None),
     )
@@ -734,15 +756,16 @@ def pallas_ring_rs_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
     )
 
     kw = _hbm_ring_kwargs(config)
+    fn = ring_reduce_scatter_matmul_hbm(mesh, **kw)
     return _vs_baseline_mode(
         config, mesh, size, "pallas_ring_rs_hbm",
         collective_matmul_rs_program(mesh, overlap=False,
                                      impl=config.matmul_impl,
                                      blocks=config.blocks),
-        ring_reduce_scatter_matmul_hbm(mesh, **kw),
+        fn,
         "matmul-then-psum_scatter",
         {"kernel": "pallas HBM ring RDMA reduce-scatter matmul",
-         "wres": config.wres}, benchmark,
+         **_wres_extras(config, fn, size)}, benchmark,
         x_spec=P(None, "x"), w_spec=P("x", None),
     )
 

@@ -95,6 +95,15 @@ def resolve_devices(
                 if kept.get(d.process_index, 0) < per:
                     picked.append(d)
                     kept[d.process_index] = kept.get(d.process_index, 0) + 1
+            if len(picked) != num_devices:
+                # a process exposes fewer than its share (degraded host /
+                # filtered backend): returning fewer devices than asked
+                # would silently change what gets measured
+                raise ValueError(
+                    f"requested {num_devices} devices ({per} per process) "
+                    f"but the {nprocs} processes expose only "
+                    f"{ {p: c for p, c in sorted(kept.items())} } — every "
+                    f"process must contribute {per}")
             devices = picked
         else:
             devices = devices[:num_devices]

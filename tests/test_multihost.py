@@ -92,6 +92,39 @@ def test_multihost_launcher_runs_bidir_rs_overlap():
     assert "validation: ok" in out.stdout
 
 
+def test_multihost_launcher_runs_inkernel_ring():
+    """The in-kernel HBM ring (Pallas make_async_remote_copy RDMA,
+    interpret mode on CPU) over a REAL 2-process cluster: the ring's
+    remote copies and flow control must resolve across the process
+    boundary, not just on the single-process virtual mesh."""
+    env = scrubbed_env()
+    env["MULTIHOST_PROGRAM"] = "overlap"
+    out = _run_launcher(
+        ["./run_multihost_benchmark.sh", "2", "pallas_ring_hbm",
+         "bfloat16", "--device=cpu", "--sizes", "64", "--iterations", "2",
+         "--warmup", "1", "--validate"],
+        env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Results for 64x64 [pallas_ring_hbm]" in out.stdout
+    assert "validation: ok" in out.stdout
+
+
+def test_multihost_launcher_runs_inkernel_bidir_rs_ring():
+    """The round-4 bidirectional RS ring over the same real 2-process
+    cluster: per-direction staging RDMA + accumulator pickup across the
+    process boundary."""
+    env = scrubbed_env()
+    env["MULTIHOST_PROGRAM"] = "overlap"
+    out = _run_launcher(
+        ["./run_multihost_benchmark.sh", "2", "pallas_ring_bidir_rs_hbm",
+         "bfloat16", "--device=cpu", "--sizes", "64", "--iterations", "2",
+         "--warmup", "1", "--validate"],
+        env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Results for 64x64 [pallas_ring_bidir_rs_hbm]" in out.stdout
+    assert "validation: ok" in out.stdout
+
+
 def test_multihost_curve_balanced_submeshes(tmp_path):
     """The scaling `curve` over a REAL 2-process cluster (4 global devices).
     Counts must be swept as multiples of the process count with BALANCED

@@ -185,8 +185,9 @@ def _run_attempts(deadline: float,
             # see .claude/skills/verify/SKILL.md) and move on; its late
             # records are still collected in the drain window below
             _health["backend"] = "slow"
-            _health["last_rc"] = None  # this attempt has NOT exited —
-            # carrying an earlier attempt's rc would misattribute it
+            # this attempt has NOT exited — carrying an earlier attempt's
+            # rc would misattribute it
+            _health["last_rc"] = None
             _emit()  # health change → refresh the parseable last line
             print(f"[bench] attempt {i} ({impl}) slow — continuing "
                   "without killing it", file=sys.stderr, flush=True)
@@ -200,8 +201,8 @@ def _run_attempts(deadline: float,
                 # schema drift): distinct from "pending"/"unavailable" so
                 # the 0.0 artifact doesn't contradict its attempt count
                 _health["backend"] = "no_result"
-                _health["last_rc"] = None  # rc was 0; an earlier failed
-                # attempt's rc must not stick to this state
+                # rc was 0; an earlier failed attempt's rc must not stick
+                _health["last_rc"] = None
                 _emit()
             # back off only in RETRY mode (past the best-of-3 protocol):
             # protocol attempts use distinct impls, so an impl-specific

@@ -123,17 +123,12 @@ def test_matmul_wres_kernel_dtypes(dtype, out_dtype):
 # ---------------------------------------------------------------------------
 
 def _ring_builders():
-    from tpu_matmul_bench.ops.pallas_ring_bidir_hbm import (
-        ring_allgather_matmul_bidir_hbm,
-    )
-    from tpu_matmul_bench.ops.pallas_ring_hbm import ring_allgather_matmul_hbm
-    from tpu_matmul_bench.ops.pallas_ring_rs_hbm import (
-        ring_reduce_scatter_matmul_hbm,
-    )
+    from tpu_matmul_bench.ops import ring_matmul_builders
 
-    return {"ag": ring_allgather_matmul_hbm,
-            "bidir": ring_allgather_matmul_bidir_hbm,
-            "rs": ring_reduce_scatter_matmul_hbm}
+    table = ring_matmul_builders()
+    return {"ag": table["pallas_ring_hbm"][0],
+            "bidir": table["pallas_ring_bidir_hbm"][0],
+            "rs": table["pallas_ring_rs_hbm"][0]}
 
 
 @pytest.mark.parametrize("ring", ["ag", "bidir", "rs"])

@@ -88,47 +88,27 @@ def _parse_candidate(text: str) -> tuple[int, int, int]:
     return parts
 
 
-def _ring_builders() -> dict:
-    """--ring vocabulary → (builder, operand-sharding kind). Imported
-    lazily so the plain tune path never loads the ring modules."""
-    from tpu_matmul_bench.ops.pallas_ring_bidir_hbm import (
-        ring_allgather_matmul_bidir_hbm,
-    )
-    from tpu_matmul_bench.ops.pallas_ring_bidir_rs_hbm import (
-        ring_reduce_scatter_matmul_bidir_hbm,
-    )
-    from tpu_matmul_bench.ops.pallas_ring_hbm import ring_allgather_matmul_hbm
-    from tpu_matmul_bench.ops.pallas_ring_rs_hbm import (
-        ring_reduce_scatter_matmul_hbm,
-    )
-
-    return {
-        "pallas_ring_hbm": (ring_allgather_matmul_hbm, "ag"),
-        "pallas_ring_bidir_hbm": (ring_allgather_matmul_bidir_hbm, "ag"),
-        "pallas_ring_rs_hbm": (ring_reduce_scatter_matmul_hbm, "rs"),
-        "pallas_ring_bidir_rs_hbm":
-            (ring_reduce_scatter_matmul_bidir_hbm, "rs"),
-    }
-
-
 def _ring_effective_blocks(kind: str, bidir: bool, size: int, d: int,
                            want: tuple[int, int, int]):
     """The per-step chunk problem a ring candidate actually runs (mirrors
-    each builder's internal effective_blocks call), as a dedupe/report
-    key: AG rings multiply [rows, k]×[k, nshard] chunks, RS rings
-    [rows, klocal]×[klocal, n]; bidirectional forms halve the rows (the
-    odd-row backward half can clamp differently, so its blocks join the
-    key)."""
+    each builder's internal effective_blocks call): AG rings multiply
+    [rows, k]×[k, nshard] chunks, RS rings [rows, klocal]×[klocal, n];
+    bidirectional forms halve the rows. Returns (effective_blocks, key) —
+    the forward half's clamped blocks for reporting, plus a dedupe key
+    that also carries the odd-row backward half's blocks (which can clamp
+    differently)."""
     mshard = size // d
-    rows = mshard // 2 if bidir else mshard
-    if kind == "ag":
-        dims = lambda r: (r, size // d, size)  # noqa: E731
-    else:
-        dims = lambda r: (r, size, size // d)  # noqa: E731
-    key = effective_blocks(*dims(rows), *want)
-    if bidir and mshard - rows != rows:
-        key = (key, effective_blocks(*dims(mshard - rows), *want))
-    return key
+
+    def dims(rows):
+        return ((rows, size // d, size) if kind == "ag"
+                else (rows, size, size // d))
+
+    rows_f = mshard // 2 if bidir else mshard
+    eff = effective_blocks(*dims(rows_f), *want)
+    key = eff
+    if bidir and mshard - rows_f != rows_f:
+        key = (eff, effective_blocks(*dims(mshard - rows_f), *want))
+    return eff, key
 
 
 def _tune_ring(ring: str, candidates, config, devices, info,
@@ -138,10 +118,11 @@ def _tune_ring(ring: str, candidates, config, devices, info,
     single real chip tunes the d=1 ring path directly)."""
     from jax.sharding import PartitionSpec as P
 
+    from tpu_matmul_bench.ops import ring_matmul_builders
     from tpu_matmul_bench.ops.pallas_ring_hbm import last_wres_engaged
     from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
 
-    builder, kind = _ring_builders()[ring]
+    builder, kind = ring_matmul_builders()[ring]
     bidir = "bidir" in ring
     mesh = make_mesh(devices)
     d = mesh.shape["x"]
@@ -167,13 +148,12 @@ def _tune_ring(ring: str, candidates, config, devices, info,
             # candidates are clamped to the chunk problem by the builder —
             # dedupe and report on what actually runs (as the plain sweep
             # does)
-            eff_key = _ring_effective_blocks(kind, bidir, size, d, want)
+            eff, eff_key = _ring_effective_blocks(kind, bidir, size, d, want)
             if eff_key in seen:
                 report(f"\n[{label}] skip {want}: clamps to already-"
                        f"measured {eff_key}")
                 continue
             seen.add(eff_key)
-            eff = eff_key[0] if isinstance(eff_key[0], tuple) else eff_key
             bm, bn, bk = eff
             note = "" if eff == tuple(want) else f" (requested {want})"
             report(f"\n[{label}] compiling + timing bm={bm} bn={bn} "

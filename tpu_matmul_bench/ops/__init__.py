@@ -7,3 +7,28 @@ optional Pallas MXU matmul kernel, and XLA ICI collectives (in
 """
 
 from tpu_matmul_bench.ops.matmul import make_bmm, make_matmul, random_operands  # noqa: F401
+
+
+def ring_matmul_builders() -> dict:
+    """The in-kernel HBM ring matmuls by mode name → (builder,
+    operand-sharding kind): "ag" rings take x P(axis, None) / w
+    P(None, axis); "rs" rings the transposed contract. Imported lazily so
+    loading the package never pulls the Pallas modules."""
+    from tpu_matmul_bench.ops.pallas_ring_bidir_hbm import (
+        ring_allgather_matmul_bidir_hbm,
+    )
+    from tpu_matmul_bench.ops.pallas_ring_bidir_rs_hbm import (
+        ring_reduce_scatter_matmul_bidir_hbm,
+    )
+    from tpu_matmul_bench.ops.pallas_ring_hbm import ring_allgather_matmul_hbm
+    from tpu_matmul_bench.ops.pallas_ring_rs_hbm import (
+        ring_reduce_scatter_matmul_hbm,
+    )
+
+    return {
+        "pallas_ring_hbm": (ring_allgather_matmul_hbm, "ag"),
+        "pallas_ring_bidir_hbm": (ring_allgather_matmul_bidir_hbm, "ag"),
+        "pallas_ring_rs_hbm": (ring_reduce_scatter_matmul_hbm, "rs"),
+        "pallas_ring_bidir_rs_hbm":
+            (ring_reduce_scatter_matmul_bidir_hbm, "rs"),
+    }

@@ -1,0 +1,141 @@
+"""SUMMA 2-D-grid distributed matmul (`parallel/summa.py`): the scanned
+k-panel masked-psum broadcasts on both mesh axes must reproduce the dense
+product on every grid factorization of the 8-device mesh — including the
+non-square grids whose lcm(r, c) panel walk exercises owner indexing in
+both dimensions — plus int8 exactness, quantized-wire broadcasts, the
+mode record, and the CLI."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_matmul_bench.parallel.modes import run_mode_benchmark
+from tpu_matmul_bench.parallel.summa import (
+    make_summa_mesh,
+    summa_grid,
+    summa_mode,
+    summa_programs,
+)
+from tpu_matmul_bench.utils.config import parse_config
+
+SIZE = 64
+
+
+def _cfg(extra=(), dtype="float32"):
+    return parse_config(
+        ["--sizes", str(SIZE), "--iterations", "2", "--warmup", "1",
+         "--dtype", dtype, *extra], "t", extra_dtypes=("int8",))
+
+
+def _operands(dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    a = jnp.asarray(rng.standard_normal((SIZE, SIZE)), dtype)
+    b = jnp.asarray(rng.standard_normal((SIZE, SIZE)), dtype)
+    return a, b
+
+
+def test_grid_factorization():
+    assert summa_grid(8) == (2, 4)
+    assert summa_grid(16) == (4, 4)
+    assert summa_grid(1) == (1, 1)
+    assert summa_grid(8, rows=4) == (4, 2)
+    with pytest.raises(ValueError, match="must divide"):
+        summa_grid(8, rows=3)
+
+
+@pytest.mark.parametrize("rows", [1, 2, 4, 8])
+def test_matches_dense_on_every_grid(rows):
+    # non-square grids (2x4, 4x2, 1x8, 8x1) walk lcm(r, c) panels with
+    # different row/column owner strides — all must reassemble A·B
+    mesh = make_summa_mesh(jax.devices()[:8], rows)
+    a, b = _operands()
+    _, full = summa_programs(mesh)
+    got = np.asarray(full(a, b))
+    want = np.asarray(a) @ np.asarray(b)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_four_device_square_grid():
+    mesh = make_summa_mesh(jax.devices()[:4])  # 2x2
+    a, b = _operands(seed=1)
+    _, full = summa_programs(mesh)
+    np.testing.assert_allclose(np.asarray(full(a, b)),
+                               np.asarray(a) @ np.asarray(b),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int8_exact():
+    mesh = make_summa_mesh(jax.devices()[:8], 2)
+    xi = (jnp.arange(SIZE * SIZE, dtype=jnp.int32)
+          .reshape(SIZE, SIZE) % 13 - 6).astype(jnp.int8)
+    wi = (jnp.arange(SIZE * SIZE, dtype=jnp.int32)
+          .reshape(SIZE, SIZE) % 7 - 3).astype(jnp.int8)
+    _, full = summa_programs(mesh)
+    y = full(xi, wi)
+    assert y.dtype == jnp.int32
+    np.testing.assert_array_equal(
+        np.asarray(y), np.asarray(xi, np.int32) @ np.asarray(wi, np.int32))
+
+
+def test_mode_runs_and_reports(mesh):
+    smesh = make_summa_mesh(list(mesh.devices.flat))
+    cfg = _cfg()
+    rec = run_mode_benchmark(summa_mode(cfg, smesh, SIZE), cfg).finalize()
+    assert rec.mode == "summa"
+    assert rec.world == 8
+    assert rec.tflops_total > 0
+    assert rec.extras["grid"] == "2x4"
+    assert rec.extras["k_panels"] == 4
+    assert rec.comm_time_s is not None
+
+
+def test_mode_validates(mesh):
+    smesh = make_summa_mesh(list(mesh.devices.flat))
+    cfg = _cfg(extra=["--validate"])
+    setup = summa_mode(cfg, smesh, SIZE)
+    res = setup.validate()
+    assert res["validation"] == "ok", res
+
+
+def test_quantized_broadcasts_validate(mesh):
+    smesh = make_summa_mesh(list(mesh.devices.flat))
+    cfg = _cfg(extra=["--validate", "--comm-quant", "int8"])
+    setup = summa_mode(cfg, smesh, SIZE)
+    res = setup.validate()
+    assert res["validation"] == "ok", res
+    rec = run_mode_benchmark(setup, cfg)
+    assert rec.extras["comm_quant"] == "int8"
+
+
+def test_indivisible_size_rejected(mesh):
+    smesh = make_summa_mesh(list(mesh.devices.flat))  # 2x4, lcm 4
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="divisible"):
+        summa_mode(cfg, smesh, 36)  # 36 % (2*4) != 0
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    from tpu_matmul_bench.benchmarks.matmul_summa_benchmark import main
+
+    records = main([
+        "--sizes", str(SIZE), "--iterations", "2", "--warmup", "1",
+        "--dtype", "float32", "--validate",
+        "--json-out", str(tmp_path / "summa.jsonl"),
+    ])
+    out = capsys.readouterr().out
+    assert "SUMMA 2-D Grid Benchmark" in out
+    assert "validation: ok" in out
+    assert len(records) == 1
+    assert records[0].extras["algorithm"].startswith("SUMMA")
+    assert (tmp_path / "summa.jsonl").read_text().count("\n") == 1
+
+
+def test_size_helpers():
+    from tpu_matmul_bench.parallel.summa import summa_min_size, summa_size_ok
+
+    assert summa_size_ok(8, 64)          # 2x4, lcm 4: 64 % 8 and % 16 == 0
+    assert not summa_size_ok(6, 64)      # 2x3, lcm 6: needs % 12 and % 18
+    assert summa_size_ok(6, summa_min_size(6, floor=64))
+    assert summa_min_size(6, floor=64) >= 64
+    assert summa_min_size(8, floor=64) == 64

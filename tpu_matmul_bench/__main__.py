@@ -20,6 +20,7 @@ _PROGRAMS = {
     "curve": "tpu_matmul_bench.benchmarks.scaling_curve",
     "membw": "tpu_matmul_bench.benchmarks.membw_benchmark",
     "hybrid": "tpu_matmul_bench.benchmarks.matmul_hybrid_benchmark",
+    "summa": "tpu_matmul_bench.benchmarks.matmul_summa_benchmark",
     "compare": "tpu_matmul_bench.benchmarks.compare_benchmarks",
 }
 

@@ -135,7 +135,7 @@ def _probe_backend(timeout_s: float) -> tuple[str, int]:
 # every row key compare() can produce — the valid --only vocabulary
 ROW_KEYS = frozenset({
     "single", "independent", "batch_parallel", "matrix_parallel",
-    "data_parallel", "model_parallel", "hybrid",
+    "data_parallel", "model_parallel", "hybrid", "summa",
     "no_overlap", "overlap", "pipeline",
     "collective_matmul", "collective_matmul_bidir",
     "collective_matmul_rs", "collective_matmul_bidir_rs",
@@ -195,16 +195,18 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
         matmul_hybrid_benchmark,
         matmul_overlap_benchmark,
         matmul_scaling_benchmark,
+        matmul_summa_benchmark,
     )
 
     if isolate:
         # the parent must stay backend-free: world/platform come from a
         # probe child (the rank-0 report gate is already forced by the
         # compare() wrapper — the driver is single-controller by
-        # construction). Only the hybrid and pallas_ring gates consume
-        # world/platform — skip the probe (which can stall on a sick
-        # backend) when --only excludes both.
-        needs_probe = only is None or bool(only & {"hybrid", "pallas_ring"})
+        # construction). Only the hybrid, summa, and pallas_ring gates
+        # consume world/platform — skip the probe (which can stall on a
+        # sick backend) when --only excludes them all.
+        needs_probe = (only is None
+                       or bool(only & {"hybrid", "summa", "pallas_ring"}))
         if needs_probe:
             backend, probed_n = _probe_backend(min(120.0, mode_timeout))
         else:
@@ -271,6 +273,24 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
     else:
         report(f"\n### hybrid skipped (needs a device count divisible by "
                f"dp={hybrid_dp} with tp ≥ 2, have {world})")
+
+    # SUMMA 2-D grid (beyond the reference's 1-D splits): meaningful on
+    # ≥ 2 devices (a 1x1 grid is the single row again), and the size must
+    # split into whole blocks/panels on the default grid (mixed-factor
+    # grids like 2x3 reject power-of-two sizes)
+    from tpu_matmul_bench.parallel.summa import summa_size_ok
+
+    if not want("summa"):
+        pass
+    elif world > 1 and summa_size_ok(world, size):
+        report("\n### summa (2-D grid) " + "#" * 40)
+        for rec in run_prog(matmul_summa_benchmark, base):
+            results["summa"] = rec
+    elif world > 1:
+        report(f"\n### summa skipped (size {size} does not split on the "
+               f"{world}-device default grid)")
+    else:
+        report("\n### summa skipped (1 device makes a degenerate 1x1 grid)")
 
     for mode in ("no_overlap", "overlap", "pipeline", "collective_matmul",
                  "collective_matmul_bidir", "collective_matmul_rs",

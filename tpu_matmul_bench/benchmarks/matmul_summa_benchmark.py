@@ -1,0 +1,98 @@
+"""SUMMA 2-D-grid benchmark — the scalable distributed matmul.
+
+The reference's distributed benchmarks split one dimension over one
+process group (`matmul_scaling_benchmark.py:167-238`,
+`backup/matmul_distributed_benchmark.py:112-174`); this program runs the
+classical 2-D processor-grid algorithm (per the TPU linear-algebra paper,
+PAPERS.md arxiv 2112.09017): A, B, C all block-sharded over an (r × c)
+mesh, k walked in lcm(r, c) panels whose owners broadcast along their
+mesh axis while the MXU accumulates — per-device memory O(1/p) in every
+matrix, no output collective. `--rows` picks the grid (default:
+most-square factorization). Compute/comm split timing follows the same
+program-variant methodology as the 1-D modes (DESIGN.md §3).
+
+Run: python -m tpu_matmul_bench summa --rows 2 --num-devices 8 --sizes 4096
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from tpu_matmul_bench.benchmarks.matmul_scaling_benchmark import (
+    cluster_exit_barrier,
+)
+from tpu_matmul_bench.benchmarks.runner import run_sizes
+from tpu_matmul_bench.parallel.collectives import verify_collectives
+from tpu_matmul_bench.parallel.mesh import make_mesh
+from tpu_matmul_bench.parallel.modes import (
+    estimate_memory_gib,
+    run_mode_benchmark,
+)
+from tpu_matmul_bench.parallel.summa import make_summa_mesh, summa_mode
+from tpu_matmul_bench.utils.config import (
+    BenchConfig,
+    build_parser,
+    config_from_args,
+)
+from tpu_matmul_bench.utils.device import (
+    collect_device_info,
+    device_banner,
+    maybe_init_multihost,
+    resolve_devices,
+)
+from tpu_matmul_bench.utils.profiling import maybe_trace
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord, header, report
+
+
+def run(config: BenchConfig, rows: int | None = None) -> list[BenchmarkRecord]:
+    maybe_init_multihost()
+    devices = resolve_devices(config.device, config.num_devices)
+    info = collect_device_info(devices)
+    mesh = make_summa_mesh(devices, rows)
+    r, c = mesh.shape["i"], mesh.shape["j"]
+    report(device_banner(info))
+    report(header(
+        "SUMMA 2-D Grid Benchmark (TPU-native)",
+        {
+            "Grid": f"{r} x {c}",
+            "Data type": config.dtype_name,
+            "Iterations per test": config.iterations,
+            "Warmup iterations": config.warmup,
+        },
+    ))
+
+    if len(devices) > 1:
+        report("\nVerifying collectives:")
+        if not verify_collectives(make_mesh(devices)):
+            report("\nERROR: collective verification failed — aborting")
+            raise SystemExit(1)
+
+    def bench_one(size: int) -> BenchmarkRecord:
+        setup = summa_mode(config, mesh, size)
+        return run_mode_benchmark(setup, config)
+
+    with maybe_trace(config.profile_dir):
+        records = run_sizes(
+            config, bench_one,
+            memory_gib=lambda s: estimate_memory_gib(
+                "summa", config, len(devices), s),
+            memory_limit_gib=info.memory_gib,
+        )
+    cluster_exit_barrier()
+    report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)
+    return records
+
+
+def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
+    parser = build_parser(__doc__ or "SUMMA benchmark",
+                          extra_dtypes=("int8",))
+    parser.add_argument(
+        "--rows", type=int, default=None,
+        help="grid rows r (columns = devices/r; default: most-square "
+             "factorization)")
+    args = parser.parse_args(argv)
+    return run(config_from_args(args), args.rows)
+
+
+if __name__ == "__main__":
+    main()

@@ -235,6 +235,11 @@ def estimate_memory_gib(
         # the bidir form's two per-direction 4-slot half-buffers total the
         # same 4/d)
         return gib(2.0 / d, 2 + 4.0 / d)
+    if mode == "summa":
+        # fully 2-D-sharded A, B, C blocks (3/d) + the scanned k-panel
+        # pair and acc (each ≤ 1/d at the grid shapes we build) — SUMMA's
+        # O(1/p) memory is the point; keep a conservative 2× on the panels
+        return gib(4.0 / d, 2.0 / d)
     if mode in ("matrix_parallel", "model_parallel", "collective_matmul",
                 "collective_matmul_bidir", "collective_matmul_rs",
                 "collective_matmul_bidir_rs", "pallas_ring") and d > 1:

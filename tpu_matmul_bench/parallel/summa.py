@@ -1,0 +1,196 @@
+"""SUMMA — scalable 2-D-grid distributed matmul.
+
+The reference's distributed matmuls are all 1-D splits over one process
+group (column-split `matrix_parallel`, `matmul_scaling_benchmark.py:
+167-238`; k-split `model_parallel`, `backup/matmul_distributed_benchmark.py:
+112-174` — SURVEY P4/P6); the classical scalable form is the 2-D
+processor grid of the SUMMA family, which "Large Scale Distributed Linear
+Algebra With Tensor Processing Units" (PAPERS.md, arxiv 2112.09017)
+demonstrates is the right shape for TPU pods: per-device memory is
+O((mk + kn + mn)/p) — every 1-D split keeps at least one full-size
+matrix per device — and the per-step working set is one k-panel.
+
+Layout: mesh (r, c) with axes ("i", "j"); A [m, k], B [k, n], and
+C [m, n] all block-sharded P("i", "j"). The k dimension is walked in
+s = lcm(r, c) panels so each panel's A columns live in exactly one grid
+column (t // (s/c)) and its B rows in exactly one grid row (t // (s/r)).
+Per step, carried through `lax.scan`:
+
+1. the owning column broadcasts its A panel [m/r, k/s] along "j", and
+   the owning row its B panel [k/s, n/c] along "i" — expressed as a
+   masked `psum` (non-owners contribute zeros), the mesh-axis broadcast
+   idiom (a one-hot all-reduce costs ~2× a tree broadcast's bytes on a
+   ring; the two broadcasts ride DISJOINT mesh axes, so on hardware they
+   use disjoint ICI rings concurrently);
+2. acc += a_panel · b_panel on the MXU.
+
+After s steps acc IS this device's C block — no output collective at
+all, which is SUMMA's point: communication scales with the perimeter of
+the grid, not the world size. The compute leg (comm-split timing,
+DESIGN.md §3) runs the same scan with the broadcasts removed (each
+device multiplies its resident slices — FLOP-identical structure).
+`--comm-quant int8` routes both broadcast psums over the int8 wire.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_matmul_bench.ops.matmul import matmul_2d
+from tpu_matmul_bench.parallel.mesh import sharded_normal, smap
+from tpu_matmul_bench.parallel.modes import (
+    ModeSetup,
+    estimate_memory_gib,
+    expected_corner,
+    make_corner_validate,
+)
+from tpu_matmul_bench.parallel.quantized import psum_impl, uses_quantized_comm
+from tpu_matmul_bench.utils.config import BenchConfig
+from tpu_matmul_bench.utils.metrics import (
+    calculate_tflops,
+    matmul_out_dtype,
+)
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+from tpu_matmul_bench.utils.timing import Timing
+
+
+def summa_grid(n_devices: int, rows: int | None = None) -> tuple[int, int]:
+    """(r, c) grid: `rows` when given, else the most-square factorization
+    (largest divisor ≤ √n as rows — e.g. 8 → 2×4, 16 → 4×4, 1 → 1×1)."""
+    if rows is not None:
+        if rows <= 0 or n_devices % rows:
+            raise ValueError(
+                f"--rows {rows} must divide the {n_devices}-device world")
+        return rows, n_devices // rows
+    r = max(d for d in range(1, int(math.isqrt(n_devices)) + 1)
+            if n_devices % d == 0)
+    return r, n_devices // r
+
+
+def make_summa_mesh(devices, rows: int | None = None) -> Mesh:
+    import numpy as np
+
+    r, c = summa_grid(len(devices), rows)
+    return Mesh(np.asarray(devices).reshape(r, c), ("i", "j"))
+
+
+def summa_size_ok(n_devices: int, size: int,
+                  rows: int | None = None) -> bool:
+    """Whether `size` splits into whole blocks and whole k-panels on the
+    grid `summa_grid(n_devices, rows)` — the gate drivers use to skip
+    incompatible sizes cleanly (mixed-factor grids like 2×3 need sizes
+    divisible by r·lcm(r,c) and c·lcm(r,c))."""
+    r, c = summa_grid(n_devices, rows)
+    s = math.lcm(r, c)
+    return size % (r * s) == 0 and size % (c * s) == 0
+
+
+def summa_min_size(n_devices: int, floor: int = 1,
+                   rows: int | None = None) -> int:
+    """The smallest compatible size ≥ `floor` for the default grid (the
+    dryrun uses this so every device count keeps a runnable SUMMA leg)."""
+    r, c = summa_grid(n_devices, rows)
+    s = math.lcm(r, c)
+    base = math.lcm(r * s, c * s)
+    return base * -(-floor // base)  # ceil(floor / base) · base
+
+
+def summa_programs(mesh: Mesh, impl: str = "xla",
+                   blocks: tuple[int, int, int] | None = None,
+                   comm_quant: str | None = None):
+    """(compute, full) shard_map programs for the SUMMA step on `mesh`."""
+    r, c = mesh.shape["i"], mesh.shape["j"]
+    s = math.lcm(r, c)
+    mm = matmul_2d(impl, blocks)
+    psum = psum_impl(comm_quant)
+
+    def body(a_local, b_local, with_comm: bool):
+        # a_local [m/r, k/c], b_local [k/r, n/c]; k panels of width k/s
+        kb_a = a_local.shape[1] // (s // c)   # panel width inside A block
+        kb_b = b_local.shape[0] // (s // r)   # panel height inside B block
+        my_j = lax.axis_index("j")
+        my_i = lax.axis_index("i")
+        out_dtype = matmul_out_dtype(a_local.dtype)
+        acc0 = jnp.zeros((a_local.shape[0], b_local.shape[1]), out_dtype)
+
+        def step(acc, t):
+            col_owner = t // (s // c)          # grid column holding panel t
+            row_owner = t // (s // r)          # grid row holding panel t
+            a_pan = lax.dynamic_slice_in_dim(
+                a_local, (t % (s // c)) * kb_a, kb_a, axis=1)
+            b_pan = lax.dynamic_slice_in_dim(
+                b_local, (t % (s // r)) * kb_b, kb_b, axis=0)
+            if with_comm:
+                # mesh-axis broadcast: the owner contributes, others zeros
+                a_pan = psum(jnp.where(my_j == col_owner, a_pan, 0), "j")
+                b_pan = psum(jnp.where(my_i == row_owner, b_pan, 0), "i")
+            return acc + mm(a_pan, b_pan).astype(out_dtype), None
+
+        acc, _ = lax.scan(step, acc0, jnp.arange(s))
+        return acc
+
+    compute = smap(lambda a, b: body(a, b, False), mesh,
+                   in_specs=(P("i", "j"), P("i", "j")),
+                   out_specs=P("i", "j"), check_vma=False)
+    full = smap(lambda a, b: body(a, b, True), mesh,
+                in_specs=(P("i", "j"), P("i", "j")),
+                out_specs=P("i", "j"), check_vma=False)
+    return compute, full
+
+
+def summa_mode(config: BenchConfig, mesh: Mesh, size: int,
+               benchmark: str = "summa") -> ModeSetup:
+    r, c = mesh.shape["i"], mesh.shape["j"]
+    world = r * c
+    s = math.lcm(r, c)
+    if size % (r * s) or size % (c * s):
+        # every block must split into whole panels (k/s) and whole block
+        # rows/cols; benchmark sizes are powers of two, grids are small
+        raise ValueError(
+            f"size {size} must be divisible by r·lcm(r,c)={r * s} and "
+            f"c·lcm(r,c)={c * s} for the ({r}x{c}) SUMMA grid")
+
+    (a,) = sharded_normal(config.seed, (size, size), config.dtype, mesh,
+                          P("i", "j"), count=1)
+    (b,) = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
+                          P("i", "j"), count=1)
+    compute, full = summa_programs(mesh, config.matmul_impl, config.blocks,
+                                   comm_quant=config.comm_quant)
+
+    def build(t_compute: Timing, t_full: Timing | None,
+              comm_s: float) -> BenchmarkRecord:
+        total_s = t_full.avg_s if t_full else t_compute.avg_s
+        total = calculate_tflops(size, total_s)
+        extras = {"grid": f"{r}x{c}", "k_panels": s,
+                  "algorithm": "SUMMA (2-D grid, masked-psum broadcasts)"}
+        if uses_quantized_comm(config):
+            extras["comm_quant"] = config.comm_quant
+        return BenchmarkRecord(
+            benchmark=benchmark, mode="summa", size=size,
+            dtype=config.dtype_name, world=world,
+            iterations=(t_full or t_compute).iterations,
+            warmup=config.warmup,
+            avg_time_s=total_s,
+            tflops_per_device=total / world,
+            tflops_total=total,
+            compute_time_s=t_compute.avg_s,
+            comm_time_s=comm_s,
+            extras=extras,
+        )
+
+    return ModeSetup(
+        "summa", (a, b), compute, full, build,
+        memory_gib_per_device=estimate_memory_gib(
+            "summa", config, world, size),
+        validate=make_corner_validate(
+            full, (a, b), lambda: expected_corner(a, b), config.dtype,
+            quantized_comm=uses_quantized_comm(config),
+            # each C element crosses two quantized broadcasts per panel;
+            # scale the tolerance by the broader of the two axes
+            world=max(r, c) + 1),
+    )

@@ -22,6 +22,7 @@ case "${MULTIHOST_PROGRAM:-scaling}" in
   overlap) DEFAULT_MODE=overlap ;;
   collectives) DEFAULT_MODE=psum ;;
   curve) DEFAULT_MODE=independent ;;
+  summa) DEFAULT_MODE=summa ;;
   *) DEFAULT_MODE=independent ;;
 esac
 MODE=${2:-$DEFAULT_MODE}
@@ -67,10 +68,16 @@ case "${MULTIHOST_PROGRAM:-scaling}" in
   overlap) MODULE=tpu_matmul_bench.benchmarks.matmul_overlap_benchmark ;;
   collectives) MODULE=tpu_matmul_bench.benchmarks.collective_benchmark ;;
   curve) MODULE=tpu_matmul_bench.benchmarks.scaling_curve ;;
+  summa) MODULE=tpu_matmul_bench.benchmarks.matmul_summa_benchmark ;;
   *) echo "ERROR: unknown MULTIHOST_PROGRAM '${MULTIHOST_PROGRAM}'" >&2; exit 2 ;;
 esac
-CMD=(python3 -m "$MODULE"
-     --mode "${MODE}" --dtype "${DTYPE}" ${EXTRA[@]+"${EXTRA[@]}"})
+if [[ "${MULTIHOST_PROGRAM:-scaling}" == "summa" ]]; then
+  # summa has no --mode (the program IS the mode; grid via --rows)
+  CMD=(python3 -m "$MODULE" --dtype "${DTYPE}" ${EXTRA[@]+"${EXTRA[@]}"})
+else
+  CMD=(python3 -m "$MODULE"
+       --mode "${MODE}" --dtype "${DTYPE}" ${EXTRA[@]+"${EXTRA[@]}"})
+fi
 
 if [[ -n "${MULTIHOST_PROC_ID:-}" ]]; then
   export JAX_PROCESS_ID="$MULTIHOST_PROC_ID"

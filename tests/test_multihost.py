@@ -125,6 +125,23 @@ def test_multihost_launcher_runs_inkernel_bidir_rs_ring():
     assert "validation: ok" in out.stdout
 
 
+def test_multihost_launcher_runs_summa():
+    """SUMMA's 2-D grid over a REAL 2-process cluster: the (2x2) mesh
+    spans the process boundary, so each k-panel's masked-psum broadcasts
+    cross hosts on one of their two axes."""
+    env = scrubbed_env()
+    env["MULTIHOST_PROGRAM"] = "summa"
+    out = _run_launcher(
+        ["./run_multihost_benchmark.sh", "2", "summa", "bfloat16",
+         "--device=cpu", "--sizes", "64", "--iterations", "2",
+         "--warmup", "1", "--validate"],
+        env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Grid: 2 x 2" in out.stdout
+    assert "Results for 64x64 [summa]" in out.stdout
+    assert "validation: ok" in out.stdout
+
+
 def test_multihost_curve_balanced_submeshes(tmp_path):
     """The scaling `curve` over a REAL 2-process cluster (4 global devices).
     Counts must be swept as multiples of the process count with BALANCED

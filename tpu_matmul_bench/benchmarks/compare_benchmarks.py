@@ -441,6 +441,19 @@ def summarize(results: dict[str, BenchmarkRecord]) -> str:
                 f"Bidirectional ring vs unidirectional: {gain:+.1f}% step "
                 "time (expect a win only when the ring is comm-bound — "
                 "both ICI directions carry half-chunks)")
+    if ("pallas_ring_bidir_rs_hbm" in results
+            and "pallas_ring_rs_hbm" in results):
+        uni, bi = t("pallas_ring_rs_hbm"), t("pallas_ring_bidir_rs_hbm")
+        if uni and bi:
+            gain = (uni - bi) / uni * 100
+            lines.append(
+                f"In-kernel bidirectional RS ring vs unidirectional: "
+                f"{gain:+.1f}% step time (same comm-bound caveat)")
+    if "summa" in results:
+        lines.append(
+            f"SUMMA 2-D grid ({results['summa'].extras.get('grid', '?')}): "
+            f"{results['summa'].tflops_total:.1f} total TFLOPS with O(1/p) "
+            "per-device memory (no full-size matrix anywhere)")
     dtype_line = bf16_vs_fp32_line(results)
     if dtype_line:
         lines.append(dtype_line)

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Turn measured tune JSONLs into ready-to-bake tuned-table rows.
+
+Reads `tune` records (`--json-out` of `python -m tpu_matmul_bench tune`,
+plain / `--mkn` / `--ring` sweeps), groups them by (dtype, precision,
+shape), ranks candidates, and prints:
+
+  - the winner per group with its margin over the runner-up and over any
+    already-baked row measured in the same sweep (so a "keep the current
+    row" verdict is visible), and
+  - the exact `_V5E_ROWS` / `_RECT_V5E_ROWS` row literals to paste into
+    `ops/pallas_matmul.py`, with the source file as provenance.
+
+Analysis only — nothing is written; baking stays a reviewed edit (the
+artifact-hygiene bar: every baked row cites its measurements/ JSONL).
+
+Usage: python scripts/bake_rows.py measurements/r4/tune_*.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+
+
+def load(paths):
+    groups = defaultdict(list)  # (dtype, precision, shape_label) -> recs
+    for path in paths:
+        try:
+            with open(path) as fh:
+                lines = fh.read().splitlines()
+        except OSError as e:
+            print(f"skip {path}: {e}", file=sys.stderr)
+            continue
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("benchmark") != "tune":
+                continue
+            ex = rec.get("extras", {})
+            if not {"block_m", "block_n", "block_k"} <= ex.keys():
+                continue
+            shape = ex.get("shape") or f"{rec['size']}^2"
+            if str(rec.get("mode", "")).startswith("tune_pallas_ring"):
+                shape = f"{rec['mode'][5:]}:{shape}"
+            key = (rec["dtype"], ex.get("precision", "default"), shape)
+            groups[key].append((rec, path))
+    return groups
+
+
+def main(paths):
+    groups = load(paths)
+    if not groups:
+        print("no tune records found", file=sys.stderr)
+        return 1
+    for (dtype, precision, shape), entries in sorted(groups.items()):
+        ranked = sorted(entries,
+                        key=lambda e: -e[0]["tflops_total"])
+        (best, src) = ranked[0]
+        ex = best["extras"]
+        blocks = (ex["block_m"], ex["block_n"], ex["block_k"])
+        unit = "TOPS" if dtype == "int8" else "TFLOPS"
+        prec = "" if precision == "default" else f" precision={precision}"
+        print(f"\n## {dtype} {shape}{prec} — {len(ranked)} candidates")
+        for (rec, p), tag in zip(ranked[:3], ("WINNER", "2nd", "3rd")):
+            e = rec["extras"]
+            margin = ("" if rec is best else
+                      f"  (-{(best['tflops_total'] - rec['tflops_total']) / best['tflops_total'] * 100:.1f}%)")
+            print(f"  {tag:>6}: ({e['block_m']}, {e['block_n']}, "
+                  f"{e['block_k']})  {rec['tflops_total']:.2f} {unit}"
+                  f"{margin}")
+        if "^2" in shape and ":" not in shape:
+            size = best["size"]
+            print(f"  bake → _V5E_ROWS[{dtype!r}]: ({size}, {blocks!r})"
+                  f"   # {best['tflops_total']:.2f} {unit}, {src}")
+        elif ":" not in shape:
+            m, k, n = (int(v) for v in shape.split("x"))
+            axis = "m" if m >= n else "n"
+            long_dim, other = (m, min(n, k)) if axis == "m" else (n, min(m, k))
+            ratio = max(1, long_dim // other)
+            print(f"  bake → _RECT_V5E_ROWS[{dtype!r}]: "
+                  f"({axis!r}, {ratio}, {other}, {blocks!r})"
+                  f"   # {best['tflops_total']:.2f} {unit} at {shape}, {src}")
+        else:
+            print(f"  ring sweep — feed the winner via --block-m/n/k "
+                  f"(rings key the plain table; no bake target)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:] or ["/dev/stdin"]))

@@ -66,3 +66,37 @@ def test_cli_dispatch(capsys):
                     "--warmup", "1", "--num-devices", "1"])
     assert len(records) == 1 and records[0].size == 64
     assert "Results for 64x64" in capsys.readouterr().out
+
+
+def test_bake_rows_emits_table_literals(tmp_path):
+    # the measurement-to-bake bridge: winners per (dtype, shape) with the
+    # exact _V5E_ROWS/_RECT_V5E_ROWS literals and source provenance
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    src = tmp_path / "tune.jsonl"
+    with open(src, "w") as f:
+        for rec in [
+            {"benchmark": "tune", "mode": "pallas_tune", "size": 8192,
+             "dtype": "int8", "tflops_total": 381.2,
+             "extras": {"block_m": 2048, "block_n": 4096, "block_k": 1024}},
+            {"benchmark": "tune", "mode": "pallas_tune", "size": 8192,
+             "dtype": "int8", "tflops_total": 346.0,
+             "extras": {"block_m": 2048, "block_n": 4096, "block_k": 512}},
+            {"benchmark": "tune", "mode": "pallas_tune", "size": 28672,
+             "dtype": "bfloat16", "tflops_total": 193.0,
+             "extras": {"block_m": 2048, "block_n": 4096, "block_k": 512,
+                        "shape": "8192x4096x28672"}},
+        ]:
+            f.write(json.dumps(rec) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "bake_rows.py"), str(src)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "_V5E_ROWS['int8']: (8192, (2048, 4096, 1024))" in out.stdout
+    assert "_RECT_V5E_ROWS['bfloat16']" in out.stdout
+    assert "381.20 TOPS" in out.stdout
+    assert str(src) in out.stdout  # provenance

@@ -6,7 +6,7 @@
 #
 # Usage: ./run_multihost_benchmark.sh [NPROCS] [MODE] [DTYPE] [--device=cpu] [extra flags...]
 # MULTIHOST_PROGRAM selects the benchmark module (scaling | distributed |
-# overlap | collectives; default scaling).
+# overlap | collectives | curve | summa | hybrid; default scaling).
 #
 # Local demo mode (default): spawns NPROCS processes on this machine joined
 # through a localhost coordinator. With --device=cpu each process simulates
@@ -23,6 +23,7 @@ case "${MULTIHOST_PROGRAM:-scaling}" in
   collectives) DEFAULT_MODE=psum ;;
   curve) DEFAULT_MODE=independent ;;
   summa) DEFAULT_MODE=summa ;;
+  hybrid) DEFAULT_MODE=hybrid ;;
   *) DEFAULT_MODE=independent ;;
 esac
 MODE=${2:-$DEFAULT_MODE}
@@ -69,10 +70,12 @@ case "${MULTIHOST_PROGRAM:-scaling}" in
   collectives) MODULE=tpu_matmul_bench.benchmarks.collective_benchmark ;;
   curve) MODULE=tpu_matmul_bench.benchmarks.scaling_curve ;;
   summa) MODULE=tpu_matmul_bench.benchmarks.matmul_summa_benchmark ;;
+  hybrid) MODULE=tpu_matmul_bench.benchmarks.matmul_hybrid_benchmark ;;
   *) echo "ERROR: unknown MULTIHOST_PROGRAM '${MULTIHOST_PROGRAM}'" >&2; exit 2 ;;
 esac
-if [[ "${MULTIHOST_PROGRAM:-scaling}" == "summa" ]]; then
-  # summa has no --mode (the program IS the mode; grid via --rows)
+if [[ "${MULTIHOST_PROGRAM:-scaling}" == "summa" || "${MULTIHOST_PROGRAM:-scaling}" == "hybrid" ]]; then
+  # summa/hybrid have no --mode (the program IS the mode; grid via
+  # --rows / --dp)
   CMD=(python3 -m "$MODULE" --dtype "${DTYPE}" ${EXTRA[@]+"${EXTRA[@]}"})
 else
   CMD=(python3 -m "$MODULE"

@@ -142,6 +142,23 @@ def test_multihost_launcher_runs_summa():
     assert "validation: ok" in out.stdout
 
 
+def test_multihost_launcher_runs_hybrid():
+    """The hybrid dp×tp mode over a REAL 2-process cluster: the 2-D mesh
+    spans the process boundary, so the tp gather and dp psum cross hosts
+    on their respective axes."""
+    env = scrubbed_env()
+    env["MULTIHOST_PROGRAM"] = "hybrid"
+    out = _run_launcher(
+        ["./run_multihost_benchmark.sh", "2", "hybrid", "bfloat16",
+         "--device=cpu", "--sizes", "64", "--iterations", "2",
+         "--warmup", "1", "--validate"],
+        env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Mesh: dp=2 x tp=2" in out.stdout
+    assert "Results for 64x64 [hybrid]" in out.stdout
+    assert "validation: ok" in out.stdout
+
+
 def test_multihost_curve_balanced_submeshes(tmp_path):
     """The scaling `curve` over a REAL 2-process cluster (4 global devices).
     Counts must be swept as multiples of the process count with BALANCED

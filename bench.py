@@ -10,7 +10,13 @@ Protocol matches the reference's: 10 warmup + 50 timed iterations
 Runs on the real TPU chip. Takes the best of three attempts (tuned Pallas
 kernel first — the measured winner, RESULTS_TPU.md — then XLA, then Pallas
 again; the first run eats session warm-up and the chip shows ~1%
-run-to-run variance).
+run-to-run variance). Attempts use `--timing fused` (all 50 iterations
+inside ONE compiled program, chained with optimization_barrier): the
+dispatch-loop protocol measures the host enqueue rate whenever the axon
+tunnel's per-RPC latency exceeds the op's ~45 ms device time (observed
+2026-07-31: 121 and 50 "TFLOPS" minutes apart on a healthy chip), while
+the fused program's single dispatch measures the chip itself — the same
+quantity the reference's CUDA events read off a deep stream.
 
 Resilience: the axon tunnel can wedge indefinitely when a relay grant is
 stranded (a killed client, or a remote-compile crash mid-RPC — both
@@ -156,7 +162,7 @@ def _run_attempts(deadline: float,
                  "tpu_matmul_bench.benchmarks.matmul_benchmark",
                  "--sizes", "16384", "--dtype", "bfloat16",
                  "--iterations", "50", "--warmup", "10",
-                 "--num-devices", "1",
+                 "--num-devices", "1", "--timing", "fused",
                  "--matmul-impl", impl, "--json-out", out_path])
         procs.append(subprocess.Popen(
             argv,

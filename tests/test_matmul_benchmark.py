@@ -92,3 +92,35 @@ def test_rect_workload_memory():
     assert abs(wl.memory_gib - want) < 1e-12
     a, b = wl.operands()
     assert a.shape == (1024, 2048) and b.shape == (2048, 512)
+
+
+def test_timing_fused_single_device(tmp_path):
+    # --timing fused: the whole loop runs inside one compiled program; the
+    # record says so and the numbers are sane (validated against the same
+    # corner check as the dispatch protocol).
+    recs = matmul_benchmark.main(_argv(
+        tmp_path, ["--num-devices", "1", "--timing", "fused", "--validate"]))
+    assert all(r.tflops_total > 0 for r in recs)
+    for r in recs:
+        assert r.extras["timing"] == "fused"
+        assert r.extras["validation"] == "ok"
+        # iterations counts fn applications (dispatches × fused length)
+        assert r.iterations >= 3 and r.iterations % 3 == 0
+
+
+def test_timing_fused_all_devices(tmp_path):
+    recs = matmul_benchmark.main(_argv(tmp_path, ["--timing", "fused"]))
+    assert all(r.world == 8 for r in recs)
+    assert all(r.extras["timing"] == "fused" for r in recs)
+    assert all(r.tflops_total == 8 * r.tflops_per_device for r in recs)
+
+
+def test_timing_fused_rect(tmp_path):
+    out = tmp_path / "rect.jsonl"
+    recs = matmul_benchmark.main([
+        "--mkn", "64", "128", "32", "--iterations", "2", "--warmup", "1",
+        "--dtype", "float32", "--num-devices", "1", "--timing", "fused",
+        "--validate", "--json-out", str(out)])
+    (rec,) = recs
+    assert rec.extras["timing"] == "fused"
+    assert rec.extras["validation"] == "ok"

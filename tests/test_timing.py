@@ -101,3 +101,67 @@ def test_time_variants_comm_split_nonnegative():
 def test_time_legs_requires_legs():
     with pytest.raises(ValueError):
         time_legs([], (jnp.ones(1),))
+
+
+def test_fuse_iterations_matches_direct_result():
+    # The fused program's output is the last step's fn application on the
+    # ORIGINAL operands (the barrier chain adds dependence, not data change).
+    from tpu_matmul_bench.utils.timing import fuse_iterations
+
+    def f(a, b):
+        return a @ b
+
+    a = jnp.arange(16.0).reshape(4, 4)
+    b = jnp.eye(4) * 2.0
+    for k in (1, 2, 5):
+        fused = fuse_iterations(f, k)
+        assert jnp.allclose(fused(a, b), f(a, b))
+
+
+def test_fuse_iterations_mixed_output_dtype():
+    # int8 operands with a widened (int32) output must carry cleanly
+    # through the scan chain.
+    from tpu_matmul_bench.utils.timing import fuse_iterations
+
+    def f(a, b):
+        return jax.lax.dot(a, b, preferred_element_type=jnp.int32)
+
+    a = jnp.ones((8, 8), jnp.int8)
+    fused = fuse_iterations(f, 3)
+    out = fused(a, a)
+    assert out.dtype == jnp.int32
+    assert jnp.all(out == 8)
+
+
+def test_fuse_iterations_runs_fn_k_times():
+    # The chained steps survive XLA: a counter bumped via an io-free proxy
+    # is impossible to observe, so instead check the program really loops —
+    # the scan must appear for k>1 (trace-level check via lowering text).
+    from tpu_matmul_bench.utils.timing import fuse_iterations
+
+    def f(a, b):
+        return a @ b
+
+    a = jnp.ones((16, 16))
+    hlo = fuse_iterations(f, 8).lower(a, a).as_text()
+    assert "while" in hlo  # the fused loop is a real on-device loop
+
+
+def test_fuse_iterations_rejects_nonpositive():
+    from tpu_matmul_bench.utils.timing import fuse_iterations
+
+    with pytest.raises(ValueError):
+        fuse_iterations(lambda x: x, 0)
+
+
+def test_time_fused_counts_fn_applications():
+    from tpu_matmul_bench.utils.timing import time_fused
+
+    def f(a, b):
+        return a @ b
+
+    a = jnp.ones((64, 64))
+    t = time_fused(f, (a, a), iterations=5, warmup=1)
+    # iterations counts fn applications: dispatches × fused length
+    assert t.iterations >= 5 and t.iterations % 5 == 0
+    assert t.total_s > 0

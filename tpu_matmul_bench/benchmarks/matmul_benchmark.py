@@ -38,7 +38,34 @@ from tpu_matmul_bench.utils.device import (
 from tpu_matmul_bench.utils.metrics import calculate_tflops
 from tpu_matmul_bench.utils.profiling import maybe_trace
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord, header, report
-from tpu_matmul_bench.utils.timing import latency_percentiles_ms, time_jitted
+from tpu_matmul_bench.utils.timing import (
+    latency_percentiles_ms,
+    time_fused,
+    time_jitted,
+)
+
+
+def _time(config: BenchConfig, fn, operands):
+    """Dispatch-loop or fused-loop timing per --timing (utils/timing.py)."""
+    timer = time_fused if config.timing == "fused" else time_jitted
+    return timer(fn, operands, iterations=config.iterations,
+                 warmup=config.warmup)
+
+
+def _base_extras(config: BenchConfig, t) -> dict:
+    """Record extras shared by every timed path: reliability + protocol."""
+    extras: dict = {} if t.reliable else {"timing_reliable": False}
+    if config.timing != "dispatch":
+        extras["timing"] = config.timing
+    return extras
+
+
+def _effective_warmup(config: BenchConfig) -> int:
+    """What actually warmed the program: the fused protocol runs ONE warm
+    pass of the K-op program (K = iterations fn applications), not
+    config.warmup dispatches — the record must describe the run, not the
+    flag."""
+    return config.iterations if config.timing == "fused" else config.warmup
 
 
 def _bench_single(
@@ -55,8 +82,8 @@ def _bench_single(
             got = mm(a, b)[:VALIDATION_CORNER, :VALIDATION_CORNER]
             verdict = corner_validation(got, expected_corner(a, b),
                                         config.dtype)
-        t = time_jitted(mm, (a, b), iterations=config.iterations, warmup=config.warmup)
-        extras: dict = {} if t.reliable else {"timing_reliable": False}
+        t = _time(config, mm, (a, b))
+        extras = _base_extras(config, t)
         if config.percentiles:
             extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
         extras.update(verdict)
@@ -68,7 +95,7 @@ def _bench_single(
         dtype=config.dtype_name,
         world=1,
         iterations=t.iterations,
-        warmup=config.warmup,
+        warmup=_effective_warmup(config),
         avg_time_s=t.avg_s,
         tflops_per_device=tflops,
         tflops_total=tflops,
@@ -102,8 +129,8 @@ def _bench_all_devices(
         got = mm(a, b)[0, :VALIDATION_CORNER, :VALIDATION_CORNER]
         verdict = corner_validation(got, expected_corner(a[0], b[0]),
                                     config.dtype)
-    t = time_jitted(mm, (a, b), iterations=config.iterations, warmup=config.warmup)
-    extras: dict = {} if t.reliable else {"timing_reliable": False}
+    t = _time(config, mm, (a, b))
+    extras = _base_extras(config, t)
     if config.percentiles:
         extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
     extras.update(verdict)
@@ -115,7 +142,7 @@ def _bench_all_devices(
         dtype=config.dtype_name,
         world=d,
         iterations=t.iterations,
-        warmup=config.warmup,
+        warmup=_effective_warmup(config),
         avg_time_s=t.avg_s,
         tflops_per_device=per_device,
         tflops_total=per_device * d,  # ≙ all_reduce SUM of TFLOPS (:114)
@@ -141,11 +168,8 @@ def _bench_rect(
             got = mm(a, b)[:c, :c]
             verdict = corner_validation(got, expected_corner(a, b, corner=c),
                                         config.dtype)
-        t = time_jitted(mm, (a, b), iterations=config.iterations,
-                        warmup=config.warmup)
-        extras: dict = {"shape": f"{m}x{k}x{n}"}
-        if not t.reliable:
-            extras["timing_reliable"] = False
+        t = _time(config, mm, (a, b))
+        extras = {"shape": f"{m}x{k}x{n}", **_base_extras(config, t)}
         if config.percentiles:
             extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
         extras.update(verdict)
@@ -153,7 +177,7 @@ def _bench_rect(
     return BenchmarkRecord(
         benchmark="matmul", mode="single", size=max(mkn),
         dtype=config.dtype_name, world=1, iterations=t.iterations,
-        warmup=config.warmup, avg_time_s=t.avg_s,
+        warmup=_effective_warmup(config), avg_time_s=t.avg_s,
         tflops_per_device=tflops, tflops_total=tflops,
         device_kind=device_kind, flops_per_op=wl.flops, extras=extras,
     )
@@ -228,7 +252,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     from tpu_matmul_bench.utils.config import build_parser, config_from_args
 
     parser = build_parser(__doc__ or "matmul benchmark",
-                          extra_dtypes=("int8",))
+                          extra_dtypes=("int8",), fused_timing=True)
     parser.add_argument(
         "--mkn", type=int, nargs=3, metavar=("M", "K", "N"), default=None,
         help="Benchmark one rectangular C[M,N] = A[M,K]·B[K,N] instead of "

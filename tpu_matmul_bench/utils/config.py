@@ -68,6 +68,11 @@ class BenchConfig:
     # HBM ring kernels' W-resident VMEM mode: auto (engage when the shard
     # fits), on (error if it cannot), off (always stream W tiles)
     wres: str = "auto"
+    # timed-loop protocol: "dispatch" = N async dispatches + one barrier
+    # (reference protocol); "fused" = the N iterations run inside ONE
+    # compiled program (lax.scan + optimization_barrier chaining) so host/
+    # tunnel dispatch latency cannot cap the measurement
+    timing: str = "dispatch"
 
     @property
     def wres_override(self) -> bool | None:
@@ -98,6 +103,7 @@ def build_parser(
     modes: Sequence[str] | None = None,
     default_mode: str | None = None,
     extra_dtypes: Sequence[str] = (),
+    fused_timing: bool = False,
 ) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=description)
     p.add_argument(
@@ -184,6 +190,21 @@ def build_parser(
              "on = require it (error if it cannot fit); off = always "
              "stream (A/B lever).",
     )
+    if fused_timing:
+        # opt-in per program: only programs that actually thread
+        # config.timing into their timed loops may offer the flag —
+        # accepting-and-ignoring it would stamp dispatch-capped numbers
+        # as fused
+        p.add_argument(
+            "--timing", type=str, default="dispatch",
+            choices=["dispatch", "fused"],
+            help="Timed-loop protocol: 'dispatch' issues one async dispatch "
+                 "per iteration (reference protocol, "
+                 "matmul_benchmark.py:54-68); 'fused' runs all iterations "
+                 "inside one compiled program (lax.scan chained via "
+                 "optimization_barrier), so a slow host↔device link "
+                 "measures the chip, not the dispatch rate.",
+        )
     p.add_argument(
         "--profile-dir", type=str, default=None,
         help="Write a jax.profiler trace of the benchmark here (view with "
@@ -215,6 +236,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         block_n=getattr(args, "block_n", None),
         block_k=getattr(args, "block_k", None),
         wres=getattr(args, "wres", "auto"),
+        timing=getattr(args, "timing", "dispatch"),
     )
 
 

@@ -190,3 +190,30 @@ def test_tune_ring_bidir_min_rows_skipped(capsys):
     assert records == []
     assert "need ≥ 2 rows" in out or "2 rows" in out
     assert "FAILED" not in out
+
+
+def test_tune_fused_timing(tmp_path):
+    # --timing fused: candidates are timed inside one compiled program;
+    # records tag the protocol and report the effective warmup.
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    records = main([
+        "--sizes", "64", "--iterations", "3", "--warmup", "5",
+        "--dtype", "float32", "--candidates", "32,32,32", "64,64,64",
+        "--timing", "fused", "--validate",
+        "--json-out", str(tmp_path / "fused.jsonl"),
+    ])
+    assert len(records) == 2
+    for r in records:
+        assert r.extras["timing"] == "fused"
+        assert r.extras["validation"] == "ok"
+        assert r.warmup == 3  # one fused pass = iterations applications
+        assert r.iterations % 3 == 0
+
+
+def test_tune_ring_rejects_fused():
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    with pytest.raises(SystemExit, match="dispatch protocol"):
+        main(["--ring", "pallas_ring_hbm", "--sizes", "64",
+              "--timing", "fused"])

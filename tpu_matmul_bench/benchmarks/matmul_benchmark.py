@@ -39,33 +39,25 @@ from tpu_matmul_bench.utils.metrics import calculate_tflops
 from tpu_matmul_bench.utils.profiling import maybe_trace
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord, header, report
 from tpu_matmul_bench.utils.timing import (
+    choose_timer,
+    effective_warmup,
     latency_percentiles_ms,
-    time_fused,
-    time_jitted,
+    protocol_extras,
 )
 
 
 def _time(config: BenchConfig, fn, operands):
     """Dispatch-loop or fused-loop timing per --timing (utils/timing.py)."""
-    timer = time_fused if config.timing == "fused" else time_jitted
-    return timer(fn, operands, iterations=config.iterations,
-                 warmup=config.warmup)
+    return choose_timer(config.timing)(
+        fn, operands, iterations=config.iterations, warmup=config.warmup)
 
 
 def _base_extras(config: BenchConfig, t) -> dict:
-    """Record extras shared by every timed path: reliability + protocol."""
-    extras: dict = {} if t.reliable else {"timing_reliable": False}
-    if config.timing != "dispatch":
-        extras["timing"] = config.timing
-    return extras
+    return protocol_extras(config.timing, t)
 
 
 def _effective_warmup(config: BenchConfig) -> int:
-    """What actually warmed the program: the fused protocol runs ONE warm
-    pass of the K-op program (K = iterations fn applications), not
-    config.warmup dispatches — the record must describe the run, not the
-    flag."""
-    return config.iterations if config.timing == "fused" else config.warmup
+    return effective_warmup(config.timing, config.iterations, config.warmup)
 
 
 def _bench_single(

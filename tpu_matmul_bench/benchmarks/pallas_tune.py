@@ -53,7 +53,12 @@ from tpu_matmul_bench.utils.reporting import (
     header,
     report,
 )
-from tpu_matmul_bench.utils.timing import time_jitted
+from tpu_matmul_bench.utils.timing import (
+    choose_timer,
+    effective_warmup,
+    protocol_extras,
+    time_jitted,
+)
 
 # Hardware-aligned candidates. The kernel raises Mosaic's vmem_limit_bytes
 # to fit each tile set (pallas_matmul._vmem_limit), so the grid includes
@@ -205,7 +210,7 @@ def _tune_ring(ring: str, candidates, config, devices, info,
 
 def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     parser = build_parser(__doc__ or "pallas block tuner",
-                          extra_dtypes=("int8",))
+                          extra_dtypes=("int8",), fused_timing=True)
     parser.add_argument(
         "--candidates", type=_parse_candidate, nargs="+",
         default=list(DEFAULT_CANDIDATES),
@@ -231,6 +236,12 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     if args.ring and args.mkn:
         raise SystemExit("--ring tunes the square --sizes sweep; "
                          "it cannot combine with --mkn")
+    if args.ring and config.timing == "fused":
+        # the rings are Pallas RDMA kernels; wrapping them in the fused
+        # scan is an unexercised compile surface — keep the ring sweep on
+        # the reference dispatch protocol
+        raise SystemExit("--ring tuning uses the dispatch protocol; "
+                         "drop --timing fused")
 
     # must precede tracing, same as runner.run_sizes: the jit cache keys on
     # the precision config (the tuner has its own loop, so it applies the
@@ -310,9 +321,9 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                             if verdict["validation"] != "ok":
                                 report(f"  VALIDATION FAILED: {verdict}")
                                 continue
-                        t = time_jitted(mm, (a, b),
-                                        iterations=config.iterations,
-                                        warmup=config.warmup)
+                        t = choose_timer(config.timing)(
+                            mm, (a, b), iterations=config.iterations,
+                            warmup=config.warmup)
                     except Exception as e:  # noqa: BLE001 — a bad blocking skips
                         report(f"  FAILED: {type(e).__name__}: {str(e)[:160]}")
                         continue
@@ -322,7 +333,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                     unit = throughput_unit(config.dtype)
                     report(f"  {tflops:.2f} {unit} ({t.avg_ms:.3f} ms)")
                     extras = {"block_m": bm, "block_n": bn, "block_k": bk,
-                              **verdict}
+                              **protocol_extras(config.timing, t), **verdict}
                     if rect:
                         extras["shape"] = label
                     if config.precision != "default":
@@ -331,7 +342,10 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                         benchmark="tune", mode="pallas_tune",
                         size=max(m, k, n),
                         dtype=config.dtype_name, world=1,
-                        iterations=t.iterations, warmup=config.warmup,
+                        iterations=t.iterations,
+                        warmup=effective_warmup(config.timing,
+                                                config.iterations,
+                                                config.warmup),
                         avg_time_s=t.avg_s, tflops_per_device=tflops,
                         tflops_total=tflops, device_kind=info.device_kind,
                         # rectangular-only: setting it for squares would

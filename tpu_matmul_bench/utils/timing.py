@@ -218,6 +218,28 @@ def time_fused(
     )
 
 
+def choose_timer(timing: str) -> Callable[..., Timing]:
+    """Timer for a --timing protocol name (see utils/config.py)."""
+    if timing not in ("dispatch", "fused"):
+        raise ValueError(f"unknown timing protocol {timing!r}")
+    return time_fused if timing == "fused" else time_jitted
+
+
+def protocol_extras(timing: str, t: Timing) -> dict:
+    """Record extras shared by every timed path: reliability + protocol."""
+    extras: dict = {} if t.reliable else {"timing_reliable": False}
+    if timing != "dispatch":
+        extras["timing"] = timing
+    return extras
+
+
+def effective_warmup(timing: str, iterations: int, warmup: int) -> int:
+    """What actually warmed the program: the fused protocol runs ONE warm
+    pass of the K-op program (K = iterations fn applications), not
+    `warmup` dispatches — records must describe the run, not the flag."""
+    return iterations if timing == "fused" else warmup
+
+
 def time_variants_n(
     fns: Sequence[Callable[..., Any]],
     args: Sequence[Any],

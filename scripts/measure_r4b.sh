@@ -1,0 +1,85 @@
+#!/bin/bash
+# Round-4 follow-up measurements — run AFTER scripts/measure_r4.sh.
+#
+# The main playbook's bf16 16k headline ran during the tunnel's recovery
+# transient (121 then 50 "TFLOPS" minutes apart on a healthy chip — the
+# dispatch loop was measuring the tunnel's per-RPC latency, not the MXU).
+# This script re-measures the headlines under BOTH protocols:
+#   - --timing fused (one compiled program = one dispatch for all 50
+#     iterations; immune to link latency) — the number that reflects the
+#     chip;
+#   - the dispatch protocol again, as the health probe for the link
+#     (healthy: the two agree to ~1%; degraded: dispatch reads low).
+#
+# Usage: bash scripts/measure_r4b.sh >> /tmp/measure_r4.log 2>&1
+
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p measurements/r4
+R4=measurements/r4
+
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+step() { echo; echo "=== [$(date +%H:%M:%S)] $*"; }
+
+# 1. bf16 16k headline, fused protocol, both impls (the round's headline).
+step "headline fused: 16k bf16 x50 pallas"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl pallas \
+  --json-out $R4/headline_fused_pallas.jsonl
+step "headline fused: 16k bf16 x50 xla"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl xla \
+  --json-out $R4/headline_fused_xla.jsonl
+
+# 2. int8 16k fused confirms (dispatch protocol already measured healthy
+#    numbers — 372.7/363.8 — so this doubles as protocol cross-validation).
+step "headline fused: 16k int8 x50 pallas + xla"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 16384 --dtype int8 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl pallas \
+  --json-out $R4/headline_fused_int8_pallas.jsonl
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 16384 --dtype int8 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl xla \
+  --json-out $R4/headline_fused_int8_xla.jsonl
+
+# 3. dispatch-protocol bf16 headline re-run (link-health probe: compare
+#    against the fused number).
+step "headline dispatch re-run: 16k bf16 x50 pallas"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+  --num-devices 1 --matmul-impl pallas \
+  --json-out $R4/headline_pallas_rerun.jsonl
+
+# 4. 8k/4k bf16 fused sweep (fills the size table under the robust
+#    protocol; r2 dispatch numbers: 194.4 at 8k, 165-188 at 4k).
+step "fused sweep: 4k 8k bf16 pallas"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 4096 8192 --dtype bfloat16 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl pallas \
+  --json-out $R4/fused_sweep_pallas.jsonl
+step "fused sweep: 4k 8k bf16 xla"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 4096 8192 --dtype bfloat16 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl xla \
+  --json-out $R4/fused_sweep_xla.jsonl
+
+# 5. int8 8k: confirm the r4 sweep winner (1024,1024,2048 @ 359.19 TOPS,
+#    tune_int8_8k.jsonl) vs XLA under the fused protocol before baking.
+step "int8 8k winner confirm (fused): pallas 1024,1024,2048 vs xla"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 8192 --dtype int8 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl pallas \
+  --block-m 1024 --block-n 1024 --block-k 2048 \
+  --json-out $R4/int8_8k_winner_fused.jsonl
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 8192 --dtype int8 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl xla \
+  --json-out $R4/int8_8k_xla_fused.jsonl
+
+step "R4B ALL DONE"

@@ -1,0 +1,84 @@
+"""--timing fused across the mode benchmarks (utils/timing.fuse_iterations).
+
+The fused protocol wraps each timed program variant in one lax.scan
+program; Pallas RDMA kernels opt out (ModeSetup.fusable=False) and demote
+to the dispatch protocol, tagging what actually ran.
+"""
+
+import pytest
+
+from tpu_matmul_bench.parallel.modes import run_mode_benchmark
+from tpu_matmul_bench.parallel.overlap import OVERLAP_MODES, overlap_mode
+from tpu_matmul_bench.parallel.modes import SCALING_MODES
+from tpu_matmul_bench.utils.config import parse_config
+
+SIZE = 64
+
+
+def _cfg(extra=()):
+    return parse_config(
+        ["--sizes", str(SIZE), "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", *extra],
+        "test",
+        modes=list(OVERLAP_MODES),
+        fused_timing=True,
+    )
+
+
+def test_scaling_mode_fused_split(mesh):
+    # batch_parallel under the fused protocol: the comm split still comes
+    # out of the variant difference, and the record tags the protocol.
+    config = _cfg(["--timing", "fused", "--validate"])
+    setup = SCALING_MODES["batch_parallel"](config, mesh, SIZE)
+    rec = run_mode_benchmark(setup, config)
+    assert rec.extras["timing"] == "fused"
+    assert rec.extras["validation"] == "ok"
+    assert rec.tflops_total > 0
+    assert rec.comm_time_s is not None and rec.comm_time_s >= 0
+
+
+def test_overlap_lax_mode_fused(mesh):
+    # the scan-carried overlap variant is fusable (scan-in-scan)
+    config = _cfg(["--timing", "fused"])
+    setup = overlap_mode(config, mesh, SIZE, variant="overlap")
+    rec = run_mode_benchmark(setup, config)
+    assert rec.extras["timing"] == "fused"
+    assert rec.tflops_total > 0
+
+
+def test_pallas_ring_demotes_to_dispatch(mesh):
+    # a non-fusable setup runs the dispatch protocol and says so
+    config = _cfg(["--timing", "fused"])
+    setup = OVERLAP_MODES["pallas_ring_hbm"](config, mesh, SIZE)
+    assert setup.fusable is False
+    rec = run_mode_benchmark(setup, config)
+    assert rec.extras["timing"] == "dispatch"
+    assert rec.tflops_total > 0
+
+
+def test_dispatch_default_untagged(mesh):
+    # without --timing fused no tag is added (records stay byte-stable
+    # with pre-r4 consumers)
+    config = _cfg()
+    setup = SCALING_MODES["independent"](config, mesh, SIZE)
+    rec = run_mode_benchmark(setup, config)
+    assert "timing" not in rec.extras
+
+
+def test_fused_iterations_accounting(mesh):
+    # fused Timings count fn applications, so per-op avg_s and the
+    # record's iterations field stay comparable across protocols
+    config = _cfg(["--timing", "fused"])
+    setup = SCALING_MODES["independent"](config, mesh, SIZE)
+    rec = run_mode_benchmark(setup, config)
+    assert rec.iterations % config.iterations == 0
+
+
+def test_summa_fused(devices):
+    from tpu_matmul_bench.parallel.summa import make_summa_mesh, summa_mode
+
+    config = _cfg(["--timing", "fused", "--validate"])
+    setup = summa_mode(config, make_summa_mesh(devices), SIZE)
+    rec = run_mode_benchmark(setup, config)
+    assert rec.extras["timing"] == "fused"
+    assert rec.extras["validation"] == "ok"

@@ -152,7 +152,8 @@ def compare(size: int, dtype: str, num_devices: int | None,
             isolate: bool = False,
             mode_timeout: float = 900.0,
             only: set[str] | None = None,
-            comm_quant: str | None = None) -> dict[str, BenchmarkRecord]:
+            comm_quant: str | None = None,
+            timing: str = "dispatch") -> dict[str, BenchmarkRecord]:
     if only is not None:
         only = {k.strip() for k in only if k.strip()}
         unknown = only - ROW_KEYS
@@ -177,16 +178,17 @@ def compare(size: int, dtype: str, num_devices: int | None,
         try:
             return _compare_rows(size, dtype, num_devices, iterations,
                                  warmup, precision, isolate, mode_timeout,
-                                 only, comm_quant)
+                                 only, comm_quant, timing)
         finally:
             force_reporting_process(prev)
     return _compare_rows(size, dtype, num_devices, iterations, warmup,
-                         precision, isolate, mode_timeout, only, comm_quant)
+                         precision, isolate, mode_timeout, only, comm_quant,
+                         timing)
 
 
 def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
-                  isolate, mode_timeout, only,
-                  comm_quant=None) -> dict[str, BenchmarkRecord]:
+                  isolate, mode_timeout, only, comm_quant=None,
+                  timing="dispatch") -> dict[str, BenchmarkRecord]:
     import jax
 
     from tpu_matmul_bench.benchmarks import (
@@ -222,6 +224,10 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
         # rides every psum/all_gather-carrying row; rows without a
         # quantizable collective ignore the flag
         common = common + ["--comm-quant", comm_quant]
+    if timing and timing != "dispatch":
+        # every row program accepts --timing; non-fusable setups (the
+        # Pallas RDMA kernels) demote to dispatch and say so in extras
+        common = common + ["--timing", timing]
     base = common + (["--num-devices", str(num_devices)] if num_devices else [])
 
     def run_prog(module, argv: list[str]) -> list[BenchmarkRecord]:
@@ -348,6 +354,11 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
         sweep_args = ["--sizes", str(size), "--dtype", dt,
                       "--iterations", str(iterations), "--warmup", str(warmup),
                       "--precision", precision, "--num-devices", "1"]
+        if timing and timing != "dispatch":
+            # the sweep rows must run the same protocol as the rest of the
+            # table — a dispatch row next to fused rows re-creates the
+            # mixed-protocol artifact --timing exists to prevent
+            sweep_args += ["--timing", timing]
         for rec in run_prog(matmul_benchmark, sweep_args):
             results[f"single_{dt}"] = rec
 
@@ -376,6 +387,8 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
                            "--iterations", str(iterations),
                            "--warmup", str(warmup),
                            "--precision", "highest", "--num-devices", "1"]
+            if timing and timing != "dispatch":
+                strict_args += ["--timing", timing]
             for rec in run_prog(matmul_benchmark, strict_args):
                 results["single_float32_strict"] = rec
 
@@ -507,6 +520,12 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
                    choices=["none", "int8"],
                    help="int8-wire collectives for every row that has a "
                         "quantizable psum/all_gather leg")
+    p.add_argument("--timing", type=str, default="dispatch",
+                   choices=["dispatch", "fused"],
+                   help="timed-loop protocol for every row (fused: all "
+                        "iterations inside one compiled program — immune "
+                        "to host-link dispatch latency; Pallas-kernel rows "
+                        "demote to dispatch and tag it in extras)")
     p.add_argument("--json-out", type=str, default=None,
                    help="write the comparison table as JSON lines")
     p.add_argument("--markdown-out", type=str, default=None,
@@ -545,7 +564,8 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
                           mode_timeout=args.mode_timeout,
                           only=(set(args.only.split(","))
                                 if args.only else None),
-                          comm_quant=args.comm_quant)
+                          comm_quant=args.comm_quant,
+                          timing=args.timing)
         return _finish(args, results)
     finally:
         # restore (not clear) after ALL parent-side reporting is done, for

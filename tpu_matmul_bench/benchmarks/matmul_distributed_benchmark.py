@@ -28,6 +28,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         modes=list(DISTRIBUTED_MODES),
         default_mode="data_parallel",
         extra_dtypes=("int8",),
+        fused_timing=True,
     )
     return run(
         config,

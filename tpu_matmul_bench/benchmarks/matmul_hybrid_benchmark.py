@@ -75,7 +75,7 @@ def run(config: BenchConfig, dp: int, batch: int) -> list[BenchmarkRecord]:
 
 def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     parser = build_parser(__doc__ or "hybrid benchmark",
-                          extra_dtypes=("int8",))
+                          extra_dtypes=("int8",), fused_timing=True)
     parser.add_argument("--dp", type=int, default=2,
                         help="data-parallel axis length (tp = devices/dp)")
     parser.add_argument("--batch", type=int, default=4,

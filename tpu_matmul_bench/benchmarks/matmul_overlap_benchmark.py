@@ -35,6 +35,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         modes=list(OVERLAP_MODES),
         default_mode="overlap",
         extra_dtypes=("int8",),
+        fused_timing=True,
     )
     return run(
         config,

@@ -147,6 +147,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         modes=list(SCALING_MODES),
         default_mode="independent",  # ≙ reference :360-362
         extra_dtypes=("int8",),
+        fused_timing=True,
     )
     return run(config)
 

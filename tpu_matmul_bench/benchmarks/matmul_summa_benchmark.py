@@ -85,7 +85,7 @@ def run(config: BenchConfig, rows: int | None = None) -> list[BenchmarkRecord]:
 
 def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     parser = build_parser(__doc__ or "SUMMA benchmark",
-                          extra_dtypes=("int8",))
+                          extra_dtypes=("int8",), fused_timing=True)
     parser.add_argument(
         "--rows", type=int, default=None,
         help="grid rows r (columns = devices/r; default: most-square "

@@ -39,8 +39,9 @@ from tpu_matmul_bench.utils.metrics import calculate_tflops, matmul_out_dtype
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
 from tpu_matmul_bench.utils.timing import (
     Timing,
+    choose_timer,
+    effective_warmup,
     latency_percentiles_ms,
-    time_jitted,
     time_variants,
     time_variants_n,
 )
@@ -70,6 +71,11 @@ class ModeSetup:
     # steps one timed program call represents (scan programs); per-step
     # extras divide by this
     steps_per_program: int = 1
+    # whether --timing fused may wrap this setup's programs in the fused
+    # scan (utils/timing.fuse_iterations). The Pallas RDMA kernels opt out:
+    # their semaphore/DMA state inside a scan body is an unexercised
+    # compile surface, so they demote to the dispatch protocol
+    fusable: bool = True
 
 
 # --validate corner size ≙ the reference's 10×10 spot check
@@ -570,14 +576,29 @@ def _pre_validate(setup: ModeSetup, config: BenchConfig) -> dict:
 
 
 def run_mode_benchmark(setup: ModeSetup, config: BenchConfig) -> BenchmarkRecord:
-    """Time a mode's programs and build its record (SURVEY I3 regimes)."""
+    """Time a mode's programs and build its record (SURVEY I3 regimes).
+
+    The --timing protocol threads through every regime; a non-fusable
+    setup (Pallas RDMA kernels) demotes to the dispatch protocol, and the
+    record's `timing` extra reports what actually ran.
+    """
+    protocol = config.timing if setup.fusable else "dispatch"
     verdict = _pre_validate(setup, config)
+
+    def _tag(rec: BenchmarkRecord) -> BenchmarkRecord:
+        if config.timing != "dispatch":
+            rec.extras["timing"] = protocol  # what ran, not what was asked
+        # describe the run, not the flag: fused warms with ONE K-op pass
+        rec.warmup = effective_warmup(protocol, config.iterations,
+                                      config.warmup)
+        return rec
+
     if setup.full is None:
-        t_compute = time_jitted(
+        t_compute = choose_timer(protocol)(
             setup.compute, setup.operands,
             iterations=config.iterations, warmup=config.warmup,
         )
-        rec = setup.build_record(t_compute, None, 0.0)
+        rec = _tag(setup.build_record(t_compute, None, 0.0))
         if not t_compute.reliable:
             rec.extras["timing_reliable"] = False
         if config.percentiles:
@@ -593,6 +614,7 @@ def run_mode_benchmark(setup: ModeSetup, config: BenchConfig) -> BenchmarkRecord
         t_compute, t_nocomm, t_full = time_variants_n(
             (setup.compute, setup.nocomm, setup.full), setup.operands,
             iterations=config.iterations, warmup=config.warmup,
+            protocol=protocol,
         )
         comm_s = max(t_full.avg_s - t_nocomm.avg_s, 0.0)
         overhead_s = max(t_nocomm.avg_s - t_compute.avg_s, 0.0)
@@ -600,9 +622,10 @@ def run_mode_benchmark(setup: ModeSetup, config: BenchConfig) -> BenchmarkRecord
         t_compute, t_full, comm_s = time_variants(
             setup.compute, setup.full, setup.operands,
             iterations=config.iterations, warmup=config.warmup,
+            protocol=protocol,
         )
         overhead_s = None
-    rec = setup.build_record(t_compute, t_full, comm_s)
+    rec = _tag(setup.build_record(t_compute, t_full, comm_s))
     if overhead_s is not None:
         rec.extras["overhead_time_s"] = round(
             overhead_s / setup.steps_per_program, 9)

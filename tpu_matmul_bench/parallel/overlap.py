@@ -306,7 +306,8 @@ def _vs_baseline_mode(config: BenchConfig, mesh: Mesh, size: int,
                       mode_name: str, baseline_program, overlapped_program,
                       baseline_label: str, extra_fields: dict, benchmark: str,
                       x_spec: P = P("x", None),
-                      w_spec: P = P(None, "x")) -> ModeSetup:
+                      w_spec: P = P(None, "x"),
+                      fusable: bool = True) -> ModeSetup:
     """Shared builder for the collective-matmul forms (all-gather ring,
     reduce-scatter ring, in-kernel Pallas ring): a serialized baseline leg
     timed against the overlapped program, with the speedup in extras."""
@@ -345,7 +346,8 @@ def _vs_baseline_mode(config: BenchConfig, mesh: Mesh, size: int,
                          mode_name, config, d, size),
                      validate=make_corner_validate(
                          overlapped_program, (x, w),
-                         lambda: expected_corner(x, w), config.dtype))
+                         lambda: expected_corner(x, w), config.dtype),
+                     fusable=fusable)
 
 
 def collective_matmul_mode(config: BenchConfig, mesh: Mesh, size: int,
@@ -631,6 +633,7 @@ def pallas_ring_mode(config: BenchConfig, mesh: Mesh, size: int,
         ring_allgather_matmul(mesh),
         "all_gather-then-matmul",
         {"kernel": "pallas ring RDMA all-gather matmul"}, benchmark,
+    fusable=False,
     )
 
 
@@ -688,6 +691,7 @@ def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
         "all_gather-then-matmul",
         {"kernel": "pallas HBM ring RDMA all-gather matmul",
          **_wres_extras(config, fn, size)}, benchmark,
+    fusable=False,
     )
 
 
@@ -713,6 +717,7 @@ def pallas_ring_bidir_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
         {"kernel": "pallas bidirectional HBM ring RDMA all-gather matmul",
          **_wres_extras(config, fn, size)},
         benchmark,
+    fusable=False,
     )
 
 
@@ -742,6 +747,7 @@ def pallas_ring_bidir_rs_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
          **_wres_extras(config, fn, size)},
         benchmark,
         x_spec=P(None, "x"), w_spec=P("x", None),
+        fusable=False,
     )
 
 
@@ -767,6 +773,7 @@ def pallas_ring_rs_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
         {"kernel": "pallas HBM ring RDMA reduce-scatter matmul",
          **_wres_extras(config, fn, size)}, benchmark,
         x_spec=P(None, "x"), w_spec=P("x", None),
+        fusable=False,
     )
 
 

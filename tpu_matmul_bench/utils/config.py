@@ -246,7 +246,9 @@ def parse_config(
     modes: Sequence[str] | None = None,
     default_mode: str | None = None,
     extra_dtypes: Sequence[str] = (),
+    fused_timing: bool = False,
 ) -> BenchConfig:
     parser = build_parser(description, modes=modes, default_mode=default_mode,
-                          extra_dtypes=extra_dtypes)
+                          extra_dtypes=extra_dtypes,
+                          fused_timing=fused_timing)
     return config_from_args(parser.parse_args(argv))

@@ -247,6 +247,7 @@ def time_variants_n(
     iterations: int = 50,
     warmup: int = 10,
     repeats: int = 3,
+    protocol: str = "dispatch",
 ) -> list[Timing]:
     """Time several program variants interleaved, median-of-`repeats` each.
 
@@ -257,7 +258,21 @@ def time_variants_n(
     across all variants instead of biasing one, and the median rejects a
     single slow outlier round. Warmup (incl. compile) happens only in the
     first round — later rounds reuse the jit cache.
+
+    With protocol="fused" each variant is wrapped by `fuse_iterations`
+    first (all `iterations` applications inside one program — see
+    `time_fused`); each round then times one dispatch per variant, and the
+    returned Timings count individual fn applications, so `avg_s` stays
+    per-op under either protocol.
     """
+    k = 1
+    if protocol == "fused":
+        k = max(int(iterations), 1)
+        fns = [fuse_iterations(fn, k) for fn in fns]
+        iterations = 1
+        warmup = 1  # one fused call compiles AND runs a full K-op pass
+    elif protocol != "dispatch":
+        raise ValueError(f"unknown timing protocol {protocol!r}")
     rounds = []
     for r in range(repeats):
         rounds.append([
@@ -268,7 +283,12 @@ def time_variants_n(
     out = []
     for i in range(len(fns)):
         ts = sorted((row[i] for row in rounds), key=lambda t: t.avg_s)
-        out.append(ts[len(ts) // 2])
+        med = ts[len(ts) // 2]
+        if k > 1:
+            med = Timing(total_s=med.total_s, iterations=med.iterations * k,
+                         sync_overhead_s=med.sync_overhead_s,
+                         reliable=med.reliable)
+        out.append(med)
     return out
 
 
@@ -280,6 +300,7 @@ def time_variants(
     iterations: int = 50,
     warmup: int = 10,
     repeats: int = 3,
+    protocol: str = "dispatch",
 ) -> tuple[Timing, Timing, float]:
     """Compute/comm split via program variants (the XLA-native split, SURVEY §7).
 
@@ -292,7 +313,8 @@ def time_variants(
     """
     t_compute, t_full = time_variants_n(
         (compute_fn, full_fn), args,
-        iterations=iterations, warmup=warmup, repeats=repeats)
+        iterations=iterations, warmup=warmup, repeats=repeats,
+        protocol=protocol)
     comm_s = max(t_full.avg_s - t_compute.avg_s, 0.0)
     return t_compute, t_full, comm_s
 

@@ -82,3 +82,33 @@ def test_summa_fused(devices):
     rec = run_mode_benchmark(setup, config)
     assert rec.extras["timing"] == "fused"
     assert rec.extras["validation"] == "ok"
+
+
+def test_collective_benchmark_fused(tmp_path):
+    import json
+
+    from tpu_matmul_bench.benchmarks import collective_benchmark
+
+    recs = collective_benchmark.main([
+        "--sizes", "64", "--iterations", "2", "--warmup", "1",
+        "--dtype", "float32", "--mode", "psum", "--timing", "fused",
+        "--validate", "--json-out", str(tmp_path / "c.jsonl")])
+    (rec,) = recs
+    assert rec.extras["timing"] == "fused"
+    assert rec.extras["validation"] == "ok"
+    assert rec.algbw_gbps > 0
+    parsed = json.loads((tmp_path / "c.jsonl").read_text().splitlines()[0])
+    assert parsed["extras"]["timing"] == "fused"
+
+
+def test_membw_fused(tmp_path):
+    from tpu_matmul_bench.benchmarks import membw_benchmark
+
+    recs = membw_benchmark.main([
+        "--sizes", "128", "--iterations", "2", "--warmup", "1",
+        "--dtype", "float32", "--mode", "triad", "--timing", "fused",
+        "--json-out", str(tmp_path / "m.jsonl")])
+    (rec,) = recs
+    assert rec.extras["timing"] == "fused"
+    assert rec.algbw_gbps > 0
+    assert rec.warmup == 2

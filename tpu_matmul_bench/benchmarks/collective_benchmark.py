@@ -101,6 +101,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         # int8 payloads: collectives move bytes, and the reductions (psum /
         # reduce_scatter) stay in-range for the small-int operand data
         extra_dtypes=("int8",),
+        fused_timing=True,
     )
     return run(config)
 

@@ -32,7 +32,11 @@ from tpu_matmul_bench.utils.reporting import (
     header,
     report,
 )
-from tpu_matmul_bench.utils.timing import time_jitted
+from tpu_matmul_bench.utils.timing import (
+    choose_timer,
+    effective_warmup,
+    protocol_extras,
+)
 
 # STREAM convention: name -> (program(a, b, s), bytes moved per element
 # slot — reads + writes of n²-element arrays). The scalar rides as a
@@ -59,8 +63,9 @@ def bench_membw(config: BenchConfig, size: int, op: str,
         device)
     s = jax.device_put(jnp.asarray(1.0001, config.dtype), device)
     jitted = jax.jit(fn)  # operands are committed to `device` above
-    t = time_jitted(jitted, (a, b, s), iterations=config.iterations,
-                    warmup=config.warmup)
+    t = choose_timer(config.timing)(jitted, (a, b, s),
+                                    iterations=config.iterations,
+                                    warmup=config.warmup)
     moved = bytes_factor * size * size * jnp.dtype(config.dtype).itemsize
     gbps = moved / t.avg_s / 1e9
     info = collect_device_info([device])
@@ -72,19 +77,19 @@ def bench_membw(config: BenchConfig, size: int, op: str,
         dtype=config.dtype_name,
         world=1,
         iterations=t.iterations,
-        warmup=config.warmup,
+        warmup=effective_warmup(config.timing, config.iterations,
+                                config.warmup),
         avg_time_s=t.avg_s,
         tflops_per_device=0.0,  # not a FLOP benchmark
         tflops_total=0.0,
         device_kind=info.device_kind,
         bytes_per_device=moved,
         algbw_gbps=gbps,
-        extras={"stream_op": op, "bytes_factor": bytes_factor},
+        extras={"stream_op": op, "bytes_factor": bytes_factor,
+                **protocol_extras(config.timing, t)},
     )
     if spec:
         rec.extras["pct_of_spec_hbm_bw"] = round(100.0 * gbps / spec, 1)
-    if not t.reliable:
-        rec.extras["timing_reliable"] = False
     return rec
 
 
@@ -94,6 +99,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         description=__doc__ or "HBM bandwidth benchmark",
         modes=list(STREAM_OPS) + ["all"],
         default_mode="all",
+        fused_timing=True,
     )
     devices = resolve_devices(config.device, 1)
     device = devices[0]

@@ -31,7 +31,11 @@ from tpu_matmul_bench.parallel.mesh import (
 from tpu_matmul_bench.parallel.modes import corner_validation
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
-from tpu_matmul_bench.utils.timing import time_jitted
+from tpu_matmul_bench.utils.timing import (
+    choose_timer,
+    effective_warmup,
+    protocol_extras,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -194,8 +198,8 @@ def run_collective_benchmark(config: BenchConfig, mesh: Mesh, size: int,
     verdict = validate_collective(config, mesh, op) if config.validate else {}
     fn, x, spec = collective_setup(config, mesh, size, op)
     d = world_size(mesh)
-    t = time_jitted(fn, (x,), iterations=config.iterations,
-                    warmup=config.warmup)
+    t = choose_timer(config.timing)(fn, (x,), iterations=config.iterations,
+                                    warmup=config.warmup)
     payload = size * size * x.dtype.itemsize  # per-device input shard bytes
     algbw = spec.conv_size(d, payload) / t.avg_s / 1e9
     rec = BenchmarkRecord(
@@ -205,7 +209,8 @@ def run_collective_benchmark(config: BenchConfig, mesh: Mesh, size: int,
         dtype=config.dtype_name,
         world=d,
         iterations=t.iterations,
-        warmup=config.warmup,
+        warmup=effective_warmup(config.timing, config.iterations,
+                                config.warmup),
         avg_time_s=t.avg_s,
         tflops_per_device=0.0,  # not a FLOP benchmark
         tflops_total=0.0,
@@ -213,8 +218,7 @@ def run_collective_benchmark(config: BenchConfig, mesh: Mesh, size: int,
         algbw_gbps=algbw,
         busbw_gbps=algbw * spec.bus_factor(d),
         comm_time_s=t.avg_s,
-        extras={"bus_factor": round(spec.bus_factor(d), 4), **verdict},
+        extras={"bus_factor": round(spec.bus_factor(d), 4),
+                **protocol_extras(config.timing, t), **verdict},
     )
-    if not t.reliable:
-        rec.extras["timing_reliable"] = False
     return rec

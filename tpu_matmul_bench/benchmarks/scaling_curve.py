@@ -72,7 +72,8 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     parser = build_parser(__doc__ or "scaling curve",
                           modes=list(SCALING_MODES),
                           default_mode="independent",
-                          extra_dtypes=("int8",))
+                          extra_dtypes=("int8",),
+                          fused_timing=True)
     parser.add_argument(
         "--device-counts", type=_parse_counts, default=None,
         help="comma-separated device counts to sweep (default: powers of "

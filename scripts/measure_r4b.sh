@@ -82,4 +82,12 @@ python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
   --num-devices 1 --timing fused --matmul-impl xla \
   --json-out $R4/int8_8k_xla_fused.jsonl
 
+# 6. int8 4k grid — the main playbook's run wedged in session acquisition
+#    and produced zero candidates; re-run it here.
+step "tune: int8 4k grid (retry)"
+python -m tpu_matmul_bench tune --sizes 4096 --dtype int8 \
+  --iterations 20 \
+  --candidates 2048,4096,512 2048,4096,1024 4096,2048,512 4096,2048,1024 1024,4096,512 4096,4096,512 2048,2048,1024 2048,2048,512 1024,2048,1024 2048,2048,2048 1024,1024,2048 \
+  --json-out measurements/r4/tune_int8_4k.jsonl
+
 step "R4B ALL DONE"

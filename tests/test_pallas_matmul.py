@@ -79,8 +79,9 @@ def test_tuned_blocks_table():
                         jnp.float16) == (4096, 2048, 512)
     assert tuned_blocks(4096, 4096, 4096, "TPU v5 lite",
                         jnp.int8) == (2048, 2048, 1024)
+    # r4 re-sweep winner (deeper-K grid): measurements/r4/tune_int8_8k.jsonl
     assert tuned_blocks(8192, 8192, 8192, "TPU v5 lite",
-                        jnp.int8) == (2048, 4096, 512)
+                        jnp.int8) == (1024, 1024, 2048)
     assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
                         jnp.int8) == (2048, 2048, 1024)
 

@@ -76,11 +76,14 @@ _V5E_ROWS: dict[str, list[tuple[int, tuple[int, int, int]]]] = {
     # was measured at the d=8 16k chunk shape (2048, k=16384, 2048) —
     # 342.6 TOPS, vs 337.3 for (1024, 1024, 512) and 247.5 for 512³;
     # requested blocks clamp to the largest dividing rung ≤ each dim
-    # (_pick_block's ladder includes 1024/2048/4096)
+    # (_pick_block's ladder includes 1024/2048/4096). 8k row re-swept in
+    # r4 over the deeper-K grid (VERDICT r3 #3): the k-major
+    # (1024, 1024, 2048) tile wins at 359.19 TOPS vs 347.2 for the old
+    # (2048, 4096, 512) row — measurements/r4/tune_int8_8k.jsonl.
     "int8": [
         (1024, (2048, 2048, 1024)),
         (4096, (2048, 2048, 1024)),
-        (8192, (2048, 4096, 512)),
+        (8192, (1024, 1024, 2048)),
         (16384, (2048, 2048, 1024)),
     ],
     # fp32 sweep (r2, 8k under --precision highest): (1024, 1024, 512)

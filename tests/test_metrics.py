@@ -103,3 +103,17 @@ def test_scaling_efficiency():
     assert scaling_efficiency(170.0, 100.0, 2) == pytest.approx(85.0)
     assert scaling_efficiency(100.0, 0.0, 2) is None
     assert scaling_efficiency(100.0, 100.0, 0) is None
+
+
+def test_hbm_gbps_env_override(monkeypatch):
+    # TPU_BENCH_HBM_GBPS grounds the roofline denominator in a measured
+    # STREAM number instead of the spec table
+    from tpu_matmul_bench.utils.metrics import hbm_bandwidth_gbps
+
+    monkeypatch.setenv("TPU_BENCH_HBM_GBPS", "777.5")
+    assert hbm_bandwidth_gbps("TPU v5 lite") == 777.5
+    assert hbm_bandwidth_gbps("unknown chip") == 777.5
+    monkeypatch.setenv("TPU_BENCH_HBM_GBPS", "not-a-number")
+    assert hbm_bandwidth_gbps("TPU v5 lite") == 819.0  # spec fallback
+    monkeypatch.delenv("TPU_BENCH_HBM_GBPS")
+    assert hbm_bandwidth_gbps("unknown chip") is None

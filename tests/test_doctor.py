@@ -1,0 +1,51 @@
+"""Backend doctor CLI (benchmarks/doctor.py) on the CPU mesh."""
+
+import json
+
+import pytest
+
+from tpu_matmul_bench.benchmarks import doctor
+
+
+def test_doctor_healthy_on_cpu(tmp_path, capsys):
+    out = tmp_path / "doc.json"
+    report = doctor.main(["--size", "128", "--iterations", "3",
+                          "--json-out", str(out)])
+    assert report["healthy"] is True
+    assert report["link"] == "ok"
+    assert report["dispatch_per_op_ms"] > 0
+    assert report["fused_per_op_ms"] > 0
+    assert report["matmul_max_rel_err"] <= 3e-2
+    parsed = json.loads(out.read_text())
+    assert parsed["healthy"] is True
+    assert "verdict: HEALTHY" in capsys.readouterr().out
+
+
+def test_doctor_degraded_exit_code(monkeypatch):
+    # fake a wedged link: dispatch 100 ms/op vs fused 1 ms/op (relative
+    # protocol speeds on the real CPU backend are not deterministic
+    # enough to drive the verdict)
+    from tpu_matmul_bench.utils import timing
+
+    def fake(avg_s):
+        return lambda *a, **k: timing.Timing(total_s=avg_s * 3, iterations=3)
+
+    monkeypatch.setattr(timing, "time_jitted", fake(0.100))
+    monkeypatch.setattr(timing, "time_fused", fake(0.001))
+    with pytest.raises(SystemExit) as e:
+        doctor.main(["--size", "128", "--iterations", "3"])
+    assert e.value.code == 3
+
+
+def test_doctor_dead_backend_reports_error(monkeypatch, capsys):
+    def boom(*a, **k):
+        raise RuntimeError("Unable to initialize backend 'axon'")
+
+    monkeypatch.setattr(doctor, "run_doctor", boom)
+    with pytest.raises(SystemExit) as e:
+        doctor.main(["--json-out", "-"])
+    assert e.value.code == 1
+    out = capsys.readouterr().out
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert rec["link"] == "dead"
+    assert "axon" in rec["error"]

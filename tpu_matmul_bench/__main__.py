@@ -22,6 +22,7 @@ _PROGRAMS = {
     "hybrid": "tpu_matmul_bench.benchmarks.matmul_hybrid_benchmark",
     "summa": "tpu_matmul_bench.benchmarks.matmul_summa_benchmark",
     "compare": "tpu_matmul_bench.benchmarks.compare_benchmarks",
+    "doctor": "tpu_matmul_bench.benchmarks.doctor",
 }
 
 

@@ -1,0 +1,183 @@
+"""Backend health diagnosis — `python -m tpu_matmul_bench doctor`.
+
+The recurring operational question on a tunneled TPU backend is not "how
+fast is the chip" but "can I trust a measurement right now". Observed
+failure modes (ROADMAP.md environment incidents): the backend dead
+(session acquisition hangs ~25 min then `UNAVAILABLE`), the backend up
+but the link degraded (per-RPC dispatch latency exceeding the op's
+device time, which made the dispatch-loop protocol read 121 then 50
+"TFLOPS" on a healthy chip — RESULTS_TPU.md r4), and the healthy state.
+
+This program runs a staged probe and reports which state the backend is
+in, with the evidence:
+
+1. backend init (timed) + device banner;
+2. sync round-trip latency (`utils/timing.sync` on finished work — the
+   fixed cost every dispatch-protocol measurement subtracts);
+3. a small validated matmul round trip (compile + numerics);
+4. the link-health verdict: the same matmul timed under the dispatch
+   protocol AND the fused protocol (`--timing fused`'s single-program
+   loop). On a healthy link the two agree; the link is reported degraded
+   when dispatch reads slower than fused by `--degraded-ratio` (1.5×)
+   AND by `--degraded-abs-ms` (2 ms) per op — the ratio alone misfires
+   on ops so small that even healthy enqueue overhead dominates, the
+   absolute gap alone misfires on giant ops. A degraded tunnel adds
+   tens of ms per RPC; a healthy one adds microseconds.
+
+Exit status: 0 healthy, 3 link-degraded (chip fine, use `--timing
+fused`), 1 anything failed. The reference has no analogue (its NCCL
+environment fails loudly); on this backend the failure mode is silence,
+so the probe prints progress BEFORE each phase — a hang is visible and
+attributable. No analogue of bench.py's child-process armor here: doctor
+IS the probe, run it under `timeout` from scripts (a killed doctor
+client can strand the relay grant like any killed client — prefer
+generous timeouts).
+
+Run: python -m tpu_matmul_bench doctor [--size 1024] [--json-out -]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Sequence
+
+import numpy as np
+
+
+def _phase(msg: str) -> None:
+    # progress BEFORE each potentially-hanging call, flushed — a wedge is
+    # then visible in the log at the phase that caused it
+    print(f"[doctor] {msg} ...", flush=True)
+
+
+def run_doctor(size: int, iterations: int, degraded_ratio: float,
+               degraded_abs_ms: float, device: str | None) -> dict:
+    report: dict = {"healthy": False, "link": "unknown"}
+
+    _phase("importing jax + initializing backend")
+    t0 = time.perf_counter()
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_matmul_bench.utils.device import (
+        collect_device_info,
+        resolve_devices,
+    )
+
+    devices = resolve_devices(device, 1)
+    info = collect_device_info(devices)
+    report["init_s"] = round(time.perf_counter() - t0, 3)
+    report["platform"] = info.platform
+    report["device_kind"] = info.device_kind
+    print(f"[doctor] backend up: {info.platform} / {info.device_kind} "
+          f"({report['init_s']}s)", flush=True)
+
+    from tpu_matmul_bench.utils.timing import (
+        sync,
+        time_fused,
+        time_jitted,
+    )
+
+    _phase("measuring sync round-trip latency")
+    with jax.default_device(devices[0]):
+        probe = jnp.ones((8, 8), jnp.float32)
+        sync(probe)  # materialize + first-call compile of the reducer
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            sync(probe)
+            best = min(best, time.perf_counter() - t0)
+        report["sync_roundtrip_ms"] = round(best * 1e3, 3)
+        print(f"[doctor] sync round trip: {report['sync_roundtrip_ms']} ms",
+              flush=True)
+
+        _phase(f"compiling + validating a {size}x{size} bf16 matmul")
+        key = jax.random.PRNGKey(0)
+        ka, kb = jax.random.split(key)
+        a = jax.random.normal(ka, (size, size), jnp.float32).astype(
+            jnp.bfloat16)
+        b = jax.random.normal(kb, (size, size), jnp.float32).astype(
+            jnp.bfloat16)
+        mm = jax.jit(lambda x, y: x @ y)
+        t0 = time.perf_counter()
+        got = mm(a, b)
+        sync(got)
+        report["first_matmul_s"] = round(time.perf_counter() - t0, 3)
+        corner = np.asarray(got[:8, :8], np.float64)
+        want = np.asarray(a[:8].astype(jnp.float32), np.float64) @ np.asarray(
+            b[:, :8].astype(jnp.float32), np.float64)
+        err = float(np.abs(corner - want).max() / (np.abs(want).max() or 1.0))
+        report["matmul_max_rel_err"] = round(err, 6)
+        if not np.isfinite(err) or err > 3e-2:
+            report["link"] = "numerics-failed"
+            return report
+        print(f"[doctor] matmul ok ({report['first_matmul_s']}s incl. "
+              f"compile, rel err {err:.2e})", flush=True)
+
+        _phase(f"link health: dispatch vs fused protocol x{iterations}")
+        t_disp = time_jitted(mm, (a, b), iterations=iterations, warmup=2)
+        t_fused = time_fused(mm, (a, b), iterations=iterations, warmup=1)
+        report["dispatch_per_op_ms"] = round(t_disp.avg_ms, 3)
+        report["fused_per_op_ms"] = round(t_fused.avg_ms, 3)
+        ratio = (t_disp.avg_s / t_fused.avg_s
+                 if t_fused.avg_s > 0 else float("inf"))
+        gap_ms = max(t_disp.avg_ms - t_fused.avg_ms, 0.0)
+        report["dispatch_over_fused"] = round(ratio, 3)
+        report["dispatch_gap_ms"] = round(gap_ms, 3)
+        degraded = ratio > degraded_ratio and gap_ms > degraded_abs_ms
+        report["link"] = "degraded" if degraded else "ok"
+        report["healthy"] = report["link"] == "ok"
+        print(f"[doctor] dispatch {t_disp.avg_ms:.3f} ms/op vs fused "
+              f"{t_fused.avg_ms:.3f} ms/op (ratio {ratio:.2f}) -> link "
+              f"{report['link']}", flush=True)
+    return report
+
+
+def main(argv: Sequence[str] | None = None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__ or "backend doctor")
+    p.add_argument("--size", type=int, default=1024,
+                   help="probe matmul size (default 1024: big enough that "
+                        "a healthy chip's device time is measurable, small "
+                        "enough to compile fast)")
+    p.add_argument("--iterations", type=int, default=20,
+                   help="timed iterations per protocol (default 20)")
+    p.add_argument("--degraded-ratio", type=float, default=1.5,
+                   help="dispatch/fused per-op ratio above which the link "
+                        "is reported degraded (default 1.5; must ALSO "
+                        "exceed --degraded-abs-ms)")
+    p.add_argument("--degraded-abs-ms", type=float, default=2.0,
+                   help="minimum dispatch-minus-fused per-op gap (ms) for "
+                        "a degraded verdict (default 2.0 — healthy links "
+                        "add microseconds, a wedging tunnel tens of ms)")
+    p.add_argument("--device", type=str, default=None,
+                   choices=["tpu", "cpu", "gpu"])
+    p.add_argument("--json-out", type=str, default=None,
+                   help="write the report as one JSON line ('-' = stdout)")
+    args = p.parse_args(argv)
+
+    try:
+        report = run_doctor(args.size, args.iterations, args.degraded_ratio,
+                            args.degraded_abs_ms, args.device)
+    except Exception as e:  # noqa: BLE001 — the verdict must always print
+        report = {"healthy": False, "link": "dead",
+                  "error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print(f"[doctor] FAILED: {report['error']}", flush=True)
+
+    line = json.dumps(report, sort_keys=True)
+    if args.json_out == "-":
+        print(line, flush=True)
+    elif args.json_out:
+        with open(args.json_out, "w") as fh:
+            fh.write(line + "\n")
+    verdict = ("HEALTHY" if report["healthy"]
+               else f"NOT HEALTHY (link: {report['link']})")
+    print(f"[doctor] verdict: {verdict}", flush=True)
+    if not report["healthy"]:
+        raise SystemExit(3 if report.get("link") == "degraded" else 1)
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -26,7 +26,7 @@ from tpu_matmul_bench.utils.device import (
     device_banner,
     resolve_devices,
 )
-from tpu_matmul_bench.utils.metrics import hbm_bandwidth_gbps
+from tpu_matmul_bench.utils.metrics import hbm_spec_gbps
 from tpu_matmul_bench.utils.reporting import (
     BenchmarkRecord,
     header,
@@ -69,7 +69,7 @@ def bench_membw(config: BenchConfig, size: int, op: str,
     moved = bytes_factor * size * size * jnp.dtype(config.dtype).itemsize
     gbps = moved / t.avg_s / 1e9
     info = collect_device_info([device])
-    spec = hbm_bandwidth_gbps(info.device_kind)
+    spec = hbm_spec_gbps(info.device_kind)
     rec = BenchmarkRecord(
         benchmark="membw",
         mode=op,

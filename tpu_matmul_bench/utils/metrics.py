@@ -138,6 +138,17 @@ _HBM_GBPS: dict[str, float] = {
 }
 
 
+def hbm_spec_gbps(device_kind: str) -> float | None:
+    """Datasheet HBM bandwidth only — the baseline membw compares against
+    (never the TPU_BENCH_HBM_GBPS override, which would make the
+    measured-vs-spec ratio circular)."""
+    kind = device_kind.lower()
+    for key, bw in _HBM_GBPS.items():
+        if key in kind:
+            return bw
+    return None
+
+
 def hbm_bandwidth_gbps(device_kind: str) -> float | None:
     # TPU_BENCH_HBM_GBPS overrides the spec table with a MEASURED number
     # (the membw CLI's STREAM result) so the roofline denominator is
@@ -152,11 +163,7 @@ def hbm_bandwidth_gbps(device_kind: str) -> float | None:
                 return bw
         except ValueError:
             pass  # malformed override falls through to the spec table
-    kind = device_kind.lower()
-    for key, bw in _HBM_GBPS.items():
-        if key in kind:
-            return bw
-    return None
+    return hbm_spec_gbps(device_kind)
 
 
 def matmul_roofline_s(

@@ -73,6 +73,7 @@ def test_tune_honors_block_flags(capsys):
         "--sizes", "64", "--iterations", "2", "--warmup", "1",
         "--dtype", "float32", "--block-m", "32", "--block-n", "32",
         "--block-k", "32", "--candidates", "64,64,64",
+     "--confirm-top", "0",
     ])
     ran = [tuple(r.extras[k] for k in ("block_m", "block_n", "block_k"))
            for r in records]
@@ -95,6 +96,7 @@ def test_tune_cli_end_to_end(tmp_path, capsys):
         "--dtype", "float32",
         "--candidates", "32,32,32", "64,64,64",
         "--json-out", str(tmp_path / "tune.jsonl"),
+     "--confirm-top", "0",
     ])
     out = capsys.readouterr().out
     assert "BEST: --block-m" in out
@@ -202,6 +204,7 @@ def test_tune_fused_timing(tmp_path):
         "--dtype", "float32", "--candidates", "32,32,32", "64,64,64",
         "--timing", "fused", "--validate",
         "--json-out", str(tmp_path / "fused.jsonl"),
+     "--confirm-top", "0",
     ])
     assert len(records) == 2
     for r in records:
@@ -217,3 +220,34 @@ def test_tune_ring_rejects_fused():
     with pytest.raises(SystemExit, match="dispatch protocol"):
         main(["--ring", "pallas_ring_hbm", "--sizes", "64",
               "--timing", "fused"])
+
+
+def test_tune_confirm_pass(tmp_path, capsys):
+    # the top candidates are re-measured interleaved and the final BEST
+    # comes from the confirm ranking; confirm records carry the tag
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    records = main([
+        "--sizes", "64", "--iterations", "2", "--warmup", "1",
+        "--dtype", "float32", "--candidates", "32,32,32", "64,64,64",
+        "--confirm-top", "2",
+        "--json-out", str(tmp_path / "c.jsonl"),
+    ])
+    out = capsys.readouterr().out
+    assert "confirm pass: top 2 interleaved" in out
+    assert "BEST" in out
+    confirm = [r for r in records if r.extras.get("confirm_pass")]
+    assert len(confirm) == 2
+
+
+def test_tune_confirm_disabled(tmp_path, capsys):
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    records = main([
+        "--sizes", "64", "--iterations", "2", "--warmup", "1",
+        "--dtype", "float32", "--candidates", "32,32,32", "64,64,64",
+        "--confirm-top", "0",
+        "--json-out", str(tmp_path / "c.jsonl"),
+    ])
+    assert "confirm pass" not in capsys.readouterr().out
+    assert not [r for r in records if r.extras.get("confirm_pass")]

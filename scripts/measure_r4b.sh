@@ -82,6 +82,16 @@ python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
   --num-devices 1 --timing fused --matmul-impl xla \
   --json-out $R4/int8_8k_xla_fused.jsonl
 
+# 5b. Fused-protocol 16k compare: the main playbook's compare steps
+#     predate --timing fused, so if they ran through a degraded window
+#     their rows are link-capped; this table is the protocol-proof one.
+step "compare: 16k full table (isolate, fused)"
+python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
+  --size 16384 --iterations 20 --warmup 5 --isolate --mode-timeout 900 \
+  --timing fused \
+  --json-out measurements/r4/compare_r4_16k_fused.jsonl \
+  --markdown-out measurements/r4/compare_r4_16k_fused.md
+
 # 6. int8 4k grid — the main playbook's run wedged in session acquisition
 #    and produced zero candidates; re-run it here.
 step "tune: int8 4k grid (retry)"

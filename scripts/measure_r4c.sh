@@ -1,0 +1,164 @@
+#!/bin/bash
+# Round-4 consolidated measurement driver — REPLACES the remainder of
+# measure_r4.sh + measure_r4b.sh, re-ordered so that if the tunnel heals
+# for only a short window, the most valuable artifacts land first:
+# the fused-protocol bf16 headline (the round's headline number), then
+# int8 confirms, then the 16k compare, then the lower-value sweeps, with
+# the historically wedge-prone rect sweeps last.
+#
+# Startup: waits for any orphaned measure_r4.sh step (a python client
+# left running to its natural slow-fail — NEVER killed) to exit before
+# touching the backend.
+#
+# Usage: bash scripts/measure_r4c.sh > /tmp/measure_r4c.log 2>&1
+
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p measurements/r4
+R4=measurements/r4
+ITERS=20
+
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+step() { echo; echo "=== [$(date +%H:%M:%S)] $*"; }
+
+step "waiting for any orphaned playbook step to exit"
+while pgrep -f "python -m tpu_matmul_bench" > /dev/null 2>&1; do
+  sleep 30
+done
+step "backend is free — starting"
+
+# 1. THE headline: bf16 16k x50 under the fused protocol, both impls.
+step "headline fused: 16k bf16 x50 pallas"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl pallas \
+  --json-out $R4/headline_fused_pallas.jsonl
+step "headline fused: 16k bf16 x50 xla"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl xla \
+  --json-out $R4/headline_fused_xla.jsonl
+
+# 2. int8 16k fused confirms (dispatch already measured 372.7/363.8 in
+#    the healthy window — this cross-validates the protocols).
+step "headline fused: 16k int8 x50 pallas + xla"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 16384 --dtype int8 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl pallas \
+  --json-out $R4/headline_fused_int8_pallas.jsonl
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 16384 --dtype int8 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl xla \
+  --json-out $R4/headline_fused_int8_xla.jsonl
+
+# 3. Link-health probe: the dispatch-protocol bf16 headline again (fused
+#    vs dispatch gap = the link verdict; also overwrites the transient-
+#    corrupted first attempt if healthy now).
+step "headline dispatch re-run: 16k bf16 x50 pallas"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+  --num-devices 1 --matmul-impl pallas \
+  --json-out $R4/headline_pallas_rerun.jsonl
+
+# 4. int8 8k winner confirm (sweep winner (1024,1024,2048) @ 359.19 is
+#    baked — confirm at 50 iters fused, vs XLA).
+step "int8 8k winner confirm (fused)"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 8192 --dtype int8 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl pallas \
+  --json-out $R4/int8_8k_winner_fused.jsonl
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 8192 --dtype int8 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl xla \
+  --json-out $R4/int8_8k_xla_fused.jsonl
+
+# 5. Full-mode compare at 16k, fused protocol, isolate (VERDICT #5).
+step "compare: 16k full table (isolate, fused)"
+python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
+  --size 16384 --iterations $ITERS --warmup 5 --isolate \
+  --mode-timeout 900 --timing fused \
+  --json-out $R4/compare_r4_16k_fused.jsonl \
+  --markdown-out $R4/compare_r4_16k_fused.md
+
+# 6. bf16 fused size sweep (4k/8k) — fills the size table link-proof.
+step "fused sweep: 4k 8k bf16 pallas + xla"
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 4096 8192 --dtype bfloat16 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl pallas \
+  --json-out $R4/fused_sweep_pallas.jsonl
+python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+  --sizes 4096 8192 --dtype bfloat16 --iterations 50 --warmup 10 \
+  --num-devices 1 --timing fused --matmul-impl xla \
+  --json-out $R4/fused_sweep_xla.jsonl
+
+# 7. The sweeps the wedge ate (with the tuner's new interleaved confirm
+#    pass; fused protocol so link drift can't re-order candidates).
+step "tune: int8 4k grid (retry, fused+confirm)"
+python -m tpu_matmul_bench tune --sizes 4096 --dtype int8 \
+  --iterations $ITERS --timing fused \
+  --candidates 2048,4096,512 2048,4096,1024 4096,2048,512 4096,2048,1024 1024,4096,512 4096,4096,512 2048,2048,1024 2048,2048,512 1024,2048,1024 2048,2048,2048 1024,1024,2048 \
+  --json-out $R4/tune_int8_4k.jsonl
+step "tune: int8 16k check (retry, fused+confirm)"
+python -m tpu_matmul_bench tune --sizes 16384 --dtype int8 \
+  --iterations $ITERS --timing fused \
+  --candidates 2048,2048,1024 2048,4096,512 2048,4096,1024 4096,2048,1024 1024,1024,2048 \
+  --json-out $R4/tune_int8_16k.jsonl
+step "tune: int8 ring chunk 2048x16384x2048 (retry, fused+confirm)"
+python -m tpu_matmul_bench tune --mkn 2048 16384 2048 --dtype int8 \
+  --iterations $ITERS --timing fused \
+  --candidates 2048,2048,1024 1024,2048,512 2048,2048,512 1024,1024,512 2048,1024,1024 \
+  --json-out $R4/tune_int8_chunk.jsonl
+
+# 8. Ring kernels at d=1 16k + the ring block sweep (dispatch protocol —
+#    the rings are not fusable by design).
+for mode in pallas_ring_hbm pallas_ring_rs_hbm pallas_ring_bidir_hbm pallas_ring_bidir_rs_hbm; do
+  step "ring d=1 16k: $mode"
+  python -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \
+    --sizes 16384 --dtype bfloat16 --iterations $ITERS --warmup 5 \
+    --num-devices 1 --mode $mode --validate \
+    --json-out $R4/ring16k_$mode.jsonl
+done
+step "tune --ring pallas_ring_hbm 16k d=1"
+python -m tpu_matmul_bench tune --ring pallas_ring_hbm --sizes 16384 \
+  --dtype bfloat16 --iterations $ITERS --num-devices 1 --validate \
+  --candidates 4096,2048,512 2048,2048,512 2048,4096,512 2048,2048,1024 1024,2048,512 \
+  --json-out $R4/tune_ring_hbm_16k.jsonl
+
+# 9. pallas_ring at its lifted VMEM cap; membw ground truth.
+step "pallas_ring at lifted VMEM cap (d=1)"
+python -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \
+  --sizes 2176 --dtype bfloat16 --iterations 200 --warmup 20 \
+  --num-devices 1 --mode pallas_ring --validate \
+  --json-out $R4/pallas_ring_cap.jsonl
+step "membw: STREAM ops at 8k/16k (fused)"
+python -m tpu_matmul_bench membw --sizes 8192 16384 --dtype bfloat16 \
+  --iterations 50 --warmup 5 --timing fused --json-out $R4/membw.jsonl
+
+# 10. fp32 strict rows; 8k compare refresh.
+step "tune: strict fp32 4k + 16k (fused+confirm)"
+python -m tpu_matmul_bench tune --sizes 4096 16384 --dtype float32 \
+  --precision highest --iterations $ITERS --timing fused \
+  --candidates 1024,1024,512 512,1024,512 1024,2048,512 2048,1024,512 512,512,512 \
+  --json-out $R4/tune_fp32_strict.jsonl
+step "compare: 8k refresh (isolate, fused)"
+python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
+  --size 8192 --iterations $ITERS --warmup 5 --isolate \
+  --mode-timeout 900 --timing fused \
+  --json-out $R4/compare_r4_8k.jsonl --markdown-out $R4/compare_r4_8k.md
+
+# 11. Rect sweeps LAST (the r2 wedge trigger).
+step "tune: rect MLP 8192x4096x28672 (fused+confirm)"
+python -m tpu_matmul_bench tune --mkn 8192 4096 28672 --dtype bfloat16 \
+  --iterations $ITERS --timing fused \
+  --candidates 4096,2048,512 2048,4096,512 1024,4096,512 2048,2048,512 4096,4096,512 1024,2048,512 \
+  --json-out $R4/tune_rect_mlp.jsonl
+step "tune: rect tall-M 28672x4096x8192 (fused+confirm)"
+python -m tpu_matmul_bench tune --mkn 28672 4096 8192 --dtype bfloat16 \
+  --iterations $ITERS --timing fused \
+  --candidates 4096,2048,512 2048,2048,512 1024,2048,512 2048,4096,512 4096,1024,512 \
+  --json-out $R4/tune_rect_tallm.jsonl
+
+step "R4C ALL DONE"

@@ -224,3 +224,20 @@ def test_compare_comm_quant_threads_to_rows(tmp_path):
     assert results["matrix_parallel"].extras.get("comm_quant") == "int8"
     # rows without a quantizable collective are unaffected
     assert "comm_quant" not in results["single"].extras
+
+
+def test_compare_threads_timing_fused(tmp_path):
+    # --timing fused reaches every row, including the dtype-sweep rows
+    # (rebuilt argv) and the pallas rows (which demote and say so)
+    out = tmp_path / "cmpf.jsonl"
+    results = compare_benchmarks.main(
+        ["--size", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", "--timing", "fused",
+         "--only", "single,batch_parallel,pallas_ring_hbm,single_bfloat16",
+         "--json-out", str(out)]
+    )
+    assert results["single"].extras["timing"] == "fused"
+    assert results["batch_parallel"].extras["timing"] == "fused"
+    assert results["single_bfloat16"].extras["timing"] == "fused"
+    # non-fusable Pallas RDMA row: demoted, provenance kept
+    assert results["pallas_ring_hbm"].extras["timing"] == "dispatch"

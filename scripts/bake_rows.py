@@ -57,7 +57,22 @@ def main(paths):
         print("no tune records found", file=sys.stderr)
         return 1
     for (dtype, precision, shape), entries in sorted(groups.items()):
-        ranked = sorted(entries,
+        # the tuner's interleaved confirm pass (--confirm-top) re-measures
+        # the finalists drift-free; when confirm records exist they are
+        # the authoritative ranking — mixing them with raw sweep numbers
+        # would let a drift-inflated sweep value outrank its own confirm
+        confirmed = [e for e in entries
+                     if e[0]["extras"].get("confirm_pass")]
+        pool = confirmed or entries
+        by_blocks: dict = {}
+        for rec, path in pool:  # dedupe: one entry per blocking, best run
+            e = rec["extras"]
+            k = (e["block_m"], e["block_n"], e["block_k"])
+            if (k not in by_blocks
+                    or rec["tflops_total"]
+                    > by_blocks[k][0]["tflops_total"]):
+                by_blocks[k] = (rec, path)
+        ranked = sorted(by_blocks.values(),
                         key=lambda e: -e[0]["tflops_total"])
         (best, src) = ranked[0]
         ex = best["extras"]

@@ -241,3 +241,26 @@ def test_compare_threads_timing_fused(tmp_path):
     assert results["single_bfloat16"].extras["timing"] == "fused"
     # non-fusable Pallas RDMA row: demoted, provenance kept
     assert results["pallas_ring_hbm"].extras["timing"] == "dispatch"
+
+
+def test_markdown_notes_fused_protocol(tmp_path):
+    md = tmp_path / "t.md"
+    compare_benchmarks.main(
+        ["--size", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", "--timing", "fused",
+         "--only", "single,pallas_ring_hbm",
+         "--markdown-out", str(md)]
+    )
+    text = md.read_text()
+    assert "timing protocol: fused" in text
+    assert "dispatch-demoted rows: pallas_ring_hbm" in text
+
+
+def test_markdown_silent_on_dispatch(tmp_path):
+    md = tmp_path / "t.md"
+    compare_benchmarks.main(
+        ["--size", "64", "--iterations", "2", "--warmup", "1",
+         "--dtype", "float32", "--only", "single",
+         "--markdown-out", str(md)]
+    )
+    assert "timing protocol" not in md.read_text()

@@ -497,6 +497,17 @@ def render_markdown(results: dict[str, BenchmarkRecord]) -> str:
         )
     dtype_line = bf16_vs_fp32_line(results)
     extra_lines = notes + ([dtype_line] if dtype_line else [])
+    protocols = {rec.extras.get("timing", "dispatch")
+                 for rec in results.values()}
+    if protocols - {"dispatch"}:
+        # a fused-protocol table must say so (and name any demoted rows) —
+        # its numbers are link-latency-immune, unlike a dispatch table
+        demoted = [n for n, r in results.items()
+                   if r.extras.get("timing", "dispatch") == "dispatch"]
+        extra_lines.append(
+            "timing protocol: fused (all iterations in one compiled "
+            "program)" + (f"; dispatch-demoted rows: {', '.join(demoted)}"
+                          if demoted else ""))
     if extra_lines:
         lines.append("")
         lines.extend(extra_lines)

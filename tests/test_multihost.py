@@ -214,3 +214,20 @@ def test_two_process_psum():
     # ...and exactly one of them is the reporting process
     assert combined.count("MULTIHOST_OK") == 1, combined
     assert combined.count("MULTIHOST_WORKER") == 1, combined
+
+
+def test_multihost_launcher_runs_fused_timing():
+    """--timing fused over a real 2-process cluster: the fused scan wraps
+    a shard_map program whose psum crosses the process boundary, and the
+    timing engine's _agree broadcast keeps both controllers' auto-scale
+    decisions identical."""
+    env = scrubbed_env()
+    out = _run_launcher(
+        ["./run_multihost_benchmark.sh", "2", "batch_parallel", "bfloat16",
+         "--device=cpu", "--sizes", "64", "--iterations", "2",
+         "--warmup", "1", "--timing", "fused", "--validate"],
+        env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Results for 64x64 [batch_parallel]" in out.stdout
+    assert "timing: fused" in out.stdout
+    assert "validation: ok" in out.stdout

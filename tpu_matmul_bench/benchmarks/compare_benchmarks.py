@@ -224,10 +224,13 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
         # rides every psum/all_gather-carrying row; rows without a
         # quantizable collective ignore the flag
         common = common + ["--comm-quant", comm_quant]
-    if timing and timing != "dispatch":
-        # every row program accepts --timing; non-fusable setups (the
-        # Pallas RDMA kernels) demote to dispatch and say so in extras
-        common = common + ["--timing", timing]
+    # every row program accepts --timing; non-fusable setups (the Pallas
+    # RDMA kernels) demote to dispatch and say so in extras. The sweep and
+    # strict rows below rebuild argv from scratch and append this too —
+    # one protocol per table.
+    timing_args = (["--timing", timing]
+                   if timing and timing != "dispatch" else [])
+    common = common + timing_args
     base = common + (["--num-devices", str(num_devices)] if num_devices else [])
 
     def run_prog(module, argv: list[str]) -> list[BenchmarkRecord]:
@@ -354,11 +357,7 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
         sweep_args = ["--sizes", str(size), "--dtype", dt,
                       "--iterations", str(iterations), "--warmup", str(warmup),
                       "--precision", precision, "--num-devices", "1"]
-        if timing and timing != "dispatch":
-            # the sweep rows must run the same protocol as the rest of the
-            # table — a dispatch row next to fused rows re-creates the
-            # mixed-protocol artifact --timing exists to prevent
-            sweep_args += ["--timing", timing]
+        sweep_args += timing_args
         for rec in run_prog(matmul_benchmark, sweep_args):
             results[f"single_{dt}"] = rec
 
@@ -387,8 +386,7 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
                            "--iterations", str(iterations),
                            "--warmup", str(warmup),
                            "--precision", "highest", "--num-devices", "1"]
-            if timing and timing != "dispatch":
-                strict_args += ["--timing", timing]
+            strict_args += timing_args
             for rec in run_prog(matmul_benchmark, strict_args):
                 results["single_float32_strict"] = rec
 

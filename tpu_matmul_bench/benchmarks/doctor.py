@@ -75,6 +75,7 @@ def run_doctor(size: int, iterations: int, degraded_ratio: float,
           f"({report['init_s']}s)", flush=True)
 
     from tpu_matmul_bench.utils.timing import (
+        _measure_sync_overhead,
         sync,
         time_fused,
         time_jitted,
@@ -84,12 +85,9 @@ def run_doctor(size: int, iterations: int, degraded_ratio: float,
     with jax.default_device(devices[0]):
         probe = jnp.ones((8, 8), jnp.float32)
         sync(probe)  # materialize + first-call compile of the reducer
-        best = float("inf")
-        for _ in range(5):
-            t0 = time.perf_counter()
-            sync(probe)
-            best = min(best, time.perf_counter() - t0)
-        report["sync_roundtrip_ms"] = round(best * 1e3, 3)
+        # the same fixed-barrier-cost measurement every timed loop subtracts
+        report["sync_roundtrip_ms"] = round(
+            _measure_sync_overhead(probe, samples=5) * 1e3, 3)
         print(f"[doctor] sync round trip: {report['sync_roundtrip_ms']} ms",
               flush=True)
 
@@ -105,12 +103,17 @@ def run_doctor(size: int, iterations: int, degraded_ratio: float,
         got = mm(a, b)
         sync(got)
         report["first_matmul_s"] = round(time.perf_counter() - t0, 3)
-        corner = np.asarray(got[:8, :8], np.float64)
-        want = np.asarray(a[:8].astype(jnp.float32), np.float64) @ np.asarray(
-            b[:, :8].astype(jnp.float32), np.float64)
-        err = float(np.abs(corner - want).max() / (np.abs(want).max() or 1.0))
-        report["matmul_max_rel_err"] = round(err, 6)
-        if not np.isfinite(err) or err > 3e-2:
+        from tpu_matmul_bench.parallel.modes import (
+            corner_validation,
+            expected_corner,
+        )
+
+        verdict = corner_validation(got[:8, :8],
+                                    expected_corner(a, b, corner=8),
+                                    jnp.bfloat16)
+        err = verdict["validation_max_rel_err"]
+        report["matmul_max_rel_err"] = err
+        if verdict["validation"] != "ok":
             report["link"] = "numerics-failed"
             return report
         print(f"[doctor] matmul ok ({report['first_matmul_s']}s incl. "

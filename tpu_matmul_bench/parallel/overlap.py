@@ -633,7 +633,7 @@ def pallas_ring_mode(config: BenchConfig, mesh: Mesh, size: int,
         ring_allgather_matmul(mesh),
         "all_gather-then-matmul",
         {"kernel": "pallas ring RDMA all-gather matmul"}, benchmark,
-    fusable=False,
+        fusable=False,
     )
 
 
@@ -691,7 +691,7 @@ def pallas_ring_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
         "all_gather-then-matmul",
         {"kernel": "pallas HBM ring RDMA all-gather matmul",
          **_wres_extras(config, fn, size)}, benchmark,
-    fusable=False,
+        fusable=False,
     )
 
 
@@ -717,7 +717,7 @@ def pallas_ring_bidir_hbm_mode(config: BenchConfig, mesh: Mesh, size: int,
         {"kernel": "pallas bidirectional HBM ring RDMA all-gather matmul",
          **_wres_extras(config, fn, size)},
         benchmark,
-    fusable=False,
+        fusable=False,
     )
 
 

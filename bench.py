@@ -136,7 +136,15 @@ def _run_attempts(deadline: float,
     """Spawn/drain measurement attempts until `deadline`. `outputs` and
     `procs` (when given) are shared with the caller so its grace drain can
     keep collecting after the deadline."""
-    tmpdir = tempfile.mkdtemp(prefix="bench_")
+    # BENCH_ARTIFACT_DIR: keep the attempts' raw JSONLs (artifact-hygiene:
+    # the driver-captured headline should have files under measurements/);
+    # default stays a tmpdir so ad-hoc runs don't litter the repo
+    artifact_dir = os.environ.get("BENCH_ARTIFACT_DIR")
+    if artifact_dir:
+        os.makedirs(artifact_dir, exist_ok=True)
+        tmpdir = artifact_dir
+    else:
+        tmpdir = tempfile.mkdtemp(prefix="bench_")
     outputs = [] if outputs is None else outputs
     procs = [] if procs is None else procs
 

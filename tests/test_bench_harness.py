@@ -344,3 +344,25 @@ def test_slow_state_does_not_carry_stale_rc(monkeypatch, capfd):
     rec = lines[-1]
     assert rec["backend"] == "slow"
     assert "last_rc" not in rec
+
+
+def test_artifact_dir_keeps_attempt_jsonls(tmp_path):
+    # BENCH_ARTIFACT_DIR: the attempts' raw JSONLs land there (provenance
+    # for the driver-captured headline) instead of a discarded tmpdir
+    import os
+
+    adir = tmp_path / "bench_artifacts"
+    fake = json.dumps(["python3", "-c",
+                       "import sys; open(sys.argv[1], 'w').write("
+                       "'{\"tflops_per_device\": 123.0}\\n')", "{out}"])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env={**os.environ, "BENCH_TIMEOUT_S": "90",
+             "BENCH_ARTIFACT_DIR": str(adir),
+             "BENCH_CHILD_CMD": fake},
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+    )
+    rec = json.loads([l for l in out.stdout.splitlines() if l.strip()][-1])
+    assert rec["value"] == 123.0
+    files = list(adir.glob("attempt_*.jsonl"))
+    assert files, list(adir.iterdir())

@@ -1,0 +1,184 @@
+#!/bin/bash
+# Round-4 priority-retry measurement driver — REPLACES measure_r4c.sh.
+#
+# The one-shot sequential playbooks had a flaw on a flaky tunnel: a step
+# that wedges is consumed, so a later healthy window goes to whatever
+# lower-value step happens to be next. This driver instead keeps a
+# priority-ordered step list and ALWAYS re-attempts the highest-value
+# unfinished step first: whenever the tunnel heals, the most valuable
+# missing artifact is the one that runs. A step is done when its command
+# exits 0; each step gets at most $MAX_ATTEMPTS tries (a step that fails
+# repeatedly on a HEALTHY backend is broken, not blocked, and must not
+# starve the rest).
+#
+# Usage: bash scripts/measure_r4d.sh > /tmp/measure_r4d.log 2>&1
+
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p measurements/r4
+R4=measurements/r4
+ITERS=20
+MAX_ATTEMPTS=8
+STATE=/tmp/measure_r4d_state
+mkdir -p "$STATE"
+
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+log() { echo; echo "=== [$(date +%H:%M:%S)] $*"; }
+
+log "waiting for any orphaned playbook step to exit"
+while pgrep -f "python -m tpu_matmul_bench" > /dev/null 2>&1; do
+  sleep 30
+done
+log "backend is free — starting priority loop"
+
+# step <id> <cmd...>: run unless already done; mark done on rc==0.
+# Returns 0 if the step is (now) done, 1 if it failed this attempt.
+step() {
+  local id="$1"; shift
+  [ -e "$STATE/$id.done" ] && return 0
+  local n=0
+  [ -e "$STATE/$id.attempts" ] && n=$(cat "$STATE/$id.attempts")
+  if [ "$n" -ge "$MAX_ATTEMPTS" ]; then
+    return 0  # give up on this step; don't starve the rest
+  fi
+  echo $((n + 1)) > "$STATE/$id.attempts"
+  log "[$id] attempt $((n + 1)): $*"
+  if "$@"; then
+    touch "$STATE/$id.done"
+    log "[$id] DONE"
+    return 0
+  fi
+  log "[$id] failed (attempt $((n + 1))/$MAX_ATTEMPTS)"
+  return 1
+}
+
+# One pass over the priority list; abort the pass on first failure so the
+# next pass starts again from the top (= highest-value unfinished step).
+pass() {
+  step headline_fused_pallas \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+      --num-devices 1 --timing fused --matmul-impl pallas \
+      --json-out $R4/headline_fused_pallas.jsonl || return 1
+  step headline_fused_xla \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+      --num-devices 1 --timing fused --matmul-impl xla \
+      --json-out $R4/headline_fused_xla.jsonl || return 1
+  step headline_fused_int8_pallas \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 16384 --dtype int8 --iterations 50 --warmup 10 \
+      --num-devices 1 --timing fused --matmul-impl pallas \
+      --json-out $R4/headline_fused_int8_pallas.jsonl || return 1
+  step headline_fused_int8_xla \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 16384 --dtype int8 --iterations 50 --warmup 10 \
+      --num-devices 1 --timing fused --matmul-impl xla \
+      --json-out $R4/headline_fused_int8_xla.jsonl || return 1
+  step headline_dispatch_rerun \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+      --num-devices 1 --matmul-impl pallas \
+      --json-out $R4/headline_pallas_rerun.jsonl || return 1
+  step int8_8k_winner_fused \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 8192 --dtype int8 --iterations 50 --warmup 10 \
+      --num-devices 1 --timing fused --matmul-impl pallas \
+      --json-out $R4/int8_8k_winner_fused.jsonl || return 1
+  step int8_8k_xla_fused \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 8192 --dtype int8 --iterations 50 --warmup 10 \
+      --num-devices 1 --timing fused --matmul-impl xla \
+      --json-out $R4/int8_8k_xla_fused.jsonl || return 1
+  step compare_16k_fused \
+    python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
+      --size 16384 --iterations $ITERS --warmup 5 --isolate \
+      --mode-timeout 900 --timing fused \
+      --json-out $R4/compare_r4_16k_fused.jsonl \
+      --markdown-out $R4/compare_r4_16k_fused.md || return 1
+  step fused_sweep_pallas \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 4096 8192 --dtype bfloat16 --iterations 50 --warmup 10 \
+      --num-devices 1 --timing fused --matmul-impl pallas \
+      --json-out $R4/fused_sweep_pallas.jsonl || return 1
+  step fused_sweep_xla \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 4096 8192 --dtype bfloat16 --iterations 50 --warmup 10 \
+      --num-devices 1 --timing fused --matmul-impl xla \
+      --json-out $R4/fused_sweep_xla.jsonl || return 1
+  step tune_int8_4k \
+    python -m tpu_matmul_bench tune --sizes 4096 --dtype int8 \
+      --iterations $ITERS --timing fused \
+      --candidates 2048,4096,512 2048,4096,1024 4096,2048,512 4096,2048,1024 1024,4096,512 4096,4096,512 2048,2048,1024 2048,2048,512 1024,2048,1024 2048,2048,2048 1024,1024,2048 \
+      --json-out $R4/tune_int8_4k.jsonl || return 1
+  step tune_int8_16k \
+    python -m tpu_matmul_bench tune --sizes 16384 --dtype int8 \
+      --iterations $ITERS --timing fused \
+      --candidates 2048,2048,1024 2048,4096,512 2048,4096,1024 4096,2048,1024 1024,1024,2048 \
+      --json-out $R4/tune_int8_16k.jsonl || return 1
+  step tune_int8_chunk \
+    python -m tpu_matmul_bench tune --mkn 2048 16384 2048 --dtype int8 \
+      --iterations $ITERS --timing fused \
+      --candidates 2048,2048,1024 1024,2048,512 2048,2048,512 1024,1024,512 2048,1024,1024 \
+      --json-out $R4/tune_int8_chunk.jsonl || return 1
+  local mode
+  for mode in pallas_ring_hbm pallas_ring_rs_hbm pallas_ring_bidir_hbm \
+              pallas_ring_bidir_rs_hbm; do
+    step ring16k_$mode \
+      python -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \
+        --sizes 16384 --dtype bfloat16 --iterations $ITERS --warmup 5 \
+        --num-devices 1 --mode $mode --validate \
+        --json-out $R4/ring16k_$mode.jsonl || return 1
+  done
+  step tune_ring_hbm_16k \
+    python -m tpu_matmul_bench tune --ring pallas_ring_hbm --sizes 16384 \
+      --dtype bfloat16 --iterations $ITERS --num-devices 1 --validate \
+      --candidates 4096,2048,512 2048,2048,512 2048,4096,512 2048,2048,1024 1024,2048,512 \
+      --json-out $R4/tune_ring_hbm_16k.jsonl || return 1
+  step pallas_ring_cap \
+    python -m tpu_matmul_bench.benchmarks.matmul_overlap_benchmark \
+      --sizes 2176 --dtype bfloat16 --iterations 200 --warmup 20 \
+      --num-devices 1 --mode pallas_ring --validate \
+      --json-out $R4/pallas_ring_cap.jsonl || return 1
+  step membw \
+    python -m tpu_matmul_bench membw --sizes 8192 16384 --dtype bfloat16 \
+      --iterations 50 --warmup 5 --timing fused \
+      --json-out $R4/membw.jsonl || return 1
+  step tune_fp32_strict \
+    python -m tpu_matmul_bench tune --sizes 4096 16384 --dtype float32 \
+      --precision highest --iterations $ITERS --timing fused \
+      --candidates 1024,1024,512 512,1024,512 1024,2048,512 2048,1024,512 512,512,512 \
+      --json-out $R4/tune_fp32_strict.jsonl || return 1
+  step compare_8k_fused \
+    python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
+      --size 8192 --iterations $ITERS --warmup 5 --isolate \
+      --mode-timeout 900 --timing fused \
+      --json-out $R4/compare_r4_8k.jsonl \
+      --markdown-out $R4/compare_r4_8k.md || return 1
+  step tune_rect_mlp \
+    python -m tpu_matmul_bench tune --mkn 8192 4096 28672 --dtype bfloat16 \
+      --iterations $ITERS --timing fused \
+      --candidates 4096,2048,512 2048,4096,512 1024,4096,512 2048,2048,512 4096,4096,512 1024,2048,512 \
+      --json-out $R4/tune_rect_mlp.jsonl || return 1
+  step tune_rect_tallm \
+    python -m tpu_matmul_bench tune --mkn 28672 4096 8192 --dtype bfloat16 \
+      --iterations $ITERS --timing fused \
+      --candidates 4096,2048,512 2048,2048,512 1024,2048,512 2048,4096,512 4096,1024,512 \
+      --json-out $R4/tune_rect_tallm.jsonl || return 1
+  return 0
+}
+
+while true; do
+  if pass; then
+    log "R4D ALL DONE (or attempt caps reached)"
+    break
+  fi
+  # a step failed — the tunnel is (probably) dead; pause briefly, then
+  # restart the pass from the top so the next healthy window goes to the
+  # highest-value missing artifact. No hot loop: a dead-tunnel failure
+  # itself takes ~25 min.
+  sleep 60
+done

@@ -303,3 +303,55 @@ def test_async_pairs_bracket_matmul_when_backend_emits_them(scan_hlo):
     assert any(any(f" {op}(" in l for op in MATMUL_OPS)
                for l in lines[start + 1:done]), (
         "no matmul scheduled between all-reduce-start and -done")
+
+
+class TestFusedWrapperPreservesSchedule:
+    """--timing fused wraps the timed program in an outer scan
+    (utils/timing.fuse_iterations); the measurement is only honest if the
+    wrapper leaves the inner step's scheduling properties intact — the
+    serialized baseline must stay serialized and the overlap path must
+    stay overlappable inside the fused loop."""
+
+    @pytest.fixture(scope="class")
+    def fused_hlo(self, mesh):
+        from tpu_matmul_bench.utils.timing import fuse_iterations
+
+        cfg = _cfg()
+        out = {}
+        for variant in ("no_overlap", "overlap"):
+            setup = overlap_mode(cfg, mesh, SIZE, variant)
+            fused = fuse_iterations(setup.full, 3)
+            out[variant] = compiled_text(fused, *setup.operands)
+        return out
+
+    @staticmethod
+    def _all_scan_bodies(txt):
+        """All while-bodies holding an all-reduce: the fused program has
+        TWO (the inlined first call's inner scan + the outer loop's), and
+        the scheduling property must hold in each."""
+        comps = parse_hlo(txt)
+        bodies = find_computations_with(comps, "all-reduce")
+        assert bodies, "no all-reduce in compiled program"
+        return comps, bodies
+
+    def test_fused_no_overlap_stays_serialized(self, fused_hlo):
+        comps, bodies = self._all_scan_bodies(fused_hlo["no_overlap"])
+        for body in bodies:
+            (ar,) = instructions_of(body, "all-reduce")
+            assert reaches_opcode(comps, body, ar, MATMUL_OPS), (
+                f"{body.name}: fused wrapper broke the "
+                "forced-serialization baseline")
+
+    def test_fused_overlap_stays_overlappable(self, fused_hlo):
+        comps, bodies = self._all_scan_bodies(fused_hlo["overlap"])
+        for body in bodies:
+            (ar,) = instructions_of(body, "all-reduce")
+            dots = instructions_of(body, *MATMUL_OPS)
+            assert dots, f"{body.name}: matmul missing (hoisted?)"
+            assert not reaches_opcode(comps, body, ar, MATMUL_OPS), (
+                f"{body.name}: fused wrapper serialized the overlap path")
+            for dot in dots:
+                assert not reaches_opcode(comps, body, dot,
+                                          ("all-reduce",)), (
+                    f"{body.name}: fused wrapper serialized the "
+                    "overlap path")

@@ -264,3 +264,36 @@ def test_markdown_silent_on_dispatch(tmp_path):
          "--markdown-out", str(md)]
     )
     assert "timing protocol" not in md.read_text()
+
+
+def test_isolate_aborts_on_probe_failure(monkeypatch, capsys):
+    # a dead backend must abort the table (rc 3) instead of burning every
+    # row's mode-timeout to produce an empty table
+    monkeypatch.setattr(compare_benchmarks, "_probe_backend",
+                        lambda t: (None, 0))
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit) as e:
+        compare_benchmarks.main(
+            ["--size", "64", "--iterations", "1", "--warmup", "1",
+             "--isolate", "--mode-timeout", "30"])
+    assert e.value.code == 3
+
+
+def test_zero_rows_exits_nonzero(monkeypatch, tmp_path):
+    # an all-rows-skipped run is a failure, not a result (scripts keying
+    # on rc must not mark it done); artifacts are still written
+    import pytest as _pytest
+
+    monkeypatch.setattr(compare_benchmarks, "_run_isolated",
+                        lambda *a, **k: [])
+    monkeypatch.setattr(compare_benchmarks, "_probe_backend",
+                        lambda t: ("cpu", 1))
+    md = tmp_path / "empty.md"
+    with _pytest.raises(SystemExit) as e:
+        compare_benchmarks.main(
+            ["--size", "64", "--iterations", "1", "--warmup", "1",
+             "--isolate", "--only", "single",
+             "--markdown-out", str(md)])
+    assert e.value.code == 4
+    assert md.exists()

@@ -103,7 +103,7 @@ def _run_isolated(module_name: str, argv: list[str],
             pass
 
 
-def _probe_backend(timeout_s: float) -> tuple[str, int]:
+def _probe_backend(timeout_s: float) -> tuple[str | None, int]:
     """Child-process probe of (backend, device_count) so the --isolate
     parent never initializes the backend itself — on exclusive-ownership
     runtimes a parent-held device would fail every child's init, and on a
@@ -126,10 +126,9 @@ def _probe_backend(timeout_s: float) -> tuple[str, int]:
                 _, backend, n = line.split()
                 return backend, int(n)
         raise ValueError(f"no probe line in {out.stdout!r}")
-    except Exception:  # noqa: BLE001 — probe is best-effort
-        report("[compare] backend probe failed or timed out — "
-               "assuming 1 device")
-        return "unknown", 1
+    except Exception:  # noqa: BLE001 — probe failure is a signal
+        report("[compare] backend probe failed or timed out")
+        return None, 0
 
 
 # every row key compare() can produce — the valid --only vocabulary
@@ -211,6 +210,14 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
                        or bool(only & {"hybrid", "summa", "pallas_ring"}))
         if needs_probe:
             backend, probed_n = _probe_backend(min(120.0, mode_timeout))
+            if backend is None:
+                # the backend cannot even init inside the probe window:
+                # every row would burn its full mode-timeout to produce an
+                # empty table (24 rows × 900 s = hours of nothing on a
+                # dead tunnel). Fail fast and scriptably instead.
+                report("[compare] backend probe failed — refusing to "
+                       "start a table no row of which can run (rc 3)")
+                raise SystemExit(3)
         else:
             backend, probed_n = "unknown", 1
         world = num_devices or probed_n
@@ -592,6 +599,12 @@ def _finish(args, results: dict[str, BenchmarkRecord]):
             for name, rec in results.items():
                 fh.write(json.dumps({"comparison_key": name,
                                      **json.loads(rec.to_json())}) + "\n")
+    if not results:
+        # a table with zero measured rows is a failed run, not a result —
+        # scripts keying on the exit code (measure_r4d.sh) must not mark
+        # it done. Artifacts above are still written for debugging.
+        report("[compare] no rows measured — exiting 4")
+        raise SystemExit(4)
     return results
 
 

@@ -19,7 +19,9 @@ mkdir -p measurements/r4
 R4=measurements/r4
 ITERS=20
 MAX_ATTEMPTS=8
-STATE=/tmp/measure_r4d_state
+# State lives in the repo (untracked, see .gitignore): /tmp is wiped on
+# container reboot, which previously reset every step to not-done.
+STATE=measurements/r4/.state
 mkdir -p "$STATE"
 
 export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache

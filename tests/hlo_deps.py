@@ -146,3 +146,19 @@ def reaches_opcode(comps: dict[str, Computation], comp: Computation,
 def compiled_text(fn, *operands) -> str:
     """Optimized (post-XLA-passes) HLO of a jitted fn on these operands."""
     return fn.lower(*operands).compile().as_text()
+
+
+_RESULT_SHAPE = re.compile(r"=\s*\(?[a-z]\w*\[([\d,]*)\]")
+
+
+def result_elems(line: str) -> int:
+    """Element count of an instruction's (first) result shape; 0 if the
+    line carries no parseable array shape. `f32[]` (scalar) counts as 1."""
+    m = _RESULT_SHAPE.search(line)
+    if not m:
+        return 0
+    n = 1
+    for d in m.group(1).split(","):
+        if d:
+            n *= int(d)
+    return n

@@ -27,6 +27,7 @@ from hlo_deps import (
     instructions_of,
     parse_hlo,
     reaches_opcode,
+    result_elems as _result_elems,
 )
 from tpu_matmul_bench.parallel.overlap import (
     collective_matmul_bidir_program,
@@ -326,12 +327,23 @@ class TestFusedWrapperPreservesSchedule:
 
     @staticmethod
     def _all_scan_bodies(txt):
-        """All while-bodies holding an all-reduce: the fused program has
-        TWO (the inlined first call's inner scan + the outer loop's), and
-        the scheduling property must hold in each."""
+        """All while-bodies holding a MODE all-reduce: the fused program
+        has several (the inlined first call's inner scan + the outer
+        loop's). The outer body additionally carries the operand chain's
+        own cross-shard combine — a ONE-element all-reduce the SPMD
+        partitioner emits for the [0..0] patch read/write
+        (utils/timing.fuse_iterations) — which has no scheduling property
+        to check. Bodies are therefore filtered to those with a
+        multi-element all-reduce; a hoist regression is still caught
+        because the mode step's full-size all-reduce always stays in its
+        body and is never excluded."""
         comps = parse_hlo(txt)
-        bodies = find_computations_with(comps, "all-reduce")
-        assert bodies, "no all-reduce in compiled program"
+        bodies = [
+            b for b in find_computations_with(comps, "all-reduce")
+            if any(_result_elems(i.line) > 1
+                   for i in instructions_of(b, "all-reduce"))
+        ]
+        assert bodies, "no mode all-reduce in compiled program"
         return comps, bodies
 
     def test_fused_no_overlap_stays_serialized(self, fused_hlo):

@@ -167,22 +167,91 @@ def fuse_iterations(
     timing reads off the stream (reference `matmul_benchmark.py:54-68`:
     events on a deep queue exclude host dispatch).
 
-    Each `lax.scan` step re-derives `fn`'s inputs through
-    `lax.optimization_barrier((args, prev_out))`: the barrier is opaque to
-    XLA, so the step's call consumes a value data-dependent on the previous
-    step's output — the calls execute sequentially, the loop-invariant
-    operands cannot be hoisted, and CSE cannot collapse the steps — while
-    the actual operand values stay bit-identical to the originals.
+    Chaining (the part that makes the measurement honest): each scan step
+    derives a bounded scalar from the previous step's output and writes it
+    into element [0, ..., 0] of every array operand — a one-element
+    `dynamic_update_slice` on the loop carry, updated in place by XLA, so
+    the cost is unmeasurable. The next call's operands are then *genuinely*
+    data-dependent on the previous output: the op cannot be hoisted out of
+    the loop (LICM) and the steps cannot be CSE-collapsed, so the
+    `iterations` applications execute back-to-back on device.
+
+    An `optimization_barrier` alone does NOT achieve this — barrier outputs
+    are tied operand-wise to their own inputs, so `barrier((args, prev))[0]`
+    is still loop-invariant, and the real-TPU toolchain hoisted the matmul
+    out of the scan, leaving a loop of output copies (observed on v5e:
+    2613 "TFLOPS" at 16k bf16, 13x the chip's peak — measurements/r4/
+    README.md). The barrier is kept for its intra-step scheduling property
+    (mode programs' leg ordering survives the wrapper; tests/
+    test_hlo_schedule.py), but the serialization guarantee comes from the
+    data dependence. Consequence: operand element [0,...,0] is NOT
+    bit-identical across iterations; timed loops never check values —
+    validation always runs the unfused program.
     """
     if iterations < 1:
         raise ValueError(f"iterations must be >= 1, got {iterations}")
+
+    # XLA's CPU emitter miscompiles an integer dot whose operand is
+    # genuinely loop-variant (invalid `add i32, i8` IR — any in-loop
+    # update of an s8 dot operand trips it, DUS or select alike), so on
+    # the CPU backend integer leaves are left unchained. CPU is the test
+    # mesh, where fused programs are checked for correctness, not timed;
+    # on TPU — the only backend whose timing matters — every leaf is
+    # chained (hardware-verified: the chained s8 dot compiles and runs at
+    # the same 23.4 ms/op the dispatch protocol measures).
+    _mix_int = jax.default_backend() != "cpu"
+
+    def _mixable(leaf: Any) -> bool:
+        return (
+            hasattr(leaf, "dtype")
+            and getattr(leaf, "ndim", 0) >= 1
+            and leaf.size >= 2
+            and (jnp.issubdtype(leaf.dtype, jnp.inexact)
+                 or (_mix_int and jnp.issubdtype(leaf.dtype, jnp.integer)))
+        )
+
+    def _chain(ops: Any, prev: Any) -> tuple[Any, bool]:
+        src = next(
+            (x for x in jax.tree_util.tree_leaves(prev) if _mixable(x)), None
+        )
+        if src is None:  # no array output to chain on
+            return ops, False
+        # A one-element SLICE, not a scalar: a replicated scalar read of a
+        # sharded output forces a full broadcast per iteration. The slice
+        # form is free on one device; under SPMD the partitioner still
+        # emits a ONE-element masked combine per step for the cross-shard
+        # read/write (visible as a 1-element all-reduce in the fused loop
+        # body — tests/test_hlo_schedule.py filters it), a latency-bound
+        # ~µs cost that is negligible against multi-ms mode steps but
+        # biases per-op numbers for very fast sharded ops; dispatch-protocol
+        # on a healthy link is the cross-check there.
+        patch = lax.slice(src, (0,) * src.ndim, (1,) * src.ndim)
+        pf = patch.astype(jnp.float32)
+        bounded = jnp.where(jnp.isfinite(pf), jnp.clip(pf, 0.0, 1.0), 0.5)
+
+        def mix(leaf):
+            if not _mixable(leaf):
+                return leaf
+            upd = lax.convert_element_type(
+                lax.reshape(bounded, (1,) * leaf.ndim), leaf.dtype
+            )
+            return lax.dynamic_update_slice(leaf, upd, (0,) * leaf.ndim)
+
+        return jax.tree_util.tree_map(mix, ops), True
 
     def fused(*args: Any) -> Any:
         out = fn(*args)
 
         def body(carry, _):
             ops, prev = carry
-            chained, _prev = lax.optimization_barrier((ops, prev))
+            chained, prev_b = lax.optimization_barrier((ops, prev))
+            mixed, did_mix = _chain(chained, prev_b)
+            if did_mix:
+                return (mixed, fn(*mixed)), None
+            # Nothing chainable (e.g. integer-only operands on the CPU
+            # test backend): keep the original operands as carry — the
+            # pre-chain structure, correct but hoist-prone; acceptable
+            # only where timing fidelity is not the point.
             return (ops, fn(*chained)), None
 
         (_, out), _ = lax.scan(body, (args, out), None,

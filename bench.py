@@ -11,7 +11,9 @@ Runs on the real TPU chip. Takes the best of three attempts (tuned Pallas
 kernel first — the measured winner, RESULTS_TPU.md — then XLA, then Pallas
 again; the first run eats session warm-up and the chip shows ~1%
 run-to-run variance). Attempts use `--timing fused` (all 50 iterations
-inside ONE compiled program, chained with optimization_barrier): the
+inside ONE compiled program, serialized by a per-step operand-element
+chain — utils/timing.fuse_iterations; records above the chip's physical
+ceiling are rejected as protocol artifacts, see MAX_PLAUSIBLE_TFLOPS): the
 dispatch-loop protocol measures the host enqueue rate whenever the axon
 tunnel's per-RPC latency exceeds the op's ~45 ms device time (observed
 2026-07-31: 121 and 50 "TFLOPS" minutes apart on a healthy chip), while
@@ -49,6 +51,11 @@ import tempfile
 import time
 
 BASELINE_TFLOPS = 140.0  # reference README.md:43 — 1× RTX 6000 Ada, bf16 16k
+
+# v5e bf16 peak is ~197 TFLOPS/chip; no real measurement exceeds it. A
+# record above this is a broken protocol (r4: a hoisted fused loop timed
+# output copies at 2613 "TFLOPS"), and must never reach the driver.
+MAX_PLAUSIBLE_TFLOPS = 220.0
 
 ATTEMPTS = ("pallas", "xla", "pallas")
 SOFT_DEADLINE_S = 900.0   # per attempt; healthy runs finish in ~4 min
@@ -124,9 +131,15 @@ def _collect(outputs: list[str]) -> list[float]:
         for line in lines:
             try:
                 rec = json.loads(line)
-                vals.append(float(rec["tflops_per_device"]))
+                v = float(rec["tflops_per_device"])
             except (ValueError, KeyError, TypeError):
                 continue
+            if v > MAX_PLAUSIBLE_TFLOPS:
+                print(f"[bench] rejecting implausible {v:.1f} TFLOPS "
+                      f"(> {MAX_PLAUSIBLE_TFLOPS} ceiling) from {path}",
+                      file=sys.stderr, flush=True)
+                continue
+            vals.append(v)
     return vals
 
 

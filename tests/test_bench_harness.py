@@ -34,6 +34,19 @@ def test_collect_reads_only_valid_attempts(tmp_path):
     assert vals == [194.1]
 
 
+def test_collect_rejects_implausible_tflops(tmp_path):
+    # r4 hoist bug: a mis-chained fused loop let XLA hoist the matmul and
+    # the record read 2613 "TFLOPS" (13x the v5e peak). A value above the
+    # physical ceiling is a broken protocol, not a measurement, and must
+    # never become the driver's headline.
+    bench = _load_bench()
+    f = tmp_path / "a.jsonl"
+    f.write_text(
+        json.dumps({"mode": "single", "tflops_per_device": 2613.3}) + "\n"
+        + json.dumps({"mode": "single", "tflops_per_device": 194.7}) + "\n")
+    assert bench._collect([str(f)]) == [194.7]
+
+
 def test_emit_schema(capfd):  # capfd: _emit writes the raw fd atomically
     bench = _load_bench()
     bench._best = 194.41

@@ -77,11 +77,18 @@ def test_tuned_blocks_table():
                         jnp.float32) == (1024, 1024, 512)
     assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
                         jnp.float16) == (4096, 2048, 512)
+    # r4 re-sweep winner (beats XLA at 4k): measurements/r4/tune_int8_4k.jsonl
     assert tuned_blocks(4096, 4096, 4096, "TPU v5 lite",
-                        jnp.int8) == (2048, 2048, 1024)
-    # r4 re-sweep winner (deeper-K grid): measurements/r4/tune_int8_8k.jsonl
+                        jnp.int8) == (1024, 2048, 1024)
+    # r4 deep-K grid winner: measurements/r4/tune_int8_8k_deep.jsonl
     assert tuned_blocks(8192, 8192, 8192, "TPU v5 lite",
-                        jnp.int8) == (1024, 1024, 2048)
+                        jnp.int8) == (2048, 1024, 2048)
+    # r4 rect rows (tuned_blocks takes m, n, k): wide-N MLP 8192×4096×28672
+    # and its tall-M dual — measurements/r4/tune_rect_{mlp,tallm}.jsonl
+    assert tuned_blocks(8192, 28672, 4096, "TPU v5 lite") == (2048, 4096, 512)
+    assert tuned_blocks(28672, 8192, 4096, "TPU v5 lite") == (4096, 1024, 512)
+    # near-square problems must NOT trigger the aspect rows
+    assert tuned_blocks(8192, 16384, 8192, "TPU v5 lite") == (2048, 2048, 512)
     assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
                         jnp.int8) == (2048, 2048, 1024)
 

@@ -372,7 +372,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                         results = _confirm_top(
                             results, args.confirm_top, config, wl,
                             max(m, k, n), (a, b), label, info, jw,
-                            records)
+                            records, shape=label if rect else None)
                 (bm, bn, bk), best = results[0]
                 report(f"\n[{label}] BEST: --block-m {bm} --block-n {bn} "
                        f"--block-k {bk}  ({best:.2f} "
@@ -381,7 +381,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
 
 
 def _confirm_top(results, top_n, config, wl, size, operands, label, info,
-                 jw, records):
+                 jw, records, shape=None):
     """Interleaved confirm pass over the sweep's finalists: the sweep
     times candidates back-to-back, so drift (clock ramps, link health)
     between measurements can re-order close candidates; re-measuring the
@@ -408,14 +408,20 @@ def _confirm_top(results, top_n, config, wl, size, operands, label, info,
         confirmed.append((eff, tflops))
         report(f"  {eff}: {tflops:.2f} {unit} confirmed "
                f"(sweep said {sweep_tflops:.2f})")
+        extras = {"block_m": eff[0], "block_n": eff[1], "block_k": eff[2],
+                  "confirm_pass": True,
+                  **protocol_extras(config.timing, t)}
+        if shape is not None:  # rect sweep: keep the MxKxN provenance
+            # (the r4 rect confirm records read as "28672²" without it)
+            extras["shape"] = shape
+        if config.precision != "default":
+            extras["precision"] = config.precision
         rec = BenchmarkRecord(
             benchmark="tune", mode="pallas_tune", size=size,
             dtype=config.dtype_name, world=1, iterations=t.iterations,
             warmup=1, avg_time_s=t.avg_s, tflops_per_device=tflops,
             tflops_total=tflops, device_kind=info.device_kind,
-            extras={"block_m": eff[0], "block_n": eff[1], "block_k": eff[2],
-                    "confirm_pass": True,
-                    **protocol_extras(config.timing, t)},
+            extras=extras,
         ).finalize()
         records.append(rec)
         jw.write(rec)

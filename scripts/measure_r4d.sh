@@ -174,7 +174,10 @@ pass() {
 }
 
 while true; do
-  if pass; then
+  # Completion needs TWO consecutive clean walks: done-markers can be
+  # cleared mid-pass (e.g. a timing fix invalidated stale artifacts), and
+  # a single walk would skip steps it already visited this invocation.
+  if pass && pass; then
     log "R4D ALL DONE (or attempt caps reached)"
     break
   fi

@@ -77,7 +77,7 @@ def test_tuned_blocks_table():
                         jnp.float32) == (1024, 1024, 512)
     assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
                         jnp.float16) == (4096, 2048, 512)
-    # r4 re-sweep winner (beats XLA at 4k): measurements/r4/tune_int8_4k.jsonl
+    # r4 re-sweep winner: measurements/r4/tune_int8_4k.jsonl
     assert tuned_blocks(4096, 4096, 4096, "TPU v5 lite",
                         jnp.int8) == (1024, 2048, 1024)
     # r4 deep-K grid winner: measurements/r4/tune_int8_8k_deep.jsonl

@@ -86,9 +86,11 @@ _V5E_ROWS: dict[str, list[tuple[int, tuple[int, int, int]]]] = {
     # 2048) — measurements/r4/tune_int8_8k_deep.jsonl; XLA's 382.0 still
     # leads 8k by 4.5%. 4k row re-swept in r4 (fused protocol,
     # 11-candidate grid + confirm pass): (1024, 2048, 1024) wins at
-    # 332.6/331.1 TOPS vs 294.1 for the old (2048, 2048, 1024) row — and
-    # beats XLA's 322.3 (r2), closing the 4k int8 gap —
-    # measurements/r4/tune_int8_4k.jsonl. 16k row reconfirmed r4: 374.8
+    # 332.6/331.1 TOPS vs 294.1 for the old (2048, 2048, 1024) row —
+    # measurements/r4/tune_int8_4k.jsonl. Honest framing: same-protocol
+    # XLA reads 372.25 at 4k (int8_4k_xla_fused.jsonl; r2's 322.3 was a
+    # dispatch artifact), so XLA leads int8 at 4k AND 8k; our kernel
+    # leads at 16k (376.0 vs 360.7). 16k row reconfirmed r4: 374.8
     # (measurements/r4/tune_int8_16k.jsonl).
     "int8": [
         (1024, (2048, 2048, 1024)),

@@ -89,8 +89,9 @@ def test_tuned_blocks_table():
     assert tuned_blocks(28672, 8192, 4096, "TPU v5 lite") == (4096, 1024, 512)
     # near-square problems must NOT trigger the aspect rows
     assert tuned_blocks(8192, 16384, 8192, "TPU v5 lite") == (2048, 2048, 512)
+    # r4: the 8k winner generalizes — measurements/r4/tune_int8_16k_b.jsonl
     assert tuned_blocks(16384, 16384, 16384, "TPU v5 lite",
-                        jnp.int8) == (2048, 2048, 1024)
+                        jnp.int8) == (2048, 1024, 2048)
 
 
 def test_fuzz_shapes_vs_xla():

@@ -90,13 +90,15 @@ _V5E_ROWS: dict[str, list[tuple[int, tuple[int, int, int]]]] = {
     # measurements/r4/tune_int8_4k.jsonl. Honest framing: same-protocol
     # XLA reads 372.25 at 4k (int8_4k_xla_fused.jsonl; r2's 322.3 was a
     # dispatch artifact), so XLA leads int8 at 4k AND 8k; our kernel
-    # leads at 16k (376.0 vs 360.7). 16k row reconfirmed r4: 374.8
-    # (measurements/r4/tune_int8_16k.jsonl).
+    # leads at 16k. 16k row: the 8k winner's shape generalizes —
+    # (2048, 1024, 2048) @ 385.0/379.8 interleaved-confirm vs 376.9/373.8
+    # for the old (2048, 2048, 1024) row (measurements/r4/
+    # tune_int8_16k_b.jsonl), extending the 16k lead over XLA's 360.7.
     "int8": [
         (1024, (2048, 2048, 1024)),
         (4096, (1024, 2048, 1024)),
         (8192, (2048, 1024, 2048)),
-        (16384, (2048, 2048, 1024)),
+        (16384, (2048, 1024, 2048)),
     ],
     # fp32 sweep (r2, 8k under --precision highest): (1024, 1024, 512)
     # wins at 32.4 TFLOPS (multi-pass MXU emulation, vs 31.4 for XLA);

@@ -1,0 +1,99 @@
+#!/bin/bash
+# Round-5 measurement playbook — freshness pass over the round-4 headline
+# set, priority-retry pattern (see measure_r4d.sh for the rationale: a
+# step is done on rc==0; every pass re-attempts the highest-value
+# unfinished step first, so any healthy window buys the most valuable
+# missing artifact).
+#
+# Round-4 left every VERDICT-r3 hardware item measured (RESULTS_TPU.md
+# "Round-4 measured set"); round 5's baseline need is freshness — confirm
+# the baked rows still hold on the current chip state — plus whatever the
+# r4 verdict flags. Add verdict-driven steps at the TOP of pass().
+#
+# Lessons baked in (measurements/r4, RESULTS_TPU.md):
+#  - fused + dispatch must agree to ~1% on a healthy link; a fused
+#    number above the chip peak (197 bf16 / 394 int8) is a protocol bug,
+#    not a measurement.
+#  - single uninterleaved runs drift +-1.5%; use `tune` with two
+#    candidates (interleaved confirm) for any row decision.
+#  - never kill a TPU client mid-RPC; let steps slow-fail.
+#
+# Usage: bash scripts/measure_r5.sh > /tmp/measure_r5.log 2>&1
+
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p measurements/r5
+R5=measurements/r5
+MAX_ATTEMPTS=8
+STATE=measurements/r5/.state
+mkdir -p "$STATE"
+
+export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+log() { echo; echo "=== [$(date +%H:%M:%S)] $*"; }
+
+log "waiting for any running benchmark step to exit"
+while pgrep -f "python -m tpu_matmul_bench" > /dev/null 2>&1; do
+  sleep 30
+done
+log "backend is free — starting priority loop"
+
+step() {
+  local id="$1"; shift
+  [ -e "$STATE/$id.done" ] && return 0
+  local n=0
+  [ -e "$STATE/$id.attempts" ] && n=$(cat "$STATE/$id.attempts")
+  if [ "$n" -ge "$MAX_ATTEMPTS" ]; then
+    return 0
+  fi
+  echo $((n + 1)) > "$STATE/$id.attempts"
+  log "[$id] attempt $((n + 1)): $*"
+  if "$@"; then
+    touch "$STATE/$id.done"
+    log "[$id] DONE"
+    return 0
+  fi
+  log "[$id] failed (attempt $((n + 1))/$MAX_ATTEMPTS)"
+  return 1
+}
+
+pass() {
+  # -- add round-5 verdict-driven steps here (highest value first) --
+  step headline_fused_pallas \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+      --num-devices 1 --timing fused --matmul-impl pallas \
+      --json-out $R5/headline_fused_pallas.jsonl || return 1
+  step headline_dispatch_pallas \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+      --num-devices 1 --matmul-impl pallas \
+      --json-out $R5/headline_dispatch_pallas.jsonl || return 1
+  step headline_fused_xla \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+      --num-devices 1 --timing fused --matmul-impl xla \
+      --json-out $R5/headline_fused_xla.jsonl || return 1
+  step int8_16k_rows_headtohead \
+    python -m tpu_matmul_bench tune --sizes 16384 --dtype int8 \
+      --iterations 50 --timing fused \
+      --candidates 2048,1024,2048 2048,2048,1024 \
+      --json-out $R5/int8_16k_headtohead.jsonl || return 1
+  step compare_16k_refresh \
+    python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
+      --size 16384 --iterations 20 --warmup 5 --isolate \
+      --mode-timeout 900 --timing fused \
+      --json-out $R5/compare_r5_16k.jsonl \
+      --markdown-out $R5/compare_r5_16k.md || return 1
+  return 0
+}
+
+while true; do
+  if pass && pass; then
+    log "R5 ALL DONE (or attempt caps reached)"
+    break
+  fi
+  sleep 60
+done

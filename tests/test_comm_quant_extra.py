@@ -1,0 +1,78 @@
+"""The comm_quant record field must flag the world-1 short-circuit.
+
+At world=1 the quantized collectives are exact no-ops (the d==1
+short-circuits in parallel/quantized.py, r3 advisor finding), so a
+single-device "quantized" record would otherwise read as an int8-wire
+measurement when nothing was quantized (the r4 16k/8k compares omit
+quantized rows for exactly this reason — RESULTS_TPU.md)."""
+
+import jax
+
+from tpu_matmul_bench.parallel.quantized import comm_quant_extra
+from tpu_matmul_bench.utils.config import parse_config
+
+
+def _cfg(extra=()):
+    return parse_config(
+        ["--sizes", "64", "--iterations", "1", "--warmup", "0",
+         "--comm-quant", "int8", *extra], "t", extra_dtypes=("int8",))
+
+
+def test_comm_quant_extra_flags_world_1():
+    cfg = _cfg()
+    assert comm_quant_extra(cfg, 1) == "int8 (inert at world=1)"
+    assert comm_quant_extra(cfg, 8) == "int8"
+
+
+def test_comm_quant_extra_flags_integer_operands():
+    # integer inputs → integer matmul outputs → the quantized collectives
+    # take the exact integer early-return at EVERY world size
+    cfg = _cfg(["--dtype", "int8"])
+    assert "inert" in comm_quant_extra(cfg, 8)
+    assert "integer" in comm_quant_extra(cfg, 8)
+
+
+def test_hybrid_degenerate_axis_flagged(devices):
+    # dp=8, tp=1: the tp gather short-circuits while the dp psum is
+    # genuinely quantized — the record must say which half is inert
+    from tpu_matmul_bench.parallel.hybrid import hybrid_mode, make_hybrid_mesh
+
+    m = make_hybrid_mesh(devices, dp=8)
+    rec = hybrid_mode(_cfg(), m, 64).build_record(_dummy_timing(), None, 0.0)
+    assert rec.extras["comm_quant"] == "int8 (gather inert at tp=1)"
+
+
+def test_matrix_parallel_world1_fallback_keeps_the_key(mesh):
+    # the d==1 fallback to independent() must still carry the flagged key
+    from tpu_matmul_bench.parallel.mesh import make_mesh
+    from tpu_matmul_bench.parallel.modes import (
+        matrix_parallel,
+        run_mode_benchmark,
+    )
+
+    mesh1 = make_mesh(jax.devices()[:1])
+    rec = run_mode_benchmark(matrix_parallel(_cfg(), mesh1, 64), _cfg())
+    assert rec.extras["comm_quant"] == "int8 (inert at world=1)"
+
+
+def _dummy_timing():
+    from tpu_matmul_bench.utils.timing import Timing
+
+    return Timing(total_s=0.01, iterations=1, sync_overhead_s=0.0,
+                  reliable=True)
+
+
+def test_world1_batch_parallel_record_carries_the_flag(mesh):
+    # end-to-end: a 1-device mesh run's record self-describes the no-op
+    from tpu_matmul_bench.parallel.mesh import make_mesh
+    from tpu_matmul_bench.parallel.modes import (
+        batch_parallel,
+        run_mode_benchmark,
+    )
+
+    mesh1 = make_mesh(jax.devices()[:1])
+    rec = run_mode_benchmark(batch_parallel(_cfg(), mesh1, 64), _cfg())
+    assert rec.extras["comm_quant"] == "int8 (inert at world=1)"
+
+    rec8 = run_mode_benchmark(batch_parallel(_cfg(), mesh, 64), _cfg())
+    assert rec8.extras["comm_quant"] == "int8"

@@ -30,6 +30,7 @@ from tpu_matmul_bench.parallel.modes import (
 )
 from tpu_matmul_bench.parallel.quantized import (
     allgather_impl,
+    comm_quant_extra,
     psum_impl,
     uses_quantized_comm,
 )
@@ -102,7 +103,17 @@ def hybrid_mode(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
         extras = {"dp": dp, "tp": tp, "global_batch": g,
                   "local_batch": local_batch}
         if uses_quantized_comm(config):
-            extras["comm_quant"] = config.comm_quant
+            label = comm_quant_extra(config, world)
+            if label == config.comm_quant:
+                # half-inert grids: a 1-extent axis short-circuits ITS
+                # collective (dp=1 → the psum, tp=1 → the gather) while
+                # the other is genuinely quantized; dp=tp=1 is the
+                # world=1 case comm_quant_extra already flags
+                if dp == 1:
+                    label += " (psum inert at dp=1)"
+                elif tp == 1:
+                    label += " (gather inert at tp=1)"
+            extras["comm_quant"] = label
         if g != batch:
             extras["note"] = f"global batch grown from {batch} to {g} to cover dp={dp}"
         return BenchmarkRecord(

@@ -31,6 +31,7 @@ from tpu_matmul_bench.ops.matmul import matmul_2d
 from tpu_matmul_bench.parallel.mesh import sharded_normal, smap as _smap, world_size
 from tpu_matmul_bench.parallel.quantized import (
     allgather_impl,
+    comm_quant_extra,
     psum_impl,
     uses_quantized_comm,
 )
@@ -336,7 +337,7 @@ def batch_parallel(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
         per_dev = calculate_tflops(size, total_s, num_ops=local_batch)
         extras = {"global_batch": g, "local_batch": local_batch}
         if uses_quantized_comm(config):
-            extras["comm_quant"] = config.comm_quant
+            extras["comm_quant"] = comm_quant_extra(config, d)
         if g != batch:
             extras["note"] = f"global batch grown from {batch} to {g} to cover {d} devices"
         return _record_base(
@@ -381,6 +382,19 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
     d = world_size(mesh)
     if d == 1:
         setup = independent(config, mesh, size, benchmark)
+        if uses_quantized_comm(config):
+            # the fallback's records must still carry the (flagged)
+            # comm_quant key, or world-1 matrix_parallel JSONL can't be
+            # filtered uniformly with the other quantizable modes
+            inner = setup.build_record
+
+            def build_flagged(t_c, t_f, comm_s):
+                rec = inner(t_c, t_f, comm_s)
+                rec.extras["comm_quant"] = comm_quant_extra(config, 1)
+                return rec
+
+            return dataclasses.replace(setup, mode="matrix_parallel",
+                                       build_record=build_flagged)
         return dataclasses.replace(setup, mode="matrix_parallel")
 
     # A replicated (≙ reference's per-rank identical A, :176), B column-sharded
@@ -407,7 +421,7 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
         per_dev = actual / d  # effective per-device (:233)
         extras = {"portion_per_device": f"1/{d} of B's columns"}
         if uses_quantized_comm(config):
-            extras["comm_quant"] = config.comm_quant
+            extras["comm_quant"] = comm_quant_extra(config, d)
         return _record_base(
             config, benchmark, "matrix_parallel", size, d, t_full or t_compute,
             avg_time_s=total_s,
@@ -459,7 +473,7 @@ def data_parallel(config: BenchConfig, mesh: Mesh, size: int,
         total_s = t_full.avg_s if t_full else t_compute.avg_s
         extras = {}
         if uses_quantized_comm(config):
-            extras["comm_quant"] = config.comm_quant
+            extras["comm_quant"] = comm_quant_extra(config, d)
         return _record_base(
             config, benchmark, "data_parallel", size, d, t_full or t_compute,
             avg_time_s=total_s,
@@ -530,7 +544,7 @@ def model_parallel(config: BenchConfig, mesh: Mesh, size: int,
         per_dev = actual / d
         extras = {"combine": "psum (reference used all_gather on partial sums)"}
         if uses_quantized_comm(config):
-            extras["comm_quant"] = config.comm_quant
+            extras["comm_quant"] = comm_quant_extra(config, d)
         return _record_base(
             config, benchmark, "model_parallel", size, d, t_full or t_compute,
             avg_time_s=total_s,

@@ -184,3 +184,17 @@ def psum_impl(comm_quant: str | None, varying_out: bool = False):
 
         return int8_varying
     raise ValueError(f"unknown comm quantization {comm_quant!r}")
+
+
+def comm_quant_extra(config, world: int) -> str:
+    """The `comm_quant` extras value for a record: when the quantized
+    collectives are exact no-ops the record must say so, or a "quantized"
+    record is indistinguishable from an int8-wire measurement. Two inert
+    cases: world=1 (the d==1 short-circuits below), and integer operand
+    dtypes at ANY world size (quantized_psum/quantized_all_gather take
+    the exact integer-collective early return — the matmul outputs the
+    collectives move are integer whenever the inputs are)."""
+    q = config.comm_quant
+    if jnp.issubdtype(jnp.dtype(config.dtype), jnp.integer):
+        return f"{q} (inert: integer operands take the exact collective)"
+    return f"{q} (inert at world=1)" if world <= 1 else q

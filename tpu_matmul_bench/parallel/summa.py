@@ -49,7 +49,11 @@ from tpu_matmul_bench.parallel.modes import (
     expected_corner,
     make_corner_validate,
 )
-from tpu_matmul_bench.parallel.quantized import psum_impl, uses_quantized_comm
+from tpu_matmul_bench.parallel.quantized import (
+    comm_quant_extra,
+    psum_impl,
+    uses_quantized_comm,
+)
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.metrics import (
     calculate_tflops,
@@ -169,7 +173,7 @@ def summa_mode(config: BenchConfig, mesh: Mesh, size: int,
         extras = {"grid": f"{r}x{c}", "k_panels": s,
                   "algorithm": "SUMMA (2-D grid, masked-psum broadcasts)"}
         if uses_quantized_comm(config):
-            extras["comm_quant"] = config.comm_quant
+            extras["comm_quant"] = comm_quant_extra(config, world)
         return BenchmarkRecord(
             benchmark=benchmark, mode="summa", size=size,
             dtype=config.dtype_name, world=world,

@@ -24,14 +24,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_launcher(args: list[str], env: dict, attempts: int = 2):
-    """Run the multihost launcher, retrying once on the known Gloo
-    transport race: under heavy host load jax's experimental CPU
-    collectives can drop a TCP pair mid-benchmark ('Connection closed by
-    peer'); both ranks then skip the size via the OOM backstop and exit 0
-    with no results block. The benchmark ends with a cluster exit barrier
+def _run_launcher(args: list[str], env: dict, attempts: int = 3):
+    """Run the multihost launcher, retrying on the known Gloo transport
+    race: under heavy host load jax's experimental CPU collectives can
+    drop a TCP pair mid-benchmark ('Connection closed by peer'); both
+    ranks then skip the size via the OOM backstop and exit 0 with no
+    results block. The benchmark ends with a cluster exit barrier
     (teardown-race fix); the remaining mid-run rendezvous race is
-    jax-internal and load-dependent, so the test retries once."""
+    jax-internal and load-dependent, so the test retries (two attempts
+    were observed insufficient when the full suite ran concurrently with
+    other work, 2026-07-31)."""
     for attempt in range(attempts):
         out = subprocess.run(
             args, cwd=str(WORKER.parent.parent), env=env, text=True,

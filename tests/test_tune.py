@@ -240,6 +240,55 @@ def test_tune_confirm_pass(tmp_path, capsys):
     assert len(confirm) == 2
 
 
+def test_tune_confirm_tie_note(capsys, monkeypatch):
+    # a sub-1% confirm margin is drift, not a decision (r4 lesson) — the
+    # ranking must say so before anyone bakes a table row from it
+    import tpu_matmul_bench.benchmarks.pallas_tune as pt
+    from tpu_matmul_bench.utils.config import parse_config
+    from tpu_matmul_bench.utils.reporting import JsonWriter
+    from tpu_matmul_bench.utils.timing import Timing
+
+    class _Wl:
+        flops = 2 * 64**3
+
+    cfg = parse_config(["--sizes", "64", "--iterations", "1",
+                        "--warmup", "0"], "t")
+    import jax.numpy as jnp
+
+    a = jnp.ones((64, 64), jnp.float32)
+
+    class _Info:
+        device_kind = "cpu"
+
+    def fake_times(margin_pct):
+        # two candidates whose avg_s differ by margin_pct
+        base = 1e-3
+        return [Timing(total_s=base, iterations=1, sync_overhead_s=0.0),
+                Timing(total_s=base * (1 + margin_pct / 100), iterations=1,
+                       sync_overhead_s=0.0)]
+
+    results = [((32, 32, 32), 100.0), ((64, 64, 64), 99.0)]
+    monkeypatch.setattr(pt, "time_variants_n",
+                        lambda *a, **k: fake_times(0.2))
+    recs: list = []
+    pt._confirm_top(list(results), 2, cfg, _Wl(), 64, (a, a), "64",
+                    _Info(), JsonWriter(None), recs)
+    assert "treat as a tie" in capsys.readouterr().out
+    # the tie flag lands on the STRUCTURED records (the channel tooling
+    # reads), not just stdout
+    flagged = [r for r in recs if "tie_margin_pct" in r.extras]
+    assert len(flagged) == 2
+    assert all(r.extras["tie_margin_pct"] < 1.0 for r in flagged)
+
+    monkeypatch.setattr(pt, "time_variants_n",
+                        lambda *a, **k: fake_times(5.0))
+    recs2: list = []
+    pt._confirm_top(list(results), 2, cfg, _Wl(), 64, (a, a), "64",
+                    _Info(), JsonWriter(None), recs2)
+    assert "treat as a tie" not in capsys.readouterr().out
+    assert not [r for r in recs2 if "tie_margin_pct" in r.extras]
+
+
 def test_tune_confirm_disabled(tmp_path, capsys):
     from tpu_matmul_bench.benchmarks.pallas_tune import main
 

@@ -1,0 +1,97 @@
+"""Property-based tests (hypothesis) for the pure-math invariants.
+
+The reference has no tests at all (SURVEY §4); the example-based suite
+pins behavior at chosen points, and these pin the INVARIANTS across the
+whole input space — the block-clamping contract every tuner/benchmark
+relies on, the quantization error bound the int8-wire collectives
+advertise, and the metrics identities the reports are built from."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis")  # optional test dep: skip cleanly where absent
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+from tpu_matmul_bench.ops.pallas_matmul import (
+    _pick_block,
+    effective_blocks,
+    vmem_bytes_estimate,
+)
+from tpu_matmul_bench.parallel.quantized import _QMAX, _dequantize, _quantize
+from tpu_matmul_bench.utils.metrics import (
+    calculate_tflops,
+    scaling_efficiency,
+)
+
+dims = st.integers(min_value=1, max_value=40000)
+prefs = st.sampled_from([32, 64, 128, 256, 512, 1024, 2048, 4096, 8192])
+
+
+@given(dim=dims, pref=prefs)
+def test_pick_block_contract(dim, pref):
+    b = _pick_block(dim, pref)
+    # the chosen block always divides the dim (grid covers it exactly)...
+    assert dim % b == 0
+    # ...and never exceeds the request unless nothing on the ladder fits
+    # (then the whole dim is one block)
+    assert b <= pref or b == dim
+
+
+@given(m=dims, n=dims, k=dims, bm=prefs, bn=prefs, bk=prefs)
+def test_effective_blocks_contract(m, n, k, bm, bn, bk):
+    ebm, ebn, ebk = effective_blocks(m, n, k, bm, bn, bk)
+    assert m % ebm == 0 and n % ebn == 0 and k % ebk == 0
+    # idempotent: re-requesting the effective blocks returns them
+    assert effective_blocks(m, n, k, ebm, ebn, ebk) == (ebm, ebn, ebk)
+
+
+@given(bm=prefs, bn=prefs, bk=prefs)
+def test_vmem_estimate_positive_and_monotone(bm, bn, bk):
+    est = vmem_bytes_estimate(bm, bn, bk, jnp.bfloat16, jnp.bfloat16,
+                              jnp.float32)
+    assert est > 0
+    # doubling a dimension never shrinks the footprint
+    assert vmem_bytes_estimate(2 * bm, bn, bk, jnp.bfloat16, jnp.bfloat16,
+                               jnp.float32) >= est
+
+
+@settings(deadline=None)  # jnp ops pay a dispatch cost per example
+@given(
+    rows=st.integers(1, 4), cols=st.integers(1, 8),
+    scale=st.floats(1e-3, 1e3),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_quantize_roundtrip_error_bound(rows, cols, scale, seed):
+    # per-row symmetric int8: |dequant(quant(x)) - x| <= rowmax/254 + eps
+    # (half a quantization step of the row's scale) — the bound the
+    # int8-wire collectives' accuracy story rests on
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((rows, cols)) * scale, jnp.float32)
+    q, s = _quantize(x)
+    assert q.dtype == jnp.int8
+    err = np.abs(np.asarray(_dequantize(q, s)) - np.asarray(x))
+    rowmax = np.max(np.abs(np.asarray(x)), axis=-1, keepdims=True)
+    # slack must be RELATIVE: fp32 rounding inside _quantize scales with
+    # rowmax, so an absolute epsilon is latently flaky at large scales
+    # (half-step boundary cases exceed rowmax/254 by O(rowmax * 1e-7))
+    bound = rowmax * (1.0 / (2 * _QMAX) + 1e-6) + 1e-9
+    assert np.all(err <= bound)
+
+
+@given(size=st.integers(1, 65536), t=st.floats(1e-6, 1e3))
+def test_calculate_tflops_identity(size, t):
+    # tflops * time == 2n³ flops (the I4 metrics contract)
+    tf = calculate_tflops(size, t)
+    assert np.isclose(tf * t * 1e12, 2.0 * size**3, rtol=1e-6)
+
+
+@given(total=st.floats(0.01, 1e4), single=st.floats(0.01, 1e4),
+       world=st.integers(1, 512))
+def test_scaling_efficiency_bounds(total, single, world):
+    eff = scaling_efficiency(total, single, world)
+    assert eff is not None and eff > 0
+    # perfect scaling is exactly 100%
+    assert np.isclose(scaling_efficiency(single * world, single, world), 100.0)

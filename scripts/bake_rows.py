@@ -80,6 +80,13 @@ def main(paths):
         unit = "TOPS" if dtype == "int8" else "TFLOPS"
         prec = "" if precision == "default" else f" precision={precision}"
         print(f"\n## {dtype} {shape}{prec} — {len(ranked)} candidates")
+        if "tie_margin_pct" in ex:
+            # the tuner's confirm pass flagged a sub-noise margin
+            # (RESULTS_TPU.md: single runs drift ±1.5%) — surface it
+            # before anyone pastes the "winner"
+            print(f"  TIE: confirm margin {ex['tie_margin_pct']}% is "
+                  "inside run noise — re-run the head-to-head with more "
+                  "--iterations before baking")
         for (rec, p), tag in zip(ranked[:3], ("WINNER", "2nd", "3rd")):
             e = rec["extras"]
             margin = ("" if rec is best else

@@ -100,3 +100,32 @@ def test_bake_rows_emits_table_literals(tmp_path):
     assert "_RECT_V5E_ROWS['bfloat16']" in out.stdout
     assert "381.20 TOPS" in out.stdout
     assert str(src) in out.stdout  # provenance
+    assert "TIE" not in out.stdout  # clear margins carry no tie warning
+
+
+def test_bake_rows_surfaces_confirm_ties(tmp_path):
+    # a tie_margin_pct flag from the tuner's confirm pass (sub-1% margin
+    # = run noise, RESULTS_TPU.md) must be surfaced before the 'winner'
+    # literal, so nobody bakes a coin flip
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    src = tmp_path / "tied.jsonl"
+    with open(src, "w") as f:
+        for blocks, tflops in (((2048, 1024, 2048), 365.1),
+                               ((1024, 1024, 2048), 364.9)):
+            f.write(json.dumps({
+                "benchmark": "tune", "mode": "pallas_tune", "size": 8192,
+                "dtype": "int8", "tflops_total": tflops,
+                "extras": {"block_m": blocks[0], "block_n": blocks[1],
+                           "block_k": blocks[2], "confirm_pass": True,
+                           "tie_margin_pct": 0.05}}) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "bake_rows.py"), str(src)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "TIE: confirm margin 0.05%" in out.stdout
+    assert "before baking" in out.stdout

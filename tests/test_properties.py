@@ -95,3 +95,41 @@ def test_scaling_efficiency_bounds(total, single, world):
     assert eff is not None and eff > 0
     # perfect scaling is exactly 100%
     assert np.isclose(scaling_efficiency(single * world, single, world), 100.0)
+
+
+@settings(deadline=None)
+@given(
+    size=st.integers(1, 65536),
+    world=st.integers(1, 256),
+    t=st.floats(1e-6, 1e2),
+    tflops=st.floats(0.01, 500.0),
+    comm=st.one_of(st.none(), st.floats(1e-7, 1.0)),
+    extras=st.dictionaries(
+        st.text(st.characters(codec="ascii", categories=("L", "N")),
+                min_size=1, max_size=12),
+        st.one_of(st.integers(-1000, 1000), st.floats(-1e6, 1e6,
+                                                      allow_nan=False),
+                  st.text(max_size=20), st.booleans()),
+        max_size=5),
+)
+def test_record_jsonl_roundtrip(size, world, t, tflops, comm, extras):
+    # the JSONL channel (to_json -> from_json) is what compare, bake_rows
+    # and digest read — every field must survive the trip bit-exactly
+    from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+
+    rec = BenchmarkRecord(
+        benchmark="matmul", mode="single", size=size, dtype="bfloat16",
+        world=world, iterations=10, warmup=2, avg_time_s=t,
+        tflops_per_device=tflops, tflops_total=tflops * world,
+        device_kind="TPU v5 lite", comm_time_s=comm,
+        compute_time_s=None if comm is None else t,
+        extras=dict(extras),
+    ).finalize()
+    back = BenchmarkRecord.from_json(rec.to_json())
+    assert back == rec
+    # forward-compat: unknown keys in the line are ignored
+    import json as _json
+
+    d = _json.loads(rec.to_json())
+    d["comparison_key"] = "whatever"
+    assert BenchmarkRecord.from_json(_json.dumps(d)) == rec

@@ -54,6 +54,10 @@ def main(paths: list[str]) -> None:
                 ("overlap_speedup_x", "validation", "timing_reliable",
                  "kernel")
                 if k in ex)
+            if ex.get("confirm_pass"):
+                extra_bits += " [confirm]"
+            if "tie_margin_pct" in ex:
+                extra_bits += f" [TIE {ex['tie_margin_pct']}%]"
             print(f"  {r.get('tflops_per_device', 0):8.2f} {unit:6} "
                   f"{shape:>18} {r.get('mode', ''):24} "
                   f"{str(blocks):>18} it={r.get('iterations')} "

@@ -133,3 +133,30 @@ def test_record_jsonl_roundtrip(size, world, t, tflops, comm, extras):
     d = _json.loads(rec.to_json())
     d["comparison_key"] = "whatever"
     assert BenchmarkRecord.from_json(_json.dumps(d)) == rec
+
+
+@given(
+    kind=st.sampled_from(["ag", "rs"]),
+    bidir=st.booleans(),
+    d=st.sampled_from([1, 2, 4, 8]),
+    size_mult=st.integers(1, 64),
+    bm=prefs, bn=prefs, bk=prefs,
+)
+def test_ring_effective_blocks_contract(kind, bidir, d, size_mult, bm, bn, bk):
+    # the chunk problem a ring candidate actually runs: the reported
+    # blocks must divide the forward half's dims (the dedupe key the ring
+    # tuner relies on), for every ring kind/direction/world size
+    from tpu_matmul_bench.benchmarks.pallas_tune import _ring_effective_blocks
+
+    size = size_mult * d * 2  # divisible by d, rows per chunk >= 2
+    mshard = size // d
+    eff, key = _ring_effective_blocks(kind, bidir, size, d, (bm, bn, bk))
+    rows = mshard // 2 if bidir else mshard
+    # dims() order matches effective_blocks' (m, n, k): AG chunks are
+    # [rows, k=size] x [size, nshard], RS chunks [rows, klocal] x [klocal, n]
+    m, n, k = ((rows, size // d, size) if kind == "ag"
+               else (rows, size, size // d))
+    ebm, ebn, ebk = eff
+    assert m % ebm == 0 and n % ebn == 0 and k % ebk == 0
+    # the dedupe key always embeds the forward half's blocks
+    assert key == eff or key[0] == eff

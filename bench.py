@@ -74,6 +74,46 @@ _best = 0.0  # best TFLOPS seen so far; what every emit reports
 _health = {"backend": "pending", "attempts": 0, "last_rc": None}
 
 
+_lkg_memo: list = []  # [dict | None] once computed — see _last_known_good
+
+
+def _last_known_good() -> dict | None:
+    """The newest committed fused-headline artifact, so a dead-backend
+    0.0 emit can point at the real measured number (and its provenance
+    file) instead of leaving the reader with nothing. Read-only file
+    scan — the parent still never touches the backend. Computed once and
+    memoized: the answer is constant for the process lifetime and _emit
+    also runs in the SIGTERM handler, which must stay free of filesystem
+    work (main() warms the memo before installing handlers)."""
+    if _lkg_memo:
+        return _lkg_memo[0]
+    import glob
+    import re
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    paths = glob.glob(os.path.join(repo, "measurements", "r*",
+                                   "headline_fused_pallas.jsonl"))
+
+    def round_no(p: str) -> int:
+        m = re.search(r"[/\\]r(\d+)[/\\]", p)
+        return int(m.group(1)) if m else -1
+
+    best = None
+    # numeric round order — lexicographic would put r10 before r2
+    for path in sorted(paths, key=round_no):
+        try:
+            with open(path) as fh:
+                rec = json.loads(fh.read().splitlines()[-1])
+            v = float(rec["tflops_per_device"])
+        except (OSError, ValueError, KeyError, IndexError, TypeError):
+            continue
+        if 0.0 < v <= MAX_PLAUSIBLE_TFLOPS:
+            best = {"value": round(v, 2),
+                    "source": os.path.relpath(path, repo)}
+    _lkg_memo.append(best)
+    return best
+
+
 def _emit() -> None:
     rec = {
         "metric": "bf16_matmul_16k_tflops_per_chip",
@@ -85,6 +125,10 @@ def _emit() -> None:
     }
     if _best == 0.0 and _health["last_rc"] is not None:
         rec["last_rc"] = _health["last_rc"]
+    if _best == 0.0:
+        lkg = _last_known_good()
+        if lkg is not None:
+            rec["last_known_good"] = lkg
     line = json.dumps(rec) + "\n"
     # one os.write of a <PIPE_BUF line is atomic: a SIGTERM-handler emit
     # can never interleave mid-line with a main-thread emit (print() would
@@ -275,6 +319,7 @@ def main() -> None:
         _emit()
         os._exit(0)
 
+    _last_known_good()  # warm the memo: no filesystem work in handlers
     signal.signal(signal.SIGTERM, _die)
     signal.signal(signal.SIGINT, _die)
 

@@ -379,3 +379,23 @@ def test_artifact_dir_keeps_attempt_jsonls(tmp_path):
     assert rec["value"] == 123.0
     files = list(adir.glob("attempt_*.jsonl"))
     assert files, list(adir.iterdir())
+
+
+def test_zero_emit_points_at_last_known_good(capfd):
+    # a dead-backend 0.0 line carries the newest committed fused-headline
+    # measurement and its provenance file, so the artifact explains what
+    # the chip was last seen doing instead of leaving a bare zero
+    bench = _load_bench()
+    bench._best = 0.0
+    bench._health.update(backend="unavailable", attempts=2, last_rc=1)
+    bench._emit()
+    rec = json.loads(capfd.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 0.0
+    lkg = rec["last_known_good"]
+    assert lkg["value"] > 100.0
+    assert lkg["source"].startswith("measurements/")
+    # a real measurement never carries the pointer
+    bench._best = 194.2
+    bench._emit()
+    rec = json.loads(capfd.readouterr().out.strip().splitlines()[-1])
+    assert "last_known_good" not in rec

@@ -139,16 +139,18 @@ def test_record_jsonl_roundtrip(size, world, t, tflops, comm, extras):
     kind=st.sampled_from(["ag", "rs"]),
     bidir=st.booleans(),
     d=st.sampled_from([1, 2, 4, 8]),
-    size_mult=st.integers(1, 64),
+    rows_per_chunk=st.integers(2, 129),  # odd values exercise the
+    # backward half clamping differently from the forward half
     bm=prefs, bn=prefs, bk=prefs,
 )
-def test_ring_effective_blocks_contract(kind, bidir, d, size_mult, bm, bn, bk):
+def test_ring_effective_blocks_contract(kind, bidir, d, rows_per_chunk,
+                                        bm, bn, bk):
     # the chunk problem a ring candidate actually runs: the reported
     # blocks must divide the forward half's dims (the dedupe key the ring
     # tuner relies on), for every ring kind/direction/world size
     from tpu_matmul_bench.benchmarks.pallas_tune import _ring_effective_blocks
 
-    size = size_mult * d * 2  # divisible by d, rows per chunk >= 2
+    size = rows_per_chunk * d  # divisible by d; mshard may be ODD
     mshard = size // d
     eff, key = _ring_effective_blocks(kind, bidir, size, d, (bm, bn, bk))
     rows = mshard // 2 if bidir else mshard

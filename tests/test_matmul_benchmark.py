@@ -124,3 +124,63 @@ def test_timing_fused_rect(tmp_path):
     (rec,) = recs
     assert rec.extras["timing"] == "fused"
     assert rec.extras["validation"] == "ok"
+
+
+def test_repeats_best_of_n(tmp_path, monkeypatch):
+    # --repeats N re-times the loop and reports the FASTEST (the r4
+    # best-of-N drift answer); records carry the repeats provenance.
+    # warmup=4 on the first repeat, 1 after — a distinctive first value
+    # so a regression to always-1 or always-config.warmup fails.
+    from tpu_matmul_bench.utils.timing import Timing
+
+    calls = []
+
+    def fake_time_jitted(fn, operands, iterations=50, warmup=10):
+        calls.append(warmup)
+        # successive repeats get faster then slower: best is the middle
+        avg = [2e-3, 1e-3, 3e-3][len(calls) - 1]
+        return Timing(total_s=avg * iterations, iterations=iterations,
+                      sync_overhead_s=0.0)
+
+    monkeypatch.setattr(matmul_benchmark, "time_jitted", fake_time_jitted)
+    recs = matmul_benchmark.main(
+        ["--sizes", "64", "--iterations", "3", "--warmup", "4",
+         "--dtype", "float32", "--num-devices", "1", "--repeats", "3",
+         "--json-out", str(tmp_path / "r.jsonl")])
+    assert calls == [4, 1, 1]  # compile-absorbing warmup paid exactly once
+    (rec,) = recs
+    assert rec.avg_time_s == 1e-3  # the fastest repeat wins
+    assert rec.extras["repeats"] == 3
+
+
+def test_repeats_fused_builds_program_once(tmp_path, monkeypatch):
+    # under --timing fused the K-iteration program is fused/compiled ONCE
+    # and re-timed; per-repeat fuse_iterations calls would retrace and
+    # recompile the whole program each round
+    from tpu_matmul_bench.utils.timing import Timing
+
+    builds, timed = [], []
+
+    def fake_fuse(fn, k):
+        builds.append(k)
+        return lambda *a: None
+
+    def fake_time_jitted(fn, operands, iterations=50, warmup=10):
+        timed.append(warmup)
+        return Timing(total_s=1e-3, iterations=1, sync_overhead_s=0.0)
+
+    monkeypatch.setattr(matmul_benchmark, "fuse_iterations", fake_fuse)
+    monkeypatch.setattr(matmul_benchmark, "time_jitted", fake_time_jitted)
+    recs = matmul_benchmark.main(
+        ["--sizes", "64", "--iterations", "5", "--warmup", "1",
+         "--dtype", "float32", "--num-devices", "1", "--repeats", "3",
+         "--timing", "fused", "--json-out", str(tmp_path / "f.jsonl")])
+    assert builds == [5]      # fused program built exactly once
+    assert len(timed) == 3    # ...and timed once per repeat
+    (rec,) = recs
+    assert rec.iterations == 5  # dispatches x fused length
+
+
+def test_repeats_default_single_timing(tmp_path):
+    recs = matmul_benchmark.main(_argv(tmp_path, ["--num-devices", "1"]))
+    assert all("repeats" not in r.extras for r in recs)

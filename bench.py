@@ -229,11 +229,18 @@ def _run_attempts(deadline: float,
                  "--iterations", "50", "--warmup", "10",
                  "--num-devices", "1", "--timing", "fused",
                  "--matmul-impl", impl, "--json-out", out_path])
+        # persistent compilation cache: attempt 2+ (and any measure-script
+        # run from the same boot) skips the 20-40 s 16k compile — more
+        # real measurement attempts fit the budget on a flaky tunnel
+        child_env = dict(os.environ)
+        child_env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+        child_env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                             "1")
         procs.append(subprocess.Popen(
             argv,
             # human report → stderr (stdout must stay clean for the JSON
             # lines; the machine channel is the --json-out file)
-            stdout=sys.stderr, stderr=sys.stderr,
+            stdout=sys.stderr, stderr=sys.stderr, env=child_env,
         ))
         # wait for this attempt, emitting improvements as they land
         attempt_deadline = time.time() + min(

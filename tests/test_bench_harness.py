@@ -399,3 +399,45 @@ def test_zero_emit_points_at_last_known_good(capfd):
     bench._emit()
     rec = json.loads(capfd.readouterr().out.strip().splitlines()[-1])
     assert "last_known_good" not in rec
+
+
+def test_children_get_persistent_compile_cache(monkeypatch):
+    # attempts must inherit a persistent JAX compilation cache (attempt
+    # 2+ skips the 20-40s 16k compile) without clobbering an explicit one
+    import time
+
+    bench = _load_bench()
+    seen_envs = []
+
+    class OkProc:
+        returncode = 0
+
+        def wait(self, timeout=None):
+            return 0
+
+        def poll(self):
+            return 0
+
+    def fake_popen(args, env=None, **kw):
+        seen_envs.append(env or {})
+        out = args[args.index("--json-out") + 1]
+        with open(out, "w") as f:
+            f.write(json.dumps({"mode": "single",
+                                "tflops_per_device": 194.0}) + "\n")
+        return OkProc()
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    bench._run_attempts(deadline=time.time() + 30)
+    assert seen_envs
+    for env in seen_envs:
+        assert env.get("JAX_COMPILATION_CACHE_DIR")
+
+    # an operator-set cache dir wins over the default
+    seen_envs.clear()
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/custom/cache")
+    bench2 = _load_bench()
+    monkeypatch.setattr(bench2.subprocess, "Popen", fake_popen)
+    bench2._run_attempts(deadline=time.time() + 30)
+    assert seen_envs  # guard: an empty run would pass the all() vacuously
+    assert all(e["JAX_COMPILATION_CACHE_DIR"] == "/custom/cache"
+               for e in seen_envs)

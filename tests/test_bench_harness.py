@@ -441,3 +441,27 @@ def test_children_get_persistent_compile_cache(monkeypatch):
     assert seen_envs  # guard: an empty run would pass the all() vacuously
     assert all(e["JAX_COMPILATION_CACHE_DIR"] == "/custom/cache"
                for e in seen_envs)
+
+
+def test_last_known_good_numeric_round_order(monkeypatch, tmp_path):
+    # r10 must outrank r9: lexicographic dir order would visit r10 first
+    # and let the OLDER r9 artifact win the last-valid-wins scan
+    import glob as _glob
+
+    bench = _load_bench()
+    for rnd, val in (("r9", 180.0), ("r10", 190.0)):
+        d = tmp_path / "measurements" / rnd
+        d.mkdir(parents=True)
+        (d / "headline_fused_pallas.jsonl").write_text(
+            json.dumps({"tflops_per_device": val}) + "\n")
+    real_glob = _glob.glob
+    monkeypatch.setattr(
+        _glob, "glob",
+        lambda pat: real_glob(str(tmp_path / "measurements" / "r*"
+                                  / "headline_fused_pallas.jsonl")))
+    lkg = bench._last_known_good()
+    assert lkg["value"] == 190.0  # the newest round, not the lexicographic last
+    # memoized: a second call returns the same object without re-scanning
+    monkeypatch.setattr(_glob, "glob",
+                        lambda pat: (_ for _ in ()).throw(AssertionError))
+    assert bench._last_known_good() is lkg

@@ -61,6 +61,16 @@ step() {
 
 pass() {
   # -- add round-5 verdict-driven steps here (highest value first) --
+  # carried over from r4 (the 05:50 wedge blocked them):
+  step headline_bestof3 \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+      --num-devices 1 --timing fused --repeats 3 --matmul-impl pallas \
+      --json-out $R5/headline_fused_bestof3.jsonl || return 1
+  step headline_percentiles \
+    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+      --sizes 16384 --iterations 30 --warmup 5 --num-devices 1 \
+      --percentiles --json-out $R5/headline_percentiles.jsonl || return 1
   step headline_fused_pallas \
     python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
       --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \

@@ -8,14 +8,35 @@ processes, runs a cross-process psum through the framework's own mesh +
 collective wrappers, and checks the rank-0 reporting gate.
 """
 
+import functools
 import socket
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 from envutil import scrubbed_env
 
 WORKER = Path(__file__).parent / "multihost_worker.py"
+
+
+def retry_flaky(test_fn=None, *, attempts=2):
+    """Test-level retry for the jax-internal Gloo transport race: the
+    in-helper launcher retries cover the no-results failure shape, but
+    the race can also surface as a missing per-mode line on an otherwise
+    rc==0 run (observed once per ~hundred full-suite runs). A real
+    regression fails every attempt; the race passes the rerun."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            for _ in range(attempts - 1):
+                try:
+                    return fn(*a, **k)
+                except AssertionError:
+                    time.sleep(5)
+            return fn(*a, **k)
+        return wrapper
+    return deco(test_fn) if test_fn is not None else deco
 
 
 def _free_port() -> int:
@@ -44,6 +65,7 @@ def _run_launcher(args: list[str], env: dict, attempts: int = 3):
     return out
 
 
+@retry_flaky
 def test_multihost_launcher_runs_scaling_benchmark():
     """The torchrun-analogue launcher: 2 coordinated processes running the
     real scaling benchmark over a 4-device (2 hosts × 2) global mesh."""
@@ -60,6 +82,7 @@ def test_multihost_launcher_runs_scaling_benchmark():
     assert out.stdout.count("Results for 64x64") == 1
 
 
+@retry_flaky
 def test_multihost_launcher_runs_bidir_overlap():
     """The bidirectional collective matmul over a REAL 2-process cluster
     (4-device global ring spanning the process boundary) — the
@@ -77,6 +100,7 @@ def test_multihost_launcher_runs_bidir_overlap():
     assert "validation: ok" in out.stdout
 
 
+@retry_flaky
 def test_multihost_launcher_runs_bidir_rs_overlap():
     """The RS dual of the bidirectional collective matmul over the same
     real 2-process cluster: the counter-rotating half-ACCUMULATOR rings
@@ -94,6 +118,7 @@ def test_multihost_launcher_runs_bidir_rs_overlap():
     assert "validation: ok" in out.stdout
 
 
+@retry_flaky
 def test_multihost_launcher_runs_inkernel_ring():
     """The in-kernel HBM ring (Pallas make_async_remote_copy RDMA,
     interpret mode on CPU) over a REAL 2-process cluster: the ring's
@@ -111,6 +136,7 @@ def test_multihost_launcher_runs_inkernel_ring():
     assert "validation: ok" in out.stdout
 
 
+@retry_flaky
 def test_multihost_launcher_runs_inkernel_bidir_rs_ring():
     """The round-4 bidirectional RS ring over the same real 2-process
     cluster: per-direction staging RDMA + accumulator pickup across the
@@ -127,6 +153,7 @@ def test_multihost_launcher_runs_inkernel_bidir_rs_ring():
     assert "validation: ok" in out.stdout
 
 
+@retry_flaky
 def test_multihost_launcher_runs_summa():
     """SUMMA's 2-D grid over a REAL 2-process cluster: the (2x2) mesh
     spans the process boundary, so each k-panel's masked-psum broadcasts
@@ -144,6 +171,7 @@ def test_multihost_launcher_runs_summa():
     assert "validation: ok" in out.stdout
 
 
+@retry_flaky
 def test_multihost_launcher_runs_hybrid():
     """The hybrid dp×tp mode over a REAL 2-process cluster: the 2-D mesh
     spans the process boundary, so the tp gather and dp psum cross hosts
@@ -161,6 +189,7 @@ def test_multihost_launcher_runs_hybrid():
     assert "validation: ok" in out.stdout
 
 
+@retry_flaky
 def test_multihost_curve_balanced_submeshes(tmp_path):
     """The scaling `curve` over a REAL 2-process cluster (4 global devices).
     Counts must be swept as multiples of the process count with BALANCED
@@ -189,6 +218,7 @@ def test_multihost_curve_balanced_submeshes(tmp_path):
     assert out.stdout.count("| Devices | Total TFLOPS") == 1
 
 
+@retry_flaky
 def test_two_process_psum():
     coordinator = f"127.0.0.1:{_free_port()}"
     env = scrubbed_env()
@@ -218,6 +248,7 @@ def test_two_process_psum():
     assert combined.count("MULTIHOST_WORKER") == 1, combined
 
 
+@retry_flaky
 def test_multihost_launcher_runs_fused_timing():
     """--timing fused over a real 2-process cluster: the fused scan wraps
     a shard_map program whose psum crosses the process boundary, and the

@@ -264,3 +264,33 @@ def test_multihost_launcher_runs_fused_timing():
     assert "Results for 64x64 [batch_parallel]" in out.stdout
     assert "timing: fused" in out.stdout
     assert "validation: ok" in out.stdout
+
+
+def test_retry_flaky_semantics(monkeypatch):
+    # the race absorber must retry an AssertionError exactly up to
+    # `attempts` and still surface deterministic failures
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    calls = []
+
+    @retry_flaky
+    def flaky_once():
+        calls.append(1)
+        if len(calls) == 1:
+            raise AssertionError("transient")
+        return "ok"
+
+    assert flaky_once() == "ok"
+    assert len(calls) == 2
+
+    hard_calls = []
+
+    @retry_flaky
+    def always_fails():
+        hard_calls.append(1)
+        raise AssertionError("real regression")
+
+    import pytest
+
+    with pytest.raises(AssertionError, match="real regression"):
+        always_fails()
+    assert len(hard_calls) == 2  # retried, then surfaced

@@ -1,32 +1,36 @@
 #!/bin/bash
-# Round-5 measurement playbook — freshness pass over the round-4 headline
-# set, priority-retry pattern (see measure_r4d.sh for the rationale: a
-# step is done on rc==0; every pass re-attempts the highest-value
-# unfinished step first, so any healthy window buys the most valuable
-# missing artifact).
+# Round-5 measurement watcher — gated priority-retry driver.
 #
-# Round-4 left every VERDICT-r3 hardware item measured (RESULTS_TPU.md
-# "Round-4 measured set"); round 5's baseline need is freshness — confirm
-# the baked rows still hold on the current chip state — plus whatever the
-# r4 verdict flags. Add verdict-driven steps at the TOP of pass().
-#
-# Lessons baked in (measurements/r4, RESULTS_TPU.md):
-#  - fused + dispatch must agree to ~1% on a healthy link; a fused
-#    number above the chip peak (197 bf16 / 394 int8) is a protocol bug,
-#    not a measurement.
-#  - single uninterleaved runs drift +-1.5%; use `tune` with two
-#    candidates (interleaved confirm) for any row decision.
-#  - never kill a TPU client mid-RPC; let steps slow-fail.
+# Changes from measure_r4d.sh's structure (rationale in VERDICT r4 /
+# measurements/r4 lessons):
+#  - HEALTH GATE: each walk of the step list is gated on a FRESH `doctor`
+#    probe (the staged recovery probe). On a dead tunnel the r4 loop
+#    burned step attempts (a wedged step takes 25 min..2 h to slow-fail;
+#    8 caps could exhaust before a window opened). Now a dead probe costs
+#    nothing; step attempts only tick when the backend answered the
+#    probe. Exit 3 (link degraded) opens the gate for FUSED-protocol
+#    steps only — GATE_LINK=degraded makes the steps script skip (not
+#    attempt, not mark done) the dispatch-protocol steps, whose numbers
+#    would be tunnel-latency artifacts (the r4 '121 then 50 TFLOPS'
+#    failure doctor was built to catch).
+#  - STEPS IN A CHILD SCRIPT: measure_r5_steps.sh is invoked fresh per
+#    walk, so new verdict-driven steps can be added mid-round without
+#    restarting this watcher (never kill a TPU client mid-RPC).
+#  - Probe timeout 2000s > the documented ~25-min dead-backend hang, so
+#    a dead backend fails CLEANLY (UNAVAILABLE, no client killed) and
+#    takes the short backoff; only a genuinely wedged probe (hangs past
+#    33 min) is timeout-killed, and that path backs off long because the
+#    kill itself can deepen the wedge.
+#  - Completion = two consecutive clean walks, EACH behind its own fresh
+#    probe (done-markers can be cleared mid-walk to invalidate stale
+#    artifacts; the confirmation walk must not re-measure them on a
+#    stale health verdict).
 #
 # Usage: bash scripts/measure_r5.sh > /tmp/measure_r5.log 2>&1
 
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p measurements/r5
-R5=measurements/r5
-MAX_ATTEMPTS=8
-STATE=measurements/r5/.state
-mkdir -p "$STATE"
 
 export JAX_COMPILATION_CACHE_DIR=/tmp/jax_cache
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
@@ -38,72 +42,50 @@ log "waiting for any running benchmark step to exit"
 while pgrep -f "python -m tpu_matmul_bench" > /dev/null 2>&1; do
   sleep 30
 done
-log "backend is free — starting priority loop"
+log "backend is free — starting gated priority loop"
 
-step() {
-  local id="$1"; shift
-  [ -e "$STATE/$id.done" ] && return 0
-  local n=0
-  [ -e "$STATE/$id.attempts" ] && n=$(cat "$STATE/$id.attempts")
-  if [ "$n" -ge "$MAX_ATTEMPTS" ]; then
-    return 0
-  fi
-  echo $((n + 1)) > "$STATE/$id.attempts"
-  log "[$id] attempt $((n + 1)): $*"
-  if "$@"; then
-    touch "$STATE/$id.done"
-    log "[$id] DONE"
-    return 0
-  fi
-  log "[$id] failed (attempt $((n + 1))/$MAX_ATTEMPTS)"
-  return 1
-}
-
-pass() {
-  # -- add round-5 verdict-driven steps here (highest value first) --
-  # carried over from r4 (the 05:50 wedge blocked them):
-  step headline_bestof3 \
-    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
-      --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
-      --num-devices 1 --timing fused --repeats 3 --matmul-impl pallas \
-      --json-out $R5/headline_fused_bestof3.jsonl || return 1
-  step headline_percentiles \
-    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
-      --sizes 16384 --iterations 30 --warmup 5 --num-devices 1 \
-      --percentiles --json-out $R5/headline_percentiles.jsonl || return 1
-  step headline_fused_pallas \
-    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
-      --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
-      --num-devices 1 --timing fused --matmul-impl pallas \
-      --json-out $R5/headline_fused_pallas.jsonl || return 1
-  step headline_dispatch_pallas \
-    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
-      --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
-      --num-devices 1 --matmul-impl pallas \
-      --json-out $R5/headline_dispatch_pallas.jsonl || return 1
-  step headline_fused_xla \
-    python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
-      --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
-      --num-devices 1 --timing fused --matmul-impl xla \
-      --json-out $R5/headline_fused_xla.jsonl || return 1
-  step int8_16k_rows_headtohead \
-    python -m tpu_matmul_bench tune --sizes 16384 --dtype int8 \
-      --iterations 50 --timing fused \
-      --candidates 2048,1024,2048 2048,2048,1024 \
-      --json-out $R5/int8_16k_headtohead.jsonl || return 1
-  step compare_16k_refresh \
-    python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
-      --size 16384 --iterations 20 --warmup 5 --isolate \
-      --mode-timeout 900 --timing fused \
-      --json-out $R5/compare_r5_16k.jsonl \
-      --markdown-out $R5/compare_r5_16k.md || return 1
-  return 0
-}
-
+clean_walks=0
 while true; do
-  if pass && pass; then
-    log "R5 ALL DONE (or attempt caps reached)"
-    break
+  log "health gate: doctor probe"
+  # stale-report hygiene: absence of the file means "probe did not
+  # complete" — a timeout-killed doctor must not leave an hours-old
+  # healthy verdict lying around
+  rm -f measurements/r5/.doctor_last.json
+  # -k 60: a probe stuck in an uninterruptible driver call survives
+  # SIGTERM; the KILL fallback keeps the gate loop alive
+  timeout -k 60 2000 python -m tpu_matmul_bench doctor --size 1024 \
+    --json-out measurements/r5/.doctor_last.json
+  rc=$?
+  if [ "$rc" -eq 0 ] || [ "$rc" -eq 3 ]; then
+    link=ok
+    [ "$rc" -eq 3 ] && link=degraded
+    log "gate open (doctor rc=$rc, link=$link) — running a walk"
+    GATE_LINK=$link bash scripts/measure_r5_steps.sh
+    walk_rc=$?
+    if [ "$walk_rc" -eq 0 ]; then
+      clean_walks=$((clean_walks + 1))
+      if [ "$clean_walks" -ge 2 ]; then
+        log "R5 ALL DONE (or attempt caps reached; two clean gated walks)"
+        break
+      fi
+      sleep 30
+    elif [ "$walk_rc" -eq 75 ]; then
+      # sentinel (not bash's own 2 = usage error, so a broken steps
+      # script is never misread as clean): walk clean except for
+      # dispatch-protocol steps skipped on a degraded link — nothing
+      # failed, but completion needs a healthy-link walk
+      log "walk clean but dispatch steps pending (degraded link) — waiting"
+      clean_walks=0
+      sleep 300
+    else
+      clean_walks=0
+      sleep 60
+    fi
+  elif [ "$rc" -eq 124 ]; then
+    log "gate closed: probe timed out (client killed mid-RPC) — long backoff"
+    sleep 900
+  else
+    log "gate closed: probe failed fast (rc=$rc) — short backoff"
+    sleep 180
   fi
-  sleep 60
 done

@@ -1,0 +1,113 @@
+#!/bin/bash
+# One priority-ordered walk over the round-5 measurement steps. Invoked
+# fresh by measure_r5.sh behind a doctor health gate on every walk, so
+# edits here (new verdict-driven steps, candidate tweaks) take effect on
+# the next walk WITHOUT killing the running watcher — killing a TPU
+# client mid-RPC strands the relay grant (measurements/r4 lesson), so
+# the watcher itself must never be restarted while a step is in flight.
+#
+# Contract (same as measure_r4d.sh): a step is done on rc==0; each gets
+# MAX_ATTEMPTS tries; the walk aborts on first failure so the next walk
+# re-attempts the highest-value unfinished step first.
+#
+# GATE_LINK (set by the watcher from doctor's verdict): when "degraded",
+# steps whose measurement uses the DISPATCH protocol (plain timed loop,
+# or --percentiles' per-iteration sync) are SKIPPED — not attempted, not
+# done-marked — because their numbers on a degraded link are tunnel-
+# latency artifacts (doctor.py's '121 then 50 TFLOPS' case). Fused and
+# tune steps still run: the fused protocol is degraded-link-proof.
+#
+# Exit: 0 = every step done or attempt-capped; 75 = clean walk except
+# dispatch-protocol steps skipped on a degraded link (75 = EX_TEMPFAIL,
+# chosen to never collide with bash's own 1/2/126/127 statuses — a
+# syntax error in this file must not be misread as a clean walk);
+# 1 = a step failed.
+
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p measurements/r5
+R5=measurements/r5
+MAX_ATTEMPTS=8
+STATE=measurements/r5/.state
+mkdir -p "$STATE"
+GATE_LINK=${GATE_LINK:-ok}
+SKIPPED_DISPATCH=0
+
+log() { echo; echo "=== [$(date +%H:%M:%S)] $*"; }
+
+# step [--dispatch] <id> <cmd...>: run unless done/attempt-capped; mark
+# done on rc==0. --dispatch tags a step whose measurement uses the
+# DISPATCH protocol: on a degraded link it is skipped — no attempt
+# burned, no done marker — and the walk reports rc=75 so the watcher
+# keeps waiting for a healthy window. One copy of the state logic: the
+# gate check sits between the done/cap reads and the attempt tick.
+step() {
+  local dispatch=0
+  if [ "$1" = --dispatch ]; then dispatch=1; shift; fi
+  local id="$1"; shift
+  [ -e "$STATE/$id.done" ] && return 0
+  local n=0
+  [ -e "$STATE/$id.attempts" ] && n=$(cat "$STATE/$id.attempts")
+  if [ "$n" -ge "$MAX_ATTEMPTS" ]; then
+    return 0
+  fi
+  if [ "$dispatch" -eq 1 ] && [ "$GATE_LINK" != ok ]; then
+    log "[$id] skipped: dispatch-protocol step on a degraded link"
+    SKIPPED_DISPATCH=1
+    return 0
+  fi
+  echo $((n + 1)) > "$STATE/$id.attempts"
+  log "[$id] attempt $((n + 1)): $*"
+  if "$@"; then
+    touch "$STATE/$id.done"
+    log "[$id] DONE"
+    return 0
+  fi
+  log "[$id] failed (attempt $((n + 1))/$MAX_ATTEMPTS)"
+  return 1
+}
+
+# -- priority list: highest value first (VERDICT r4 #3 then freshness) --
+step headline_bestof3 \
+  python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+    --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+    --num-devices 1 --timing fused --repeats 3 --matmul-impl pallas \
+    --json-out $R5/headline_fused_bestof3.jsonl || exit 1
+step --dispatch headline_percentiles \
+  python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+    --sizes 16384 --iterations 30 --warmup 5 --num-devices 1 \
+    --percentiles --json-out $R5/headline_percentiles.jsonl || exit 1
+step --dispatch percentiles_4k \
+  python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+    --sizes 4096 --iterations 50 --warmup 10 --num-devices 1 \
+    --percentiles --matmul-impl pallas \
+    --json-out $R5/percentiles_4k.jsonl || exit 1
+step headline_fused_pallas \
+  python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+    --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+    --num-devices 1 --timing fused --matmul-impl pallas \
+    --json-out $R5/headline_fused_pallas.jsonl || exit 1
+step --dispatch headline_dispatch_pallas \
+  python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+    --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+    --num-devices 1 --matmul-impl pallas \
+    --json-out $R5/headline_dispatch_pallas.jsonl || exit 1
+step headline_fused_xla \
+  python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+    --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+    --num-devices 1 --timing fused --matmul-impl xla \
+    --json-out $R5/headline_fused_xla.jsonl || exit 1
+step int8_16k_rows_headtohead \
+  python -m tpu_matmul_bench tune --sizes 16384 --dtype int8 \
+    --iterations 50 --timing fused \
+    --candidates 2048,1024,2048 2048,2048,1024 \
+    --json-out $R5/int8_16k_headtohead.jsonl || exit 1
+step compare_16k_refresh \
+  python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
+    --size 16384 --iterations 20 --warmup 5 --isolate \
+    --mode-timeout 900 --timing fused \
+    --json-out $R5/compare_r5_16k.jsonl \
+    --markdown-out $R5/compare_r5_16k.md || exit 1
+
+[ "$SKIPPED_DISPATCH" -eq 1 ] && exit 75
+exit 0

@@ -20,7 +20,9 @@ def test_defaults_match_reference():
     assert cfg.dtype == jnp.bfloat16
     assert cfg.mode is None
     assert cfg.device is None
-    assert cfg.matmul_impl == "xla"
+    # beyond the reference's surface: the default impl is the
+    # measured-winner router (VERDICT r4 #2), not a fixed kernel
+    assert cfg.matmul_impl == "auto"
 
 
 def test_flags():

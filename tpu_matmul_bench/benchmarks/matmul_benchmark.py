@@ -21,6 +21,7 @@ from jax import shard_map
 
 from tpu_matmul_bench.benchmarks.runner import run_sizes
 from tpu_matmul_bench.models.workloads import MatmulWorkload, RectMatmulWorkload
+from tpu_matmul_bench.ops.impl_select import auto_extras
 from tpu_matmul_bench.ops.matmul import make_matmul, matmul_2d
 from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
 from tpu_matmul_bench.parallel.modes import (
@@ -64,13 +65,15 @@ def _time(config: BenchConfig, fn, operands):
             fn, operands, iterations=config.iterations, warmup=config.warmup)
     if config.timing == "fused":
         k = max(int(config.iterations), 1)
-        fused = fuse_iterations(fn, k)
+        chain_state: dict = {}
+        fused = fuse_iterations(fn, k, chain_state=chain_state)
         best = None
         for _ in range(reps):
             t = time_jitted(fused, operands, iterations=1, warmup=1)
             t = Timing(total_s=t.total_s, iterations=t.iterations * k,
                        sync_overhead_s=t.sync_overhead_s,
-                       reliable=t.reliable)
+                       reliable=t.reliable,
+                       chain=chain_state.get("chain"))
             if best is None or t.avg_s < best.avg_s:
                 best = t
         return best
@@ -102,7 +105,7 @@ def _bench_single(
     # actually selects where the work runs, not just what the banner says
     with jax.default_device(device if device is not None else jax.devices()[0]):
         a, b = wl.operands()
-        mm = make_matmul(config.matmul_impl, config.blocks)
+        mm = make_matmul(config.matmul_impl, config.blocks, device_kind)
         verdict: dict = {}
         if config.validate:  # before timing: a wrong kernel fails fast
             got = mm(a, b)[:VALIDATION_CORNER, :VALIDATION_CORNER]
@@ -110,6 +113,8 @@ def _bench_single(
                                         config.dtype)
         t = _time(config, mm, (a, b))
         extras = _base_extras(config, t)
+        extras.update(auto_extras(config.matmul_impl, size, size, size,
+                                  device_kind, config.dtype))
         if config.percentiles:
             extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
         extras.update(verdict)
@@ -141,7 +146,7 @@ def _bench_all_devices(
 
     # Per-device independent matmul, zero collectives in the timed loop —
     # ≙ every rank calling benchmark_matmul concurrently.
-    mm2d = matmul_2d(config.matmul_impl, config.blocks)
+    mm2d = matmul_2d(config.matmul_impl, config.blocks, device_kind)
     mm = jax.jit(
         shard_map(
             lambda x, y: jnp.stack([mm2d(x[i], y[i]) for i in range(x.shape[0])]),
@@ -157,6 +162,8 @@ def _bench_all_devices(
                                     config.dtype)
     t = _time(config, mm, (a, b))
     extras = _base_extras(config, t)
+    extras.update(auto_extras(config.matmul_impl, size, size, size,
+                              device_kind, config.dtype))
     if config.percentiles:
         extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
     extras.update(verdict)
@@ -187,7 +194,7 @@ def _bench_rect(
     wl = RectMatmulWorkload(m, k, n, config.dtype, seed=config.seed)
     with jax.default_device(device):
         a, b = wl.operands()
-        mm = make_matmul(config.matmul_impl, config.blocks)
+        mm = make_matmul(config.matmul_impl, config.blocks, device_kind)
         verdict: dict = {}
         if config.validate:
             c = min(VALIDATION_CORNER, m, n)  # rect: corner bounded by M, N
@@ -196,6 +203,8 @@ def _bench_rect(
                                         config.dtype)
         t = _time(config, mm, (a, b))
         extras = {"shape": f"{m}x{k}x{n}", **_base_extras(config, t)}
+        extras.update(auto_extras(config.matmul_impl, m, n, k,
+                                  device_kind, config.dtype))
         if config.percentiles:
             extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
         extras.update(verdict)

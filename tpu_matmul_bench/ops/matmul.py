@@ -28,20 +28,48 @@ def _dot(a: jax.Array, b: jax.Array) -> jax.Array:
 
 
 def make_matmul(
-    impl: str = "xla", blocks: tuple[int, int, int] | None = None
+    impl: str = "xla", blocks: tuple[int, int, int] | None = None,
+    device_kind: str | None = None,
 ) -> Callable[[jax.Array, jax.Array], jax.Array]:
-    """A jitted C = A @ B. ``impl`` selects XLA's dot or the Pallas kernel;
-    ``blocks`` overrides the Pallas (bm, bn, bk) blocking (config.blocks)."""
-    return jax.jit(matmul_2d(impl, blocks))
+    """A jitted C = A @ B. ``impl`` selects XLA's dot, the Pallas kernel,
+    or the measured-winner router (``auto``); ``blocks`` overrides the
+    Pallas (bm, bn, bk) blocking (config.blocks); ``device_kind`` is the
+    RESOLVED compute device's kind for auto routing (see matmul_2d)."""
+    return jax.jit(matmul_2d(impl, blocks, device_kind))
 
 
 def matmul_2d(
-    impl: str = "xla", blocks: tuple[int, int, int] | None = None
+    impl: str = "xla", blocks: tuple[int, int, int] | None = None,
+    device_kind: str | None = None,
 ) -> Callable[[jax.Array, jax.Array], jax.Array]:
     """Un-jitted 2-D matmul for use *inside* shard_map/jit bodies — the one
     place every benchmark mode takes its hot op from, so `--matmul-impl
     pallas` (and a `--block-m/n/k` override) swaps the kernel uniformly
-    across all modes."""
+    across all modes. `impl="auto"` routes each (dtype, shape) to its
+    measured winner at trace time (ops/impl_select.py): shapes are static
+    under jit/shard_map, so the Python-level branch costs nothing in the
+    compiled program — inside shard_map the routing sees the per-shard
+    shape, which is the problem each device actually solves.
+
+    `device_kind` must be the RESOLVED compute device's kind (the mesh's
+    devices, or the --device selection) — falling back to
+    `jax.devices()[0]` only when the caller didn't resolve one. The
+    default backend's first device is NOT always where the work runs
+    (`--device cpu` on a TPU host pins compute via jax.default_device,
+    which jax.devices() ignores), and routing on the wrong kind would
+    both pick a bad impl (Pallas-interpret on CPU) and contradict the
+    record's auto_extras provenance."""
+    if impl == "auto":
+        from tpu_matmul_bench.ops.impl_select import select_impl
+
+        def _auto(a: jax.Array, b: jax.Array) -> jax.Array:
+            kind = (device_kind if device_kind is not None
+                    else jax.devices()[0].device_kind)
+            choice = select_impl(a.shape[0], b.shape[1], a.shape[1],
+                                 kind, a.dtype)
+            return matmul_2d(choice.impl, blocks)(a, b)
+
+        return _auto
     if impl == "pallas":
         from tpu_matmul_bench.ops.pallas_matmul import pallas_matmul
 

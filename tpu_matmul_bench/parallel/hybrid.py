@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_matmul_bench.ops.matmul import matmul_2d
+from tpu_matmul_bench.parallel.mesh import mesh_device_kind
 from tpu_matmul_bench.parallel.mesh import sharded_normal, smap
 from tpu_matmul_bench.parallel.modes import (
     ModeSetup,
@@ -56,7 +57,7 @@ def hybrid_programs(mesh: Mesh, impl: str = "xla",
     """(compute, full) shard_map programs for the composed dp×tp step.
     `comm_quant="int8"` routes BOTH collectives over the int8 wire (the
     tp column gather and the dp gradient-sync psum)."""
-    mm = matmul_2d(impl, blocks)
+    mm = matmul_2d(impl, blocks, mesh_device_kind(mesh))
     ag = allgather_impl(comm_quant)
     psum = psum_impl(comm_quant, varying_out=True)
 

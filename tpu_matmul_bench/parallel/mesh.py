@@ -92,3 +92,11 @@ def ring_perm_rev(n: int) -> list[tuple[int, int]]:
     the counter-rotating half of a bidirectional ring, which uses both
     directions of each full-duplex ICI link concurrently."""
     return [(i, (i - 1) % n) for i in range(n)]
+
+
+def mesh_device_kind(mesh: Mesh) -> str:
+    """The mesh's device kind — the RESOLVED compute devices' kind, which
+    is what `--matmul-impl auto` must route on (the default backend's
+    jax.devices()[0] can be a different platform than the mesh when
+    --device overrides it)."""
+    return next(iter(mesh.devices.flat)).device_kind

@@ -28,7 +28,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_matmul_bench.ops.matmul import matmul_2d
-from tpu_matmul_bench.parallel.mesh import sharded_normal, smap as _smap, world_size
+from tpu_matmul_bench.parallel.mesh import (
+    mesh_device_kind,
+    sharded_normal,
+    smap as _smap,
+    world_size,
+)
 from tpu_matmul_bench.parallel.quantized import (
     allgather_impl,
     comm_quant_extra,
@@ -273,7 +278,8 @@ def independent(config: BenchConfig, mesh: Mesh, size: int,
     total / (per-device · world) (reference `:313-315`).
     """
     d = world_size(mesh)
-    mm = matmul_2d(config.matmul_impl, config.blocks)
+    mm = matmul_2d(config.matmul_impl, config.blocks,
+                   mesh_device_kind(mesh))
     a, b = sharded_normal(config.seed, (d, size, size), config.dtype, mesh, P("x"))
     compute = _smap(
         _stacked_mm(mm),
@@ -320,7 +326,8 @@ def batch_parallel(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
     d = world_size(mesh)
     local_batch = max(batch // d, 1)
     g = local_batch * d
-    mm = matmul_2d(config.matmul_impl, config.blocks)
+    mm = matmul_2d(config.matmul_impl, config.blocks,
+                   mesh_device_kind(mesh))
     a, b = sharded_normal(config.seed, (g, size, size), config.dtype, mesh, P("x"))
     compute = _smap(
         _stacked_mm(mm),
@@ -402,7 +409,8 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
     (b,) = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
                           P(None, "x"), count=1)
 
-    mm = matmul_2d(config.matmul_impl, config.blocks)
+    mm = matmul_2d(config.matmul_impl, config.blocks,
+                   mesh_device_kind(mesh))
     # --comm-quant int8: the C-shard gather carries int8 + per-row scales
     # (the AG analogue of the gradient-sync modes' quantized psum)
     ag = allgather_impl(config.comm_quant)
@@ -456,7 +464,8 @@ def data_parallel(config: BenchConfig, mesh: Mesh, size: int,
     comm reported separately.
     """
     d = world_size(mesh)
-    mm = matmul_2d(config.matmul_impl, config.blocks)
+    mm = matmul_2d(config.matmul_impl, config.blocks,
+                   mesh_device_kind(mesh))
     a, b = sharded_normal(config.seed, (d, size, size), config.dtype, mesh, P("x"))
     compute = _smap(
         _stacked_mm(mm),
@@ -517,7 +526,8 @@ def model_parallel(config: BenchConfig, mesh: Mesh, size: int,
     (b,) = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
                           P("x", None), count=1)
 
-    partial_product = matmul_2d(config.matmul_impl, config.blocks)
+    partial_product = matmul_2d(config.matmul_impl, config.blocks,
+                                mesh_device_kind(mesh))
 
     compute = _smap(
         partial_product, mesh,

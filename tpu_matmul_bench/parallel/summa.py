@@ -42,6 +42,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_matmul_bench.ops.matmul import matmul_2d
+from tpu_matmul_bench.parallel.mesh import mesh_device_kind
 from tpu_matmul_bench.parallel.mesh import sharded_normal, smap
 from tpu_matmul_bench.parallel.modes import (
     ModeSetup,
@@ -110,7 +111,7 @@ def summa_programs(mesh: Mesh, impl: str = "xla",
     """(compute, full) shard_map programs for the SUMMA step on `mesh`."""
     r, c = mesh.shape["i"], mesh.shape["j"]
     s = math.lcm(r, c)
-    mm = matmul_2d(impl, blocks)
+    mm = matmul_2d(impl, blocks, mesh_device_kind(mesh))
     psum = psum_impl(comm_quant)
 
     def body(a_local, b_local, with_comm: bool):

@@ -148,8 +148,12 @@ def build_parser(
         help="Write JSON-lines results here ('-' for stdout)",
     )
     p.add_argument(
-        "--matmul-impl", type=str, default="xla", choices=["xla", "pallas"],
-        help="Matmul implementation: XLA jnp.matmul or the Pallas kernel",
+        "--matmul-impl", type=str, default="auto",
+        choices=["auto", "xla", "pallas"],
+        help="Matmul implementation: 'auto' (default) routes each "
+             "(dtype, shape) to the measured winner between XLA's dot and "
+             "the Pallas kernel (ops/impl_select.py, r4 head-to-head "
+             "artifacts); 'xla'/'pallas' force one.",
     )
     p.add_argument("--seed", type=int, default=0, help="PRNG seed for operand data")
     p.add_argument(

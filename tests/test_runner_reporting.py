@@ -96,3 +96,44 @@ def test_format_blocks_contain_reference_fields():
     assert "64x64" in size_preamble(64, "bfloat16")
     h = header("T", {"Devices": 2})
     assert "Configuration:" in h and "Devices: 2" in h
+
+
+def test_run_sizes_transport_errors_fail_fast():
+    # r5 multihost-race root cause: a Gloo 'Connection closed by peer'
+    # mid-collective was swallowed by the per-size OOM backstop, leaving
+    # a desynced cluster running and a CLEAN exit with no results. The
+    # runner must re-raise transport errors (cluster-fatal) while keeping
+    # OOM skip-and-continue (reference parity) and generic-error
+    # resilience.
+    from tpu_matmul_bench.benchmarks.runner import run_sizes
+    from tpu_matmul_bench.utils.config import parse_config
+
+    config = parse_config(["--sizes", "64", "128"], "d")
+
+    def boom_transport(size):
+        raise RuntimeError(
+            "Gloo allreduce failed: Connection closed by peer [127.0.0.1]")
+
+    with pytest.raises(RuntimeError, match="Connection closed by peer"):
+        run_sizes(config, boom_transport)
+
+    # OOM still skips and continues to the next size
+    calls = []
+
+    def oom_then_ok(size):
+        calls.append(size)
+        if size == 64:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+        return _rec(size=size)
+
+    recs = run_sizes(config, oom_then_ok)
+    assert calls == [64, 128] and [r.size for r in recs] == [128]
+
+    # generic errors keep per-size resilience too
+    def generic_then_ok(size):
+        if size == 64:
+            raise ValueError("some per-size failure")
+        return _rec(size=size)
+
+    recs = run_sizes(config, generic_then_ok)
+    assert [r.size for r in recs] == [128]

@@ -12,7 +12,11 @@ from typing import Callable, Iterable
 
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.device import apply_matmul_precision
-from tpu_matmul_bench.utils.errors import is_oom_error, release_device_memory
+from tpu_matmul_bench.utils.errors import (
+    is_oom_error,
+    is_transport_error,
+    release_device_memory,
+)
 from tpu_matmul_bench.utils.reporting import (
     BenchmarkRecord,
     JsonWriter,
@@ -63,6 +67,19 @@ def run_sizes(
             except Exception as e:  # noqa: BLE001 — per-size resilience
                 if is_oom_error(e):
                     report(f"\n  ERROR: Out of memory for {size}x{size} matrices")
+                elif is_transport_error(e):
+                    # r5 root-cause of the multihost "rc==0 with no
+                    # results" flake: a Gloo TCP pair dropping mid-
+                    # collective was swallowed here as if it were an OOM,
+                    # leaving a DESYNCED cluster running (the peer may
+                    # have completed the collective this process aborted)
+                    # and a clean exit with no results block. Transport
+                    # failures are cluster-fatal, not per-size: re-raise
+                    # so the run exits nonzero and the launcher retries
+                    # the whole cluster (the torchrun-elastic analogue).
+                    report(f"\n  FATAL: cluster transport failure at "
+                           f"{size}x{size}: {e}")
+                    raise
                 else:
                     report(f"\n  ERROR: {e}")
                     report(traceback.format_exc())

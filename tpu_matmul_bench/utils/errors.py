@@ -20,6 +20,33 @@ def is_oom_error(e: BaseException) -> bool:
     return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "out of memory" in msg
 
 
+# Distributed-transport failure signatures (jax's experimental CPU
+# collectives ride Gloo TCP pairs; ICI/DCN failures surface similar
+# strings). Kept to EXPLICIT transport phrases: a generic match (e.g.
+# bare "gloo", which also appears in startup/config errors) would
+# misclassify unrelated errors into the fail-fast path.
+_TRANSPORT_SIGNATURES = (
+    "Connection closed by peer",
+    "Connection reset by peer",
+    "Connection refused",
+    "Broken pipe",
+    "Socket closed",
+)
+
+
+def is_transport_error(e: BaseException) -> bool:
+    """A dropped cluster transport (e.g. Gloo 'Connection closed by peer'
+    mid-collective, observed under heavy host load — tests/test_multihost
+    r3/r4). UNLIKE OOM, this is not per-size recoverable: after a dropped
+    TCP pair the processes may have diverged (one caught the error while
+    its peer completed the collective), so every later collective on the
+    cluster risks deadlock or silent corruption. Callers must fail fast —
+    the launcher/harness retries the whole cluster cleanly (the torchrun-
+    elastic analogue), which is the only sound recovery unit."""
+    msg = str(e).lower()
+    return any(sig.lower() in msg for sig in _TRANSPORT_SIGNATURES)
+
+
 def release_device_memory(*arrays: object) -> None:
     """Drop operand references and collect, ≙ `torch.cuda.empty_cache()`
     between sizes (reference `matmul_scaling_benchmark.py:344`)."""

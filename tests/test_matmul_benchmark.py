@@ -161,7 +161,7 @@ def test_repeats_fused_builds_program_once(tmp_path, monkeypatch):
 
     builds, timed = [], []
 
-    def fake_fuse(fn, k):
+    def fake_fuse(fn, k, chain_state=None):
         builds.append(k)
         return lambda *a: None
 

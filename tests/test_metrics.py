@@ -126,3 +126,39 @@ def test_hbm_gbps_env_override(monkeypatch):
     assert hbm_bandwidth_gbps("TPU v4") == 1228.0  # no measured row: spec
     monkeypatch.delenv("TPU_BENCH_HBM_GBPS")
     assert hbm_bandwidth_gbps("unknown chip") is None
+
+
+def test_roofline_records_bandwidth_provenance():
+    # ADVICE r4: roofline_pct moved its denominator from the 819 spec to
+    # the measured 665 table (env-overridable) — every record that fills
+    # roofline_pct must also record the bandwidth that produced it, or
+    # artifacts from different eras/overrides are silently incomparable
+    from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+
+    def rec(**kw):
+        return BenchmarkRecord(
+            benchmark="matmul", mode="single", size=256, dtype="bfloat16",
+            world=1, iterations=2, warmup=1, avg_time_s=1e-5,
+            tflops_per_device=1.0, tflops_total=1.0,
+            device_kind="TPU v5 lite", **kw).finalize()
+
+    r = rec()
+    assert r.roofline_pct is not None
+    assert r.extras["roofline_bw_gbps"] == 665.0  # the measured table
+
+    import os
+    os.environ["TPU_BENCH_HBM_GBPS"] = "700"
+    try:
+        r2 = rec()
+        assert r2.extras["roofline_bw_gbps"] == 700.0  # override visible
+    finally:
+        del os.environ["TPU_BENCH_HBM_GBPS"]
+
+    # compute-bound sizes fill neither the pct nor the provenance
+    r3 = BenchmarkRecord(
+        benchmark="matmul", mode="single", size=16384, dtype="bfloat16",
+        world=1, iterations=2, warmup=1, avg_time_s=1.0,
+        tflops_per_device=1.0, tflops_total=1.0,
+        device_kind="TPU v5 lite").finalize()
+    assert r3.roofline_pct is None
+    assert "roofline_bw_gbps" not in r3.extras

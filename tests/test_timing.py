@@ -207,3 +207,33 @@ def test_time_fused_counts_fn_applications():
     # iterations counts fn applications: dispatches × fused length
     assert t.iterations >= 5 and t.iterations % 5 == 0
     assert t.total_s > 0
+
+
+def test_fused_timing_tags_unchained_fallback():
+    # ADVICE r4: on the CPU backend integer operands take the barrier-only
+    # fallback (the hoist-prone structure behind the 2613-TFLOPS bug);
+    # the Timing and the record extras must say so explicitly
+    import jax.numpy as jnp
+
+    from tpu_matmul_bench.utils.timing import protocol_extras, time_fused
+
+    a = jnp.ones((8, 8), jnp.int8)
+
+    def f(x, y):
+        return jnp.dot(x, y, preferred_element_type=jnp.int32)
+
+    t = time_fused(f, (a, a), iterations=3)
+    assert t.chain == "none"  # CPU int8: unchained fallback
+    assert protocol_extras("fused", t)["chain"] == "none"
+
+    # float operands chain normally and carry no warning tag
+    b = jnp.ones((8, 8), jnp.float32)
+    t2 = time_fused(lambda x, y: x @ y, (b, b), iterations=3)
+    assert t2.chain == "operand"
+    assert "chain" not in protocol_extras("fused", t2)
+
+    # dispatch timings never carry the field
+    from tpu_matmul_bench.utils.timing import time_jitted
+    t3 = time_jitted(lambda x, y: x @ y, (b, b), iterations=2, warmup=1)
+    assert t3.chain is None
+    assert "chain" not in protocol_extras("dispatch", t3)

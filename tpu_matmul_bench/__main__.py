@@ -26,8 +26,11 @@ _PROGRAMS = {
 }
 
 
-def main(argv: list[str] | None = None):
-    """Dispatch to a program's main(); returns its records list."""
+def main(argv: list[str] | None = None, _cli: bool = False):
+    """Dispatch to a program's main(); returns its records list. `_cli`
+    marks a real process entry (python -m / console script), where the
+    doctor probe takes its hard-exit path; in-process callers (tests,
+    tooling) always get normal return/SystemExit semantics."""
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help") or argv[0] not in _PROGRAMS:
         is_help = bool(argv) and argv[0] in ("-h", "--help")
@@ -39,13 +42,20 @@ def main(argv: list[str] | None = None):
     import importlib
 
     module = importlib.import_module(_PROGRAMS[argv[0]])
+    if argv[0] == "doctor" and _cli:
+        # the probe contract needs a hard exit (see doctor.cli_main):
+        # a dead-tunnel client thread must not hold the process open —
+        # and BOTH process spellings (`python -m tpu_matmul_bench` and
+        # the console script) must take this path
+        sys.argv = [sys.argv[0], *argv[1:]]
+        module.cli_main()
     return module.main(argv[1:])
 
 
 def script_main() -> None:
     """Console-script entry: discards main()'s records (setuptools wraps the
     entry point in sys.exit(), and a non-empty list must not become status 1)."""
-    main()
+    main(_cli=True)
 
 
 if __name__ == "__main__":

@@ -182,5 +182,28 @@ def main(argv: Sequence[str] | None = None) -> dict:
     return report
 
 
+def cli_main() -> None:
+    """CLI wrapper with a HARD exit: on a dead tunnel the axon client can
+    leave a non-daemon session-acquisition thread behind, and normal
+    interpreter shutdown then blocks joining it — observed r5: the
+    verdict printed in ~10 s but the process lingered the full probe
+    timeout, costing the watcher's gate its fast-fail path (and ending
+    in a SIGTERM on a client whose thread may hold a relay request).
+    os._exit after an explicit flush skips thread joins entirely.
+    In-process callers (tests, dryrun) use main()/run_doctor and keep
+    normal SystemExit semantics."""
+    import os
+    import sys
+
+    code = 0
+    try:
+        main()
+    except SystemExit as e:
+        code = int(e.code or 0)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(code)
+
+
 if __name__ == "__main__":
-    main()
+    cli_main()

@@ -97,6 +97,15 @@ class BenchmarkRecord:
                 # only when the HBM leg binds — in the compute-bound regime
                 # the roofline equals peak efficiency and adds nothing
                 self.roofline_pct = 100.0 * bounds[1] / self.avg_time_s
+                # provenance (ADVICE r4): the denominator changed from the
+                # 819 GB/s spec to the measured 665 table and is env-
+                # overridable — a roofline_pct without the bandwidth that
+                # produced it is incomparable across artifacts
+                from tpu_matmul_bench.utils.metrics import hbm_bandwidth_gbps
+
+                self.extras.setdefault(
+                    "roofline_bw_gbps",
+                    hbm_bandwidth_gbps(self.device_kind))
         return self
 
     def to_json(self) -> str:

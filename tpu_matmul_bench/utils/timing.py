@@ -67,6 +67,13 @@ class Timing:
     iterations: int
     sync_overhead_s: float = 0.0  # measured fixed barrier cost, for reporting
     reliable: bool = True  # False when device time never cleared the barrier noise
+    # fused protocol only: how the loop was serialized — "operand" (the
+    # hoist-proof data-dependence chain) or "none" (the barrier-only
+    # fallback, hoist-PRONE — taken for integer-only operands on the CPU
+    # backend). None for dispatch timings. ADVICE r4: a fused record
+    # produced without the serializing chain must self-describe instead
+    # of relying on the ceiling check alone.
+    chain: str | None = None
 
     @property
     def avg_s(self) -> float:
@@ -155,7 +162,8 @@ def time_jitted(
 
 
 def fuse_iterations(
-    fn: Callable[..., Any], iterations: int
+    fn: Callable[..., Any], iterations: int,
+    chain_state: dict | None = None,
 ) -> Callable[..., Any]:
     """One jitted program running `iterations` sequential calls of `fn`.
 
@@ -175,6 +183,12 @@ def fuse_iterations(
     data-dependent on the previous output: the op cannot be hoisted out of
     the loop (LICM) and the steps cannot be CSE-collapsed, so the
     `iterations` applications execute back-to-back on device.
+
+    `chain_state` (optional dict) is populated at trace time with
+    {"chain": "operand" | "none"} — how the loop was actually
+    serialized — so timers can stamp the decision into record extras
+    (the "none" fallback is hoist-prone and must be visible in the
+    artifact, not inferred from the backend).
 
     An `optimization_barrier` alone does NOT achieve this — barrier outputs
     are tied operand-wise to their own inputs, so `barrier((args, prev))[0]`
@@ -246,6 +260,8 @@ def fuse_iterations(
             ops, prev = carry
             chained, prev_b = lax.optimization_barrier((ops, prev))
             mixed, did_mix = _chain(chained, prev_b)
+            if chain_state is not None:  # trace-time: record the decision
+                chain_state["chain"] = "operand" if did_mix else "none"
             if did_mix:
                 return (mixed, fn(*mixed)), None
             # Nothing chainable (e.g. integer-only operands on the CPU
@@ -277,13 +293,15 @@ def time_fused(
     inherited from `time_jitted`, with each "dispatch" now a K-op program.
     """
     k = max(int(iterations), 1)
-    fused = fuse_iterations(fn, k)
+    chain_state: dict = {}
+    fused = fuse_iterations(fn, k, chain_state=chain_state)
     t = time_jitted(fused, args, iterations=1, warmup=1)
     return Timing(
         total_s=t.total_s,
         iterations=t.iterations * k,
         sync_overhead_s=t.sync_overhead_s,
         reliable=t.reliable,
+        chain=chain_state.get("chain"),
     )
 
 
@@ -299,6 +317,11 @@ def protocol_extras(timing: str, t: Timing) -> dict:
     extras: dict = {} if t.reliable else {"timing_reliable": False}
     if timing != "dispatch":
         extras["timing"] = timing
+    if t.chain == "none":
+        # the fused loop ran WITHOUT the serializing operand chain
+        # (integer-only operands on the CPU backend): hoist-prone — the
+        # record must say so rather than rely on the ceiling check
+        extras["chain"] = "none"
     return extras
 
 
@@ -335,9 +358,11 @@ def time_variants_n(
     per-op under either protocol.
     """
     k = 1
+    chain_states: list[dict] = [{} for _ in fns]
     if protocol == "fused":
         k = max(int(iterations), 1)
-        fns = [fuse_iterations(fn, k) for fn in fns]
+        fns = [fuse_iterations(fn, k, chain_state=st)
+               for fn, st in zip(fns, chain_states)]
         iterations = 1
         warmup = 1  # one fused call compiles AND runs a full K-op pass
     elif protocol != "dispatch":
@@ -353,10 +378,13 @@ def time_variants_n(
     for i in range(len(fns)):
         ts = sorted((row[i] for row in rounds), key=lambda t: t.avg_s)
         med = ts[len(ts) // 2]
-        if k > 1:
+        if protocol == "fused":  # k == 1 (iterations=1) still needs the
+            # chain tag — the hoist-prone "none" fallback must reach the
+            # record regardless of the fused length
             med = Timing(total_s=med.total_s, iterations=med.iterations * k,
                          sync_overhead_s=med.sync_overhead_s,
-                         reliable=med.reliable)
+                         reliable=med.reliable,
+                         chain=chain_states[i].get("chain"))
         out.append(med)
     return out
 

@@ -67,7 +67,11 @@ def main(paths):
         by_blocks: dict = {}
         for rec, path in pool:  # dedupe: one entry per blocking, best run
             e = rec["extras"]
-            k = (e["block_m"], e["block_n"], e["block_k"])
+            # the r5 structural axes are part of a candidate's identity:
+            # an nmk/ksplit run with the same (bm, bn, bk) is a DIFFERENT
+            # program and must not collapse with the plain-kernel row
+            k = (e["block_m"], e["block_n"], e["block_k"],
+                 e.get("grid_order", "mnk"), e.get("ksplit", 1))
             if (k not in by_blocks
                     or rec["tflops_total"]
                     > by_blocks[k][0]["tflops_total"]):
@@ -87,14 +91,38 @@ def main(paths):
             print(f"  TIE: confirm margin {ex['tie_margin_pct']}% is "
                   "inside run noise — re-run the head-to-head with more "
                   "--iterations before baking")
+        elif len(ranked) > 1:
+            # the tuner's flag only covers candidates confirmed in the
+            # SAME run; after cross-file dedup the top two may come from
+            # different runs, so recompute the margin here — a coin-flip
+            # ranking must never print a clean WINNER (ADVICE r4)
+            runner_up = ranked[1][0]
+            margin_pct = ((best["tflops_total"] - runner_up["tflops_total"])
+                          / best["tflops_total"] * 100.0)
+            if margin_pct < 1.0:
+                print(f"  TIE: top-2 margin {margin_pct:.2f}% (across "
+                      "runs/files) is inside the ±1.5% run noise — "
+                      "re-run the head-to-head interleaved before baking")
         for (rec, p), tag in zip(ranked[:3], ("WINNER", "2nd", "3rd")):
             e = rec["extras"]
             margin = ("" if rec is best else
                       f"  (-{(best['tflops_total'] - rec['tflops_total']) / best['tflops_total'] * 100:.1f}%)")
+            structural = "".join(
+                f" {k}={e[k]}" for k in ("grid_order", "ksplit") if k in e)
             print(f"  {tag:>6}: ({e['block_m']}, {e['block_n']}, "
-                  f"{e['block_k']})  {rec['tflops_total']:.2f} {unit}"
-                  f"{margin}")
-        if "^2" in shape and ":" not in shape:
+                  f"{e['block_k']}){structural}  "
+                  f"{rec['tflops_total']:.2f} {unit}{margin}")
+        if "grid_order" in ex or "ksplit" in ex:
+            # a structural-axis winner cannot be expressed as a plain
+            # table row — the tables carry (bm, bn, bk) only; replaying
+            # the number needs the kernel kwargs too
+            print(f"  bake → structural winner: pass "
+                  + " ".join(f"--{k.replace('_', '-')} {ex[k]}"
+                             for k in ("grid_order", "ksplit") if k in ex)
+                  + f" with --block-m/n/k {blocks} (no plain table row "
+                  f"reproduces this; extend the table schema before "
+                  f"baking)   # {best['tflops_total']:.2f} {unit}, {src}")
+        elif "^2" in shape and ":" not in shape:
             size = best["size"]
             print(f"  bake → _V5E_ROWS[{dtype!r}]: ({size}, {blocks!r})"
                   f"   # {best['tflops_total']:.2f} {unit}, {src}")

@@ -102,6 +102,21 @@ step int8_16k_rows_headtohead \
     --iterations 50 --timing fused \
     --candidates 2048,1024,2048 2048,2048,1024 \
     --json-out $R5/int8_16k_headtohead.jsonl || exit 1
+# VERDICT r4 #5: the structurally different tall-M angles the plain
+# sweeps never tried — N-major grid order and K-split two-pass
+# accumulation at the 28672x4096x8192 dual shape (XLA leads 192.19 vs
+# our 187.02). Done = a baked row >= 192 with provenance, or a
+# documented structural finding + `auto` keeps routing tall-M to XLA.
+step tune_rect_tallm_nmk \
+  python -m tpu_matmul_bench tune --mkn 28672 4096 8192 --dtype bfloat16 \
+    --iterations 20 --timing fused --grid-order nmk \
+    --candidates 4096,1024,512 2048,1024,512 4096,2048,512 2048,2048,512 4096,4096,512 \
+    --json-out $R5/tune_rect_tallm_nmk.jsonl || exit 1
+step tune_rect_tallm_ksplit \
+  python -m tpu_matmul_bench tune --mkn 28672 4096 8192 --dtype bfloat16 \
+    --iterations 20 --timing fused --ksplit 2 \
+    --candidates 4096,1024,512 4096,2048,512 2048,2048,512 \
+    --json-out $R5/tune_rect_tallm_ksplit.jsonl || exit 1
 step compare_16k_refresh \
   python -m tpu_matmul_bench.benchmarks.compare_benchmarks \
     --size 16384 --iterations 20 --warmup 5 --isolate \

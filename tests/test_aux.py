@@ -129,3 +129,65 @@ def test_bake_rows_surfaces_confirm_ties(tmp_path):
     assert out.returncode == 0, out.stderr
     assert "TIE: confirm margin 0.05%" in out.stdout
     assert "before baking" in out.stdout
+
+
+def test_bake_rows_recomputes_cross_file_tie(tmp_path):
+    # ADVICE r4: when the deduped top-2 come from DIFFERENT runs/files,
+    # no tuner tie flag exists — bake_rows must recompute the margin
+    # itself and refuse to print a clean WINNER for a coin flip
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    srcs = []
+    for i, (blocks, tflops) in enumerate(
+            (((2048, 1024, 2048), 365.1), ((1024, 1024, 2048), 364.2))):
+        src = tmp_path / f"sweep_{i}.jsonl"
+        src.write_text(json.dumps({
+            "benchmark": "tune", "mode": "pallas_tune", "size": 8192,
+            "dtype": "int8", "tflops_total": tflops,
+            "extras": {"block_m": blocks[0], "block_n": blocks[1],
+                       "block_k": blocks[2]}}) + "\n")
+        srcs.append(str(src))
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "bake_rows.py"), *srcs],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "TIE: top-2 margin 0.25%" in out.stdout
+    assert "before baking" in out.stdout
+
+
+def test_bake_rows_keeps_structural_axes_distinct(tmp_path):
+    # r5 structural sweeps: an nmk/ksplit record with the same blocks is a
+    # DIFFERENT program — it must not dedupe against the plain row, and a
+    # structural winner must not print a plain table-row bake line
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    src = tmp_path / "structural.jsonl"
+    with open(src, "w") as f:
+        f.write(json.dumps({
+            "benchmark": "tune", "mode": "pallas_tune", "size": 28672,
+            "dtype": "bfloat16", "tflops_total": 192.5,
+            "extras": {"block_m": 4096, "block_n": 1024, "block_k": 512,
+                       "grid_order": "nmk",
+                       "shape": "28672x4096x8192"}}) + "\n")
+        f.write(json.dumps({
+            "benchmark": "tune", "mode": "pallas_tune", "size": 28672,
+            "dtype": "bfloat16", "tflops_total": 187.0,
+            "extras": {"block_m": 4096, "block_n": 1024, "block_k": 512,
+                       "shape": "28672x4096x8192"}}) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "bake_rows.py"), str(src)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "2 candidates" in out.stdout       # no cross-axis collapse
+    assert "grid_order=nmk" in out.stdout     # winner names its axis
+    assert "structural winner" in out.stdout  # no plain-row bake line
+    assert "--grid-order nmk" in out.stdout
+    assert "_RECT_V5E_ROWS" not in out.stdout

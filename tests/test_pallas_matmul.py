@@ -146,3 +146,53 @@ def test_rect_row_keying():
             del pm._RECT_V5E_ROWS["bfloat16"]
         else:
             pm._RECT_V5E_ROWS["bfloat16"] = old
+
+
+def test_grid_order_nmk_matches_dense():
+    # r5 structural axis (VERDICT r4 #5): N-major output-tile order must
+    # compute the same product — only the HBM re-read pattern differs
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_matmul_bench.ops.pallas_matmul import pallas_matmul
+
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(rng.normal(size=(256, 512)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(512, 128)), jnp.float32)
+    want = np.asarray(a @ b)
+    got = np.asarray(pallas_matmul(a, b, block_m=128, block_n=64,
+                                   block_k=128, grid_order="nmk"))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="grid_order"):
+        pallas_matmul(a, b, grid_order="kmn")
+
+
+def test_ksplit_matches_dense_and_falls_back():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_matmul_bench.ops.pallas_matmul import pallas_matmul_ksplit
+
+    rng = np.random.default_rng(4)
+    a = jnp.asarray(rng.normal(size=(256, 512)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(512, 128)), jnp.float32)
+    want = np.asarray(a @ b)
+    got = np.asarray(pallas_matmul_ksplit(a, b, splits=2, block_m=128,
+                                          block_n=64, block_k=128))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+    # K=512 has no 128-aligned 3-way split → single-pass fallback
+    got = np.asarray(pallas_matmul_ksplit(a, b, splits=3))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+    # int8 keeps the int32 output contract through the split's fp32-free
+    # (int32) accumulation path
+    ai = jnp.asarray(rng.integers(-8, 8, size=(128, 256)), jnp.int8)
+    bi = jnp.asarray(rng.integers(-8, 8, size=(256, 128)), jnp.int8)
+    goti = pallas_matmul_ksplit(ai, bi, splits=2, block_m=128,
+                                block_n=128, block_k=128)
+    assert goti.dtype == jnp.int32
+    np.testing.assert_array_equal(
+        np.asarray(goti),
+        np.asarray(ai, np.int32) @ np.asarray(bi, np.int32))

@@ -300,3 +300,53 @@ def test_tune_confirm_disabled(tmp_path, capsys):
     ])
     assert "confirm pass" not in capsys.readouterr().out
     assert not [r for r in records if r.extras.get("confirm_pass")]
+
+
+def test_tune_structural_axes_cli(tmp_path):
+    # --grid-order / --ksplit: the r5 tall-M structural sweep axes must
+    # run end-to-end, validate, and stamp the records so a baked row
+    # knows the order/splits that produced it
+    import json
+
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    out = tmp_path / "tune.jsonl"
+    records = main(["--sizes", "256", "--iterations", "2", "--warmup", "1",
+                    "--dtype", "float32", "--num-devices", "1",
+                    "--candidates", "128,128,128", "64,64,128",
+                    "--grid-order", "nmk", "--ksplit", "2",
+                    "--validate", "--confirm-top", "2",
+                    "--json-out", str(out)])
+    assert records
+    recs = [json.loads(l) for l in out.read_text().splitlines()]
+    for rec in recs:
+        assert rec["extras"]["grid_order"] == "nmk"
+        assert rec["extras"]["ksplit"] == 2
+    assert any(r["extras"].get("confirm_pass") for r in recs)
+
+    # --ring rejects the plain-kernel-only axes
+    import pytest
+
+    with pytest.raises(SystemExit, match="cannot combine"):
+        main(["--ring", "pallas_ring_hbm", "--grid-order", "nmk"])
+
+
+def test_tune_ksplit_fallback_not_mislabeled(tmp_path):
+    # requested --ksplit with no 128-aligned equal split runs the plain
+    # kernel — records must NOT carry a ksplit tag (bake_rows would key
+    # them as a distinct program and attribute plain numbers to a
+    # structural one)
+    import json
+
+    from tpu_matmul_bench.benchmarks.pallas_tune import main
+
+    out = tmp_path / "tune.jsonl"
+    main(["--sizes", "256", "--iterations", "2", "--warmup", "1",
+          "--dtype", "float32", "--num-devices", "1",
+          "--candidates", "128,128,128",
+          "--ksplit", "3",  # 256 % 3 != 0 -> single-pass fallback
+          "--confirm-top", "0", "--json-out", str(out)])
+    recs = [json.loads(l) for l in out.read_text().splitlines()]
+    assert recs
+    for rec in recs:
+        assert "ksplit" not in rec["extras"], rec["extras"]

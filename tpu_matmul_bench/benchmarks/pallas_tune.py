@@ -32,8 +32,10 @@ from tpu_matmul_bench.models.workloads import (
     MatmulWorkload,
     RectMatmulWorkload,
 )
-from tpu_matmul_bench.ops.matmul import make_matmul
-from tpu_matmul_bench.ops.pallas_matmul import effective_blocks
+from tpu_matmul_bench.ops.pallas_matmul import (
+    effective_blocks,
+    effective_ksplit,
+)
 from tpu_matmul_bench.parallel.modes import (
     VALIDATION_CORNER,
     corner_validation,
@@ -84,6 +86,37 @@ DEFAULT_CANDIDATES = [
     (2048, 4096, 512),
     (4096, 2048, 512),
 ]
+
+
+def _candidate_fn(eff: tuple[int, int, int], grid_order: str = "mnk",
+                  ksplit: int = 1):
+    """A jitted candidate: the plain blocked kernel, optionally under an
+    alternative grid order and/or K-split multi-pass accumulation (the
+    r5 structural axes — ops/pallas_matmul.py)."""
+    from tpu_matmul_bench.ops.pallas_matmul import (
+        pallas_matmul,
+        pallas_matmul_ksplit,
+    )
+
+    bm, bn, bk = eff
+    if ksplit > 1:
+        return jax.jit(lambda a, b: pallas_matmul_ksplit(
+            a, b, splits=ksplit, block_m=bm, block_n=bn, block_k=bk,
+            grid_order=grid_order))
+    return jax.jit(lambda a, b: pallas_matmul(
+        a, b, block_m=bm, block_n=bn, block_k=bk, grid_order=grid_order))
+
+
+def _structural_extras(grid_order: str, ksplit: int) -> dict:
+    """Record extras for the non-default structural axes — a baked row
+    needs to know the order/splits that produced the number, not just
+    the blocking."""
+    out: dict = {}
+    if grid_order != "mnk":
+        out["grid_order"] = grid_order
+    if ksplit > 1:
+        out["ksplit"] = ksplit
+    return out
 
 
 def _parse_candidate(text: str) -> tuple[int, int, int]:
@@ -241,7 +274,25 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
              "resolved devices; combine with --wres on/off to A/B the "
              "W-resident mode)",
     )
+    parser.add_argument(
+        "--grid-order", type=str, default="mnk", choices=["mnk", "nmk"],
+        help="Output-tile iteration order for every candidate: mnk "
+             "(M slowest, default) or nmk (N slowest) — the orders differ "
+             "in which operand's HBM re-reads dominate; a structural "
+             "axis for rectangular shapes (plain-kernel sweep only)",
+    )
+    parser.add_argument(
+        "--ksplit", type=int, default=1,
+        help="K-split multi-pass accumulation: each candidate computes "
+             "C as the fp32 sum of N partial products over K/N-wide "
+             "slabs (pallas_matmul_ksplit; falls back to single-pass "
+             "when K has no 128-aligned equal split). Plain-kernel "
+             "sweep only; default 1 = single pass.",
+    )
     args = parser.parse_args(argv)
+    if args.ring and (args.grid_order != "mnk" or args.ksplit != 1):
+        raise SystemExit("--grid-order/--ksplit tune the plain kernel; "
+                         "they cannot combine with --ring")
     config = config_from_args(args)
     if args.ring and args.mkn:
         raise SystemExit("--ring tunes the square --sizes sweep; "
@@ -296,6 +347,14 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         for m, k, n in shapes:
             rect = not (m == k == n)
             label = f"{m}x{k}x{n}" if rect else str(m)
+            # label records with the split the kernel ACTUALLY uses — a
+            # 128-unaligned K falls back to single-pass, and a fallback
+            # run must not masquerade as a K-split program
+            eff_ks = effective_ksplit(k, args.ksplit)
+            if eff_ks != args.ksplit:
+                report(f"\n[{label}] note: --ksplit {args.ksplit} has no "
+                       f"128-aligned equal split of K={k} — running "
+                       "single-pass (records carry no ksplit tag)")
             wl = (RectMatmulWorkload(m, k, n, config.dtype, seed=config.seed)
                   if rect else
                   MatmulWorkload(m, config.dtype, seed=config.seed))
@@ -320,7 +379,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                     report(f"\n[{label}] compiling + timing bm={bm} bn={bn} "
                            f"bk={bk}{note} ...")
                     try:
-                        mm = make_matmul("pallas", eff)
+                        mm = _candidate_fn(eff, args.grid_order, args.ksplit)
                         verdict: dict = {}
                         if config.validate:  # a wrong blocking fails fast
                             c = min(VALIDATION_CORNER, m, n)
@@ -343,6 +402,8 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                     unit = throughput_unit(config.dtype)
                     report(f"  {tflops:.2f} {unit} ({t.avg_ms:.3f} ms)")
                     extras = {"block_m": bm, "block_n": bn, "block_k": bk,
+                              **_structural_extras(args.grid_order,
+                                                   eff_ks),
                               **protocol_extras(config.timing, t), **verdict}
                     if rect:
                         extras["shape"] = label
@@ -372,7 +433,8 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                         results = _confirm_top(
                             results, args.confirm_top, config, wl,
                             max(m, k, n), (a, b), label, info, jw,
-                            records, shape=label if rect else None)
+                            records, shape=label if rect else None,
+                            grid_order=args.grid_order, ksplit=eff_ks)
                 (bm, bn, bk), best = results[0]
                 report(f"\n[{label}] BEST: --block-m {bm} --block-n {bn} "
                        f"--block-k {bk}  ({best:.2f} "
@@ -381,7 +443,7 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
 
 
 def _confirm_top(results, top_n, config, wl, size, operands, label, info,
-                 jw, records, shape=None):
+                 jw, records, shape=None, grid_order="mnk", ksplit=1):
     """Interleaved confirm pass over the sweep's finalists: the sweep
     times candidates back-to-back, so drift (clock ramps, link health)
     between measurements can re-order close candidates; re-measuring the
@@ -391,7 +453,7 @@ def _confirm_top(results, top_n, config, wl, size, operands, label, info,
     finalists = results[:top_n]
     report(f"\n[{label}] confirm pass: top {len(finalists)} interleaved "
            "(median-of-3)")
-    fns = [make_matmul("pallas", eff) for eff, _ in finalists]
+    fns = [_candidate_fn(eff, grid_order, ksplit) for eff, _ in finalists]
     try:
         times = time_variants_n(
             fns, operands, iterations=config.iterations,
@@ -411,6 +473,7 @@ def _confirm_top(results, top_n, config, wl, size, operands, label, info,
                f"(sweep said {sweep_tflops:.2f})")
         extras = {"block_m": eff[0], "block_n": eff[1], "block_k": eff[2],
                   "confirm_pass": True,
+                  **_structural_extras(grid_order, ksplit),
                   **protocol_extras(config.timing, t)}
         if shape is not None:  # rect sweep: keep the MxKxN provenance
             # (the r4 rect confirm records read as "28672²" without it)

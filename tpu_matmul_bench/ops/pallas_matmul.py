@@ -232,7 +232,8 @@ def effective_blocks(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_m", "block_n", "block_k", "interpret")
+    jax.jit, static_argnames=("block_m", "block_n", "block_k", "interpret",
+                              "grid_order")
 )
 def pallas_matmul(
     a: jax.Array,
@@ -242,6 +243,7 @@ def pallas_matmul(
     block_n: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    grid_order: str = "mnk",
 ) -> jax.Array:
     """C = A @ B with a blocked Pallas kernel.
 
@@ -249,6 +251,14 @@ def pallas_matmul(
     pass explicit values (the --block-m/n/k flags) to override.
     `interpret=None` auto-selects interpreter mode off-TPU so the kernel is
     testable on the virtual CPU mesh (SURVEY §4 testing strategy).
+
+    `grid_order` picks the output-tile iteration order: "mnk" (default —
+    M slowest, so B's tile stream repeats M/bm times) or "nmk" (N slowest,
+    so A's stream repeats N/bn times). K stays innermost either way (the
+    accumulator scratch holds exactly one output tile). The orders differ
+    only in which operand's HBM re-reads dominate — a structural tuning
+    axis for rectangular problems (VERDICT r4 #5: tall-M shapes re-read
+    the big A under "mnk"-minor-j; "nmk" streams A once per column band).
     """
     if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
         raise ValueError(f"bad matmul shapes {a.shape} @ {b.shape}")
@@ -275,7 +285,7 @@ def pallas_matmul(
         out = pallas_matmul(
             pad_to(a, mp, kp), pad_to(b, kp, np_),
             block_m=block_m, block_n=block_n, block_k=block_k,
-            interpret=interpret,
+            interpret=interpret, grid_order=grid_order,
         )
         return out[:m, :n]
 
@@ -285,16 +295,28 @@ def pallas_matmul(
     out_dtype = matmul_out_dtype(jnp.promote_types(a.dtype, b.dtype))
     acc_dtype = matmul_acc_dtype(out_dtype)
 
-    grid = (m // bm, n // bn, k // bk)
+    if grid_order == "mnk":
+        grid = (m // bm, n // bn, k // bk)
+        a_map = lambda i, j, kk: (i, kk)      # noqa: E731
+        b_map = lambda i, j, kk: (kk, j)      # noqa: E731
+        o_map = lambda i, j, kk: (i, j)       # noqa: E731
+    elif grid_order == "nmk":
+        grid = (n // bn, m // bm, k // bk)
+        a_map = lambda j, i, kk: (i, kk)      # noqa: E731
+        b_map = lambda j, i, kk: (kk, j)      # noqa: E731
+        o_map = lambda j, i, kk: (i, j)       # noqa: E731
+    else:
+        raise ValueError(f"unknown grid_order {grid_order!r} "
+                         "(choose 'mnk' or 'nmk')")
     return pl.pallas_call(
         _matmul_kernel,
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((bm, bk), a_map),
+            pl.BlockSpec((bk, bn), b_map),
         ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_specs=pl.BlockSpec((bm, bn), o_map),
         scratch_shapes=[pltpu.VMEM((bm, bn), acc_dtype)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -310,3 +332,64 @@ def pallas_matmul(
         ),
         interpret=interpret,
     )(a, b)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("splits", "block_m", "block_n", "block_k",
+                              "interpret", "grid_order")
+)
+def pallas_matmul_ksplit(
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    splits: int = 2,
+    block_m: int | None = None,
+    block_n: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+    grid_order: str = "mnk",
+) -> jax.Array:
+    """K-split multi-pass accumulation: C = Σ_s A[:, Ks]·B[Ks, :].
+
+    The structurally different tall-M angle VERDICT r4 #5 asked for: each
+    pass solves an S×-narrower-K problem (smaller per-tile K sweep, a
+    different pipeline shape), and the partial products are summed in
+    fp32 outside the kernel before one downcast — the same accumulate-
+    high contract as the single-pass kernel, at the cost of S-1 extra
+    C-sized read-modify-writes of HBM traffic. Wins only where the
+    narrower K pass is enough faster to pay for that traffic; measured
+    via `tune --ksplit` and baked only with a JSONL artifact.
+    """
+    if splits < 1:
+        raise ValueError(f"splits must be >= 1, got {splits}")
+    k = a.shape[1]
+    if effective_ksplit(k, splits) == 1:
+        # no split (or no 128-aligned equal split exists): single pass
+        return pallas_matmul(a, b, block_m=block_m, block_n=block_n,
+                             block_k=block_k, interpret=interpret,
+                             grid_order=grid_order)
+    kc = k // splits
+    out_dtype = matmul_out_dtype(jnp.promote_types(a.dtype, b.dtype))
+    acc_dtype = matmul_acc_dtype(out_dtype)
+    acc = None
+    for s in range(splits):
+        part = pallas_matmul(
+            jax.lax.slice_in_dim(a, s * kc, (s + 1) * kc, axis=1),
+            jax.lax.slice_in_dim(b, s * kc, (s + 1) * kc, axis=0),
+            block_m=block_m, block_n=block_n, block_k=block_k,
+            interpret=interpret, grid_order=grid_order,
+        ).astype(acc_dtype)
+        acc = part if acc is None else acc + part
+    return acc.astype(out_dtype)
+
+
+def effective_ksplit(k: int, splits: int) -> int:
+    """The split count `pallas_matmul_ksplit` ACTUALLY uses for a K-dim of
+    `k`: `splits` when a 128-aligned equal split exists, else 1 (single-
+    pass fallback). Tooling that labels measurements (tune extras,
+    bake_rows keys) must use this, not the requested value — a fallback
+    run is the plain kernel and must not masquerade as a K-split program.
+    """
+    if splits <= 1 or k % splits or (k // splits) % 128:
+        return 1
+    return int(splits)

@@ -62,6 +62,17 @@ if [[ $CPU -eq 1 ]]; then
   export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=2"
   unset PALLAS_AXON_POOL_IPS || true
 fi
+# Persistent compile cache for every rank: Gloo's transport read timeout
+# is shorter than a heavy program's cold compile under load, so compile
+# SKEW between ranks can kill the collective one rank is already waiting
+# in (observed r5: 'Gloo ReduceScatter failed: Read timeout' on the
+# bidir-RS programs). A shared cache keeps ranks' compile times — and a
+# retried cluster's — in lockstep.
+# (uid-suffixed: a world-shared fixed path owned by another user would
+# silently disable the cache and bring the skew race back)
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache_multihost_$(id -u)}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-1}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 case "${MULTIHOST_PROGRAM:-scaling}" in
   scaling) MODULE=tpu_matmul_bench.benchmarks.matmul_scaling_benchmark ;;
@@ -91,8 +102,23 @@ fi
 echo "Running multi-process benchmark: $NPROCS processes, mode=${MODE}, dtype=${DTYPE}, coordinator=$COORD"
 WORKER_LOG_DIR=$(mktemp -d)
 PIDS=()
-# if rank 0 fails, don't orphan workers blocked in collectives
-trap 'kill ${PIDS[@]+"${PIDS[@]}"} 2>/dev/null || true' EXIT
+# if rank 0 fails, don't orphan workers blocked in collectives; a worker
+# stuck in a C++ Gloo read ignores TERM (signal handled only back in
+# Python), so follow up with KILL after a short grace — but only when a
+# worker actually survived the TERM (no unconditional 2s delay on every
+# exit)
+reap_workers() {
+  kill ${PIDS[@]+"${PIDS[@]}"} 2>/dev/null || true
+  local pid alive=0
+  for pid in ${PIDS[@]+"${PIDS[@]}"}; do
+    kill -0 "$pid" 2>/dev/null && alive=1
+  done
+  if [[ $alive -eq 1 ]]; then
+    sleep 2
+    kill -9 ${PIDS[@]+"${PIDS[@]}"} 2>/dev/null || true
+  fi
+}
+trap reap_workers EXIT
 for ((i=1; i<NPROCS; i++)); do
   JAX_PROCESS_ID=$i "${CMD[@]}" >"$WORKER_LOG_DIR/worker$i.log" 2>&1 &
   PIDS+=($!)

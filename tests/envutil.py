@@ -16,13 +16,23 @@ _AXON_VARS = ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
 def scrubbed_env(platforms: str | None = None,
                  device_count: int | None = None) -> dict[str, str]:
     """A copy of os.environ with the axon vars removed; optionally pin the
-    child to `platforms` (e.g. "cpu") and a forced host device count."""
+    child to `platforms` (e.g. "cpu") and a forced host device count.
+
+    Children also get a persistent JAX compilation cache: the multihost
+    Gloo race's compile-skew face (r5 soak) fires when one rank's cold
+    compile of a heavy program stalls past Gloo's transport read timeout
+    while its peer waits inside the collective — with a shared on-disk
+    cache, a failed cold attempt still populates the cache, so the
+    cluster-level retry runs warm and the ranks stay synchronized."""
     env = {k: v for k, v in os.environ.items() if k not in _AXON_VARS}
     if platforms is not None:
         env["JAX_PLATFORMS"] = platforms
     if device_count is not None:
         env["XLA_FLAGS"] = (
             f"--xla_force_host_platform_device_count={device_count}")
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   f"/tmp/jax_cache_tests_{os.getuid()}")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     return env
 
 

@@ -137,3 +137,21 @@ def test_run_sizes_transport_errors_fail_fast():
 
     recs = run_sizes(config, generic_then_ok)
     assert [r.size for r in recs] == [128]
+
+
+def test_transport_signatures_cover_gloo_op_failures():
+    # r5 soak find: the race's second face is 'Gloo ReduceScatter failed:
+    # ... Read timeout' (gloo/transport/tcp/buffer.cc) — the collective-
+    # failure prefix identifies transport errors regardless of cause
+    # wording, while gloo CONFIG errors stay in the resilient path
+    from tpu_matmul_bench.utils.errors import is_transport_error
+
+    assert is_transport_error(RuntimeError(
+        "INTERNAL: Error dispatching computation: Gloo ReduceScatter "
+        "failed: [external/gloo/gloo/transport/tcp/buffer.cc:72] "
+        "Read timeout [127.0.0.1]:61868"))
+    assert is_transport_error(RuntimeError(
+        "Gloo AllGather failed: Connection closed by peer"))
+    assert not is_transport_error(RuntimeError(
+        "gloo backend requires jax_cpu_collectives_implementation"))
+    assert not is_transport_error(RuntimeError("Read timeout"))  # bare

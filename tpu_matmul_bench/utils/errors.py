@@ -11,6 +11,7 @@ references (plus an optional live-array delete for eagerness).
 from __future__ import annotations
 
 import gc
+import re
 
 import jax
 
@@ -33,6 +34,13 @@ _TRANSPORT_SIGNATURES = (
     "Socket closed",
 )
 
+# A failed Gloo COLLECTIVE always reports as "Gloo <Op> failed: <cause>"
+# (observed causes: 'Connection closed by peer', 'Read timeout' —
+# r5 soak run 7, gloo/transport/tcp/buffer.cc). The prefix identifies a
+# transport-layer collective failure regardless of the cause wording,
+# while config errors ("gloo backend requires ...") never match it.
+_GLOO_OP_FAILED = re.compile(r"gloo \w+ failed", re.IGNORECASE)
+
 
 def is_transport_error(e: BaseException) -> bool:
     """A dropped cluster transport (e.g. Gloo 'Connection closed by peer'
@@ -44,7 +52,8 @@ def is_transport_error(e: BaseException) -> bool:
     the launcher/harness retries the whole cluster cleanly (the torchrun-
     elastic analogue), which is the only sound recovery unit."""
     msg = str(e).lower()
-    return any(sig.lower() in msg for sig in _TRANSPORT_SIGNATURES)
+    return (any(sig.lower() in msg for sig in _TRANSPORT_SIGNATURES)
+            or _GLOO_OP_FAILED.search(msg) is not None)
 
 
 def release_device_memory(*arrays: object) -> None:

@@ -41,7 +41,12 @@ def main(paths: list[str]) -> None:
             print(f"\n## {p} — no parseable records")
             continue
         print(f"\n## {p} ({len(recs)} records)")
-        recs.sort(key=lambda r: -(r.get("tflops_per_device") or 0))
+        # superseded records sink below everything else regardless of
+        # throughput — the first line must never read as a headline from
+        # a kernel the measurements say is dominated
+        recs.sort(key=lambda r: (
+            "superseded_by" in (r.get("extras") or {}),
+            -(r.get("tflops_per_device") or 0)))
         for r in recs:
             ex = r.get("extras") or {}
             shape = ex.get("shape") or f"{r.get('size')}²"
@@ -58,6 +63,15 @@ def main(paths: list[str]) -> None:
                 extra_bits += " [confirm]"
             if "tie_margin_pct" in ex:
                 extra_bits += f" [TIE {ex['tie_margin_pct']}%]"
+            for k in ("grid_order", "ksplit"):  # r5 structural axes
+                if k in ex:
+                    extra_bits += f" {k}={ex[k]}"
+            if "superseded_by" in ex:
+                # e.g. pallas_ring: kept for pedagogy/budget validation,
+                # dominated at every size — never read it as a headline
+                extra_bits += f" [SUPERSEDED by {ex['superseded_by']}]"
+            if "chain" in ex:
+                extra_bits += f" [chain={ex['chain']}: hoist-prone]"
             print(f"  {r.get('tflops_per_device', 0):8.2f} {unit:6} "
                   f"{shape:>18} {r.get('mode', ''):24} "
                   f"{str(blocks):>18} it={r.get('iterations')} "

@@ -129,6 +129,12 @@ def test_overlap_records(mesh, name):
     assert rec.avg_time_s > 0
     if name == "collective_matmul":
         assert "overlap_speedup_x" in rec.extras
+    if name == "pallas_ring":
+        # the dominated VMEM-resident kernel must be machine-visibly
+        # superseded so tooling never ranks it as a headline (VERDICT
+        # r4 #6; measured r4: 129.3 at its cap vs 186-194 for the HBM
+        # forms)
+        assert rec.extras["superseded_by"] == "pallas_ring_hbm"
     if name in ("overlap", "pipeline"):
         # ring/scan structure cost is reported on its own, NOT inside
         # comm_time_s (VERDICT r1 #7): comm = full − nocomm variant

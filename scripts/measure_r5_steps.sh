@@ -97,6 +97,20 @@ step headline_fused_xla \
     --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
     --num-devices 1 --timing fused --matmul-impl xla \
     --json-out $R5/headline_fused_xla.jsonl || exit 1
+# r5 `auto` routing on hardware: the DEFAULT config (no --matmul-impl)
+# must resolve to the measured winner and reproduce its number — bf16
+# 16k routes to the tuned Pallas kernel, int8 8k routes to XLA; the
+# records' matmul_impl_resolved/impl_provenance extras are the evidence
+step headline_auto \
+  python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+    --sizes 16384 --dtype bfloat16 --iterations 50 --warmup 10 \
+    --num-devices 1 --timing fused \
+    --json-out $R5/headline_auto.jsonl || exit 1
+step auto_int8_8k \
+  python -m tpu_matmul_bench.benchmarks.matmul_benchmark \
+    --sizes 8192 --dtype int8 --iterations 50 --warmup 10 \
+    --num-devices 1 --timing fused \
+    --json-out $R5/auto_int8_8k.jsonl || exit 1
 step int8_16k_rows_headtohead \
   python -m tpu_matmul_bench tune --sizes 16384 --dtype int8 \
     --iterations 50 --timing fused \

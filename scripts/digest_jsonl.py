@@ -41,6 +41,20 @@ def main(paths: list[str]) -> None:
             print(f"\n## {p} — no parseable records")
             continue
         print(f"\n## {p} ({len(recs)} records)")
+        # schema v2: a provenance manifest heads the file — summarize it,
+        # never rank it (it carries no measurement); pre-v2 round files
+        # (measurements/r2–r5) have none and digest byte-identically
+        manifests = [r for r in recs
+                     if r.get("record_type") == "manifest"]
+        recs = [r for r in recs if r.get("record_type") != "manifest"]
+        for m in manifests:
+            sha = (m.get("git_sha") or "?")[:9]
+            cfg = m.get("config") or {}
+            print(f"  [manifest] schema=v{m.get('schema_version')} "
+                  f"jax={m.get('jax_version')} "
+                  f"{m.get('device_count')}x{m.get('device_kind')} "
+                  f"git={sha} dtype={cfg.get('dtype')} "
+                  f"argv={' '.join(m.get('argv') or [])}")
         # superseded records sink below everything else regardless of
         # throughput — the first line must never read as a headline from
         # a kernel the measurements say is dominated
@@ -52,7 +66,8 @@ def main(paths: list[str]) -> None:
             shape = ex.get("shape") or f"{r.get('size')}²"
             blocks = ""
             if "block_m" in ex:  # tuner records carry the blocking
-                blocks = f"({ex['block_m']},{ex['block_n']},{ex['block_k']})"
+                blocks = (f"({ex.get('block_m')},{ex.get('block_n')},"
+                          f"{ex.get('block_k')})")
             unit = ex.get("throughput_unit", "TFLOPS")
             extra_bits = " ".join(
                 f"{k}={ex[k]}" for k in
@@ -72,7 +87,16 @@ def main(paths: list[str]) -> None:
                 extra_bits += f" [SUPERSEDED by {ex['superseded_by']}]"
             if "chain" in ex:
                 extra_bits += f" [chain={ex['chain']}: hoist-prone]"
-            print(f"  {r.get('tflops_per_device', 0):8.2f} {unit:6} "
+            smp = ex.get("samples")
+            if isinstance(smp, dict):  # schema v2 per-iteration sampling
+                extra_bits += (f" p50={smp.get('p50_ms')} "
+                               f"p95={smp.get('p95_ms')} "
+                               f"p99={smp.get('p99_ms')} "
+                               f"sd={smp.get('stddev_ms')}ms")
+                if smp.get("warmup_drift"):
+                    extra_bits += (" [WARMUP DRIFT "
+                                   f"{smp.get('warmup_drift_pct')}%]")
+            print(f"  {r.get('tflops_per_device') or 0:8.2f} {unit:6} "
                   f"{shape:>18} {r.get('mode', ''):24} "
                   f"{str(blocks):>18} it={r.get('iterations')} "
                   f"{extra_bits}")

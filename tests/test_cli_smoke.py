@@ -1,0 +1,86 @@
+"""End-to-end smoke of the telemetry path (tier-1, CPU, slow-unmarked):
+`python -m tpu_matmul_bench matmul --sizes 64 --iterations 2 --json-out -
+--trace-out -` must emit a JSONL stream headed by a provenance manifest
+AND a Chrome trace whose spans nest correctly — so the run-ledger path
+can't silently rot while the TPU rounds lean on it.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from tests.envutil import scrubbed_env
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _spans_nest(events):
+    """Complete ('X') events nest iff every pair is disjoint or contained."""
+    iv = [(e["ts"], e["ts"] + e["dur"]) for e in events]
+    for i, (s1, e1) in enumerate(iv):
+        for s2, e2 in iv[i + 1:]:
+            disjoint = e1 <= s2 or e2 <= s1
+            contained = (s1 <= s2 and e2 <= e1) or (s2 <= s1 and e1 <= e2)
+            if not (disjoint or contained):
+                return False
+    return True
+
+
+def test_cli_matmul_trace_and_manifest_end_to_end():
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu_matmul_bench", "matmul",
+         "--sizes", "64", "--iterations", "2", "--warmup", "1",
+         "--samples", "--json-out", "-", "--trace-out", "-"],
+        env=scrubbed_env(platforms="cpu", device_count=1),
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+
+    parsed = []
+    for line in out.stdout.splitlines():
+        try:
+            parsed.append(json.loads(line))
+        except ValueError:
+            continue  # human report lines share stdout
+    manifests = [d for d in parsed
+                 if isinstance(d, dict)
+                 and d.get("record_type") == "manifest"]
+    records = [d for d in parsed
+               if isinstance(d, dict) and d.get("benchmark") == "matmul"]
+    traces = [d for d in parsed
+              if isinstance(d, dict) and "traceEvents" in d]
+    assert len(manifests) == 1 and len(records) == 1 and len(traces) == 1
+
+    m = manifests[0]
+    assert m["schema_version"] >= 2
+    assert m["device_kind"] and m["device_count"] >= 1
+    assert any("--trace-out" in a for a in m["argv"])
+    assert m.get("git_sha") is None or len(m["git_sha"]) == 40
+    assert m["artifacts"]["chrome_trace"] == "-"
+
+    # the JSONL stream begins with the manifest
+    assert parsed.index(m) < parsed.index(records[0])
+
+    events = traces[0]["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"compile", "warmup", "sync-calibrate", "measure",
+            "size:64"} <= names
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+    assert _spans_nest(events)
+    # phase spans sit inside the per-size span
+    size_span = next(e for e in events if e["name"] == "size:64")
+    measure = next(e for e in events if e["name"] == "measure")
+    assert size_span["ts"] <= measure["ts"]
+    assert measure["ts"] + measure["dur"] <= (
+        size_span["ts"] + size_span["dur"] + 1e-6)
+
+    # per-iteration sampling rode along (--samples)
+    samples = records[0]["extras"]["samples"]
+    for key in ("p50_ms", "p95_ms", "p99_ms", "stddev_ms",
+                "warmup_drift"):
+        assert key in samples
+    assert samples["n"] == 2
+
+    # stdout phase summary accompanied the trace
+    assert "phase summary" in out.stdout

@@ -22,6 +22,8 @@ def test_compare_small(tmp_path):
                 "pallas_ring", "single_float32", "single_bfloat16"}
     assert expected <= set(results)
     lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert lines[0]["record_type"] == "manifest"  # schema-v2 header
+    lines = lines[1:]
     assert {l["comparison_key"] for l in lines} >= expected
     assert all(l["tflops_total"] > 0 for l in lines)
 

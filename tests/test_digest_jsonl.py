@@ -1,0 +1,92 @@
+"""Tests for scripts/digest_jsonl.py under the schema-v2 run ledger:
+manifest headers are summarized (never ranked), records missing optional
+fields digest without KeyError, and the new percentile/jitter columns
+appear only for records that carry extras["samples"] — so pre-v2 round
+files (measurements/r2–r5) digest byte-identically.
+"""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location(
+    "digest_jsonl", REPO / "scripts" / "digest_jsonl.py")
+digest = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(digest)
+
+
+def _write(tmp_path, name, records):
+    p = tmp_path / name
+    p.write_text("".join(json.dumps(r) + "\n" for r in records))
+    return p
+
+
+def test_manifest_is_summarized_not_ranked(tmp_path, capsys):
+    p = _write(tmp_path, "run.jsonl", [
+        {"record_type": "manifest", "schema_version": 2,
+         "jax_version": "0.4.37", "device_count": 8,
+         "device_kind": "cpu", "git_sha": "deadbeefcafe0123",
+         "argv": ["prog", "--sizes", "64"],
+         "config": {"dtype": "bfloat16"}},
+        {"benchmark": "matmul", "mode": "single", "size": 64,
+         "iterations": 3, "tflops_per_device": 1.5, "extras": {}},
+    ])
+    digest.main([str(p)])
+    out = capsys.readouterr().out
+    assert "(2 records)" in out
+    assert "[manifest] schema=v2 jax=0.4.37 8xcpu git=deadbeefc" in out
+    assert "argv=prog --sizes 64" in out
+    # the manifest line precedes the ranked rows and is not a throughput row
+    lines = out.splitlines()
+    assert lines.index(next(l for l in lines if "[manifest]" in l)) < \
+        lines.index(next(l for l in lines if "1.50" in l))
+
+
+def test_missing_optional_fields_never_keyerror(tmp_path, capsys):
+    p = _write(tmp_path, "sparse.jsonl", [
+        {"benchmark": "x"},  # nearly empty record
+        {"mode": "m", "size": 8, "extras": None},
+        {"size": 16, "extras": {"block_m": 128}},  # partial blocking
+        {"tflops_per_device": None, "busbw_gbps": None,
+         "roofline_pct": None},
+    ])
+    digest.main([str(p)])  # must not raise
+    assert "(4 records)" in capsys.readouterr().out
+
+
+def test_samples_columns_and_drift_flag(tmp_path, capsys):
+    smp = {"p50_ms": 1.2, "p95_ms": 1.5, "p99_ms": 1.9,
+           "stddev_ms": 0.2, "warmup_drift": True,
+           "warmup_drift_pct": 25.0}
+    p = _write(tmp_path, "s.jsonl", [
+        {"benchmark": "matmul", "mode": "single", "size": 64,
+         "tflops_per_device": 2.0, "extras": {"samples": smp}},
+        {"benchmark": "matmul", "mode": "single", "size": 128,
+         "tflops_per_device": 1.0, "extras": {}},
+    ])
+    digest.main([str(p)])
+    out = capsys.readouterr().out
+    with_samples = next(l for l in out.splitlines() if "p50=" in l)
+    assert "p95=1.5" in with_samples and "p99=1.9" in with_samples
+    assert "sd=0.2ms" in with_samples
+    assert "[WARMUP DRIFT 25.0%]" in with_samples
+    # the sample-less record gets no percentile columns
+    assert sum("p50=" in l for l in out.splitlines()) == 1
+
+
+@pytest.mark.parametrize("round_dir", ["r2", "r3", "r4", "r5"])
+def test_pre_v2_round_files_still_digest(round_dir, capsys):
+    """Compat check: the hand-measured round files (no manifest, no
+    samples) digest with every record parsed and no new columns."""
+    d = REPO / "measurements" / round_dir
+    if not d.is_dir() or not list(d.glob("*.jsonl")):
+        pytest.skip(f"{d} has no JSONL files")
+    digest.main([str(d)])
+    out = capsys.readouterr().out
+    assert "records)" in out
+    assert "[manifest]" not in out and "p50=" not in out

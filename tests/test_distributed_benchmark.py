@@ -16,7 +16,7 @@ def test_distributed_cli(mode, tmp_path, capsys):
     out = capsys.readouterr().out
     assert f"Results for 64x64 [{mode}]" in out
     assert len(records) == 1 and records[0].mode == mode
-    rec = json.loads(out_path.read_text())
+    rec = json.loads(out_path.read_text().splitlines()[-1])
     assert rec["benchmark"] == "distributed" and rec["world"] == 8
 
 

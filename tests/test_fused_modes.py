@@ -97,7 +97,7 @@ def test_collective_benchmark_fused(tmp_path):
     assert rec.extras["timing"] == "fused"
     assert rec.extras["validation"] == "ok"
     assert rec.algbw_gbps > 0
-    parsed = json.loads((tmp_path / "c.jsonl").read_text().splitlines()[0])
+    parsed = json.loads((tmp_path / "c.jsonl").read_text().splitlines()[-1])
     assert parsed["extras"]["timing"] == "fused"
 
 

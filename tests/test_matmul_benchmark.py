@@ -21,9 +21,11 @@ def test_single_device(tmp_path):
     assert [r.size for r in recs] == [64, 128]
     assert all(r.world == 1 for r in recs)
     assert all(r.tflops_total > 0 for r in recs)
-    lines = (tmp_path / "out.jsonl").read_text().splitlines()
-    assert len(lines) == 2
-    parsed = json.loads(lines[0])
+    lines = [json.loads(l)
+             for l in (tmp_path / "out.jsonl").read_text().splitlines()]
+    assert lines[0]["record_type"] == "manifest"  # schema-v2 header
+    assert len(lines) == 3
+    parsed = lines[1]
     assert parsed["benchmark"] == "matmul"
     assert parsed["mode"] == "single"
 
@@ -68,7 +70,7 @@ def test_mkn_rectangular(tmp_path):
     assert rec.extras["shape"] == "96x256x160"
     assert rec.extras["validation"] == "ok"
     assert rec.roofline_pct is None  # square-only metric
-    saved = json.loads(out.read_text())
+    saved = json.loads(out.read_text().splitlines()[-1])
     assert saved["flops_per_op"] == rec.flops_per_op
 
 

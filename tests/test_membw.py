@@ -17,6 +17,8 @@ def test_membw_records(tmp_path):
         assert r.algbw_gbps and r.algbw_gbps > 0
         assert r.tflops_total == 0.0  # bandwidth, not FLOPs
     lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert lines[0]["record_type"] == "manifest"  # schema-v2 header
+    lines = lines[1:]
     assert len(lines) == len(recs)
     # STREAM byte conventions: copy/scale/dot move 2 arrays, add/triad 3
     per = 128 * 128 * 4

@@ -42,8 +42,10 @@ def test_run_sizes_skips_failures_and_continues(tmp_path):
     records = run_sizes(config, bench_one)
     assert seen == [32, 64, 128]  # failure did not stop the sweep (≙ I7)
     assert [r.size for r in records] == [32, 128]
-    lines = (tmp_path / "o.jsonl").read_text().splitlines()
-    assert [json.loads(l)["size"] for l in lines] == [32, 128]
+    lines = [json.loads(l)
+             for l in (tmp_path / "o.jsonl").read_text().splitlines()]
+    assert lines[0]["record_type"] == "manifest"  # schema-v2 header
+    assert [l["size"] for l in lines[1:]] == [32, 128]
 
 
 def test_run_sizes_preflight_memory_guard():

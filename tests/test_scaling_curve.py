@@ -18,6 +18,8 @@ def test_curve_sweeps_device_counts(tmp_path):
          "--markdown-out", str(md), "--json-out", str(out)])
     assert [r.world for r in recs] == [1, 2, 4]
     lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert lines[0]["record_type"] == "manifest"  # schema-v2 header
+    lines = lines[1:]
     assert [l["extras"]["curve_devices"] for l in lines] == [1, 2, 4]
     # multi-device independent rows carry scaling vs the measured 1-device
     # baseline (the README table's third column)

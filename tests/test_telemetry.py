@@ -1,0 +1,256 @@
+"""Tests for the run-ledger telemetry subsystem (utils/telemetry.py):
+span nesting + Chrome-trace shape, sample-distribution math, the
+warmup-drift flag, the provenance manifest, and the JsonWriter header.
+All CPU-only and fast (tier-1).
+"""
+
+import json
+import time
+
+import pytest
+
+from tpu_matmul_bench.utils import telemetry
+from tpu_matmul_bench.utils.config import parse_config
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord, JsonWriter
+from tpu_matmul_bench.utils.timing import sample_stats
+
+
+@pytest.fixture(autouse=True)
+def _clean_artifacts():
+    telemetry.reset_artifacts()
+    yield
+    telemetry.reset_artifacts()
+
+
+def _rec(**kw):
+    base = dict(
+        benchmark="t", mode="m", size=64, dtype="bfloat16", world=1,
+        iterations=3, warmup=1, avg_time_s=0.01, tflops_per_device=1.0,
+        tflops_total=1.0,
+    )
+    base.update(kw)
+    return BenchmarkRecord(**base)
+
+
+# ---------------------------------------------------------------- spans
+
+def test_span_nesting_and_chrome_trace_shape():
+    tr = telemetry.SpanTracker()
+    with tr.span("outer", size=64):
+        with tr.span("inner"):
+            time.sleep(0.002)
+        with tr.span("inner"):
+            pass
+    trace = tr.to_chrome_trace()
+    assert trace["displayTimeUnit"] == "ms"
+    events = trace["traceEvents"]
+    assert [e["name"] for e in events] == ["outer", "inner", "inner"]
+    # complete events on one pid/tid — viewers nest by containment
+    assert all(e["ph"] == "X" for e in events)
+    assert len({(e["pid"], e["tid"]) for e in events}) == 1
+    outer, first_inner = events[0], events[1]
+    assert outer["args"] == {"size": 64}
+    # ts/dur are µs; each inner interval lies inside the outer interval
+    for inner in events[1:]:
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-6
+    assert first_inner["dur"] >= 1e3  # the 2 ms sleep, in µs
+    # the whole structure is JSON-serializable (the --trace-out payload)
+    json.dumps(trace)
+
+
+def test_span_depth_and_close_time_args():
+    tr = telemetry.SpanTracker()
+    with tr.span("measure") as meta:
+        meta["iterations"] = 40
+    (ev,) = tr.events
+    assert ev.depth == 0
+    assert ev.args == {"iterations": 40}
+
+
+def test_module_span_is_noop_without_session():
+    assert telemetry.current_tracker() is None
+    with telemetry.span("orphan") as meta:
+        meta["x"] = 1  # writable even when discarded
+    assert telemetry.current_tracker() is None
+
+
+def test_session_writes_trace_and_summary(tmp_path, capsys):
+    out = tmp_path / "trace.json"
+    with telemetry.session(str(out)) as tr:
+        assert telemetry.current_tracker() is tr
+        with telemetry.span("compile"):
+            pass
+    assert telemetry.current_tracker() is None
+    trace = json.loads(out.read_text())
+    assert [e["name"] for e in trace["traceEvents"]] == ["compile"]
+    text = capsys.readouterr().out
+    assert "chrome trace written" in text
+    assert "phase summary" in text and "compile" in text
+
+
+def test_session_noop_and_reentrant(tmp_path):
+    with telemetry.session(None) as tr:
+        assert tr is None
+    out = tmp_path / "t.json"
+    with telemetry.session(str(out)) as outer:
+        # an in-process child run (scaling_curve → scaling.run) must not
+        # steal or rewrite the outer session's trace
+        with telemetry.session(str(tmp_path / "other.json")) as inner:
+            assert inner is outer
+        assert telemetry.current_tracker() is outer
+    assert out.exists()
+    assert not (tmp_path / "other.json").exists()
+
+
+# ------------------------------------------------------- sample stats
+
+def test_sample_stats_percentile_math():
+    import numpy as np
+
+    samples_s = [i / 1000.0 for i in range(1, 101)]  # 1..100 ms, flat
+    st = sample_stats(samples_s)
+    assert st["n"] == 100
+    assert st["p50_ms"] == pytest.approx(np.percentile(range(1, 101), 50))
+    assert st["p95_ms"] == pytest.approx(np.percentile(range(1, 101), 95))
+    assert st["p99_ms"] == pytest.approx(np.percentile(range(1, 101), 99))
+    assert st["stddev_ms"] == pytest.approx(float(np.std(range(1, 101))),
+                                            abs=1e-3)
+    assert st["min_ms"] == 1.0 and st["max_ms"] == 100.0
+
+
+def test_warmup_drift_flag_fires_on_slow_start():
+    # first quartile ~2x the last: warmup did not absorb the ramp
+    drifting = [0.020] * 5 + [0.010] * 15
+    st = sample_stats(drifting)
+    assert st["warmup_drift"] is True
+    assert st["warmup_drift_pct"] > telemetry.WARMUP_DRIFT_THRESHOLD_PCT
+
+
+def test_warmup_drift_flag_quiet_on_flat_distribution():
+    st = sample_stats([0.010] * 20)
+    assert st["warmup_drift"] is False
+    assert st["warmup_drift_pct"] == pytest.approx(0.0)
+    # a FAST start (drift negative) is jitter, not warmup residue
+    st = sample_stats([0.010] * 5 + [0.020] * 15)
+    assert st["warmup_drift"] is False
+
+
+def test_sample_stats_rejects_empty():
+    with pytest.raises(ValueError):
+        sample_stats([])
+
+
+# ------------------------------------------------------------ manifest
+
+def test_manifest_contents(monkeypatch):
+    monkeypatch.setattr(telemetry, "git_sha", lambda: "deadbeefcafe")
+    config = parse_config(
+        ["--sizes", "64", "--dtype", "float32", "--precision", "highest"],
+        "t")
+    m = telemetry.build_manifest(config, argv=["prog", "--sizes", "64"])
+    assert m["record_type"] == "manifest"
+    assert m["schema_version"] == telemetry.SCHEMA_VERSION
+    assert m["git_sha"] == "deadbeefcafe"
+    assert m["argv"] == ["prog", "--sizes", "64"]
+    assert m["device_count"] == 8  # the virtual CPU test mesh
+    assert m["backend"] == "cpu"
+    assert m["mesh_shape"] == [8]
+    assert m["config"]["dtype"] == "float32"
+    assert m["config"]["precision"] == "highest"
+    assert m["jax_version"]
+    assert telemetry.is_manifest(m)
+    assert not telemetry.is_manifest(json.loads(_rec().to_json()))
+    json.dumps(m)  # must be a pure-JSON record
+
+
+def test_manifest_without_config_and_real_git_sha():
+    m = telemetry.build_manifest()
+    assert "config" not in m
+    sha = m["git_sha"]  # this repo IS a git checkout
+    assert sha is None or (len(sha) == 40 and set(sha) <= set("0123456789abcdef"))
+
+
+def test_manifest_cross_references_artifacts(monkeypatch):
+    telemetry.note_artifact("profiler_trace_dir", "/tmp/prof")
+    telemetry.note_artifact("chrome_trace", "/tmp/t.json")
+    m = telemetry.build_manifest()
+    assert m["artifacts"] == {"profiler_trace_dir": "/tmp/prof",
+                              "chrome_trace": "/tmp/t.json"}
+
+
+def test_maybe_trace_notes_profiler_artifact(monkeypatch, tmp_path):
+    import contextlib
+
+    import jax
+
+    from tpu_matmul_bench.utils.profiling import maybe_trace
+
+    monkeypatch.setattr(jax.profiler, "trace",
+                        lambda _d: contextlib.nullcontext())
+    with maybe_trace(str(tmp_path / "prof")):
+        assert telemetry.artifacts()["profiler_trace_dir"] == (
+            str(tmp_path / "prof"))
+
+
+# ------------------------------------------------- JsonWriter + header
+
+def test_jsonwriter_writes_manifest_header(tmp_path, monkeypatch):
+    monkeypatch.setattr(telemetry, "git_sha", lambda: "abc123")
+    path = tmp_path / "out.jsonl"
+    with JsonWriter(str(path), manifest=telemetry.build_manifest()) as jw:
+        jw.write(_rec())
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) == 2
+    assert telemetry.is_manifest(lines[0])
+    assert lines[0]["git_sha"] == "abc123"
+    assert lines[1]["benchmark"] == "t"
+
+
+def test_jsonwriter_durability_flush_and_fsync(tmp_path, monkeypatch):
+    """A killed run must leave a readable partial JSONL: every record is
+    visible on disk BEFORE close(), and fsync is invoked per line."""
+    import os
+
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: (synced.append(fd), real_fsync(fd))[1])
+    path = tmp_path / "out.jsonl"
+    jw = JsonWriter(str(path), manifest=telemetry.build_manifest())
+    jw.write(_rec(size=1))
+    jw.write(_rec(size=2))
+    # read back while the writer is still open — simulates the artifact
+    # state an OOM-killed run leaves behind
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [l.get("size") for l in lines] == [None, 1, 2]
+    assert len(synced) == 3  # manifest + 2 records
+    jw.close()
+
+
+def test_jsonwriter_stdout_fsync_is_safe(capsys):
+    # '-' targets a captured/pipe stream: fsync must degrade to flush,
+    # never raise
+    with JsonWriter("-", manifest=telemetry.build_manifest()) as jw:
+        jw.write(_rec())
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert telemetry.is_manifest(lines[0]) and lines[1]["benchmark"] == "t"
+
+
+def test_runner_emits_manifest_and_size_spans(tmp_path):
+    from tpu_matmul_bench.benchmarks.runner import run_sizes
+
+    out = tmp_path / "o.jsonl"
+    config = parse_config(
+        ["--sizes", "32", "64", "--json-out", str(out)], "t")
+    with telemetry.session(str(tmp_path / "trace.json")):
+        run_sizes(config, lambda size: _rec(size=size))
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert telemetry.is_manifest(lines[0])
+    # the manifest cross-references the trace written by the same run
+    assert lines[0]["artifacts"]["chrome_trace"] == (
+        str(tmp_path / "trace.json"))
+    assert [l["size"] for l in lines[1:]] == [32, 64]
+    trace = json.loads((tmp_path / "trace.json").read_text())
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "size:32" in names and "size:64" in names

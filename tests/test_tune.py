@@ -1,5 +1,7 @@
 """Tests for the Pallas block tuner and the --block-m/n/k plumbing."""
 
+import json
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -104,7 +106,8 @@ def test_tune_cli_end_to_end(tmp_path, capsys):
     assert {tuple(r.extras[k] for k in ("block_m", "block_n", "block_k"))
             for r in records} == {(32, 32, 32), (64, 64, 64)}
     lines = (tmp_path / "tune.jsonl").read_text().splitlines()
-    assert len(lines) == 2
+    assert len(lines) == 3  # manifest header + 2 candidate records
+    assert json.loads(lines[0])["record_type"] == "manifest"
 
 
 def test_tune_rejects_bad_candidate():
@@ -134,7 +137,7 @@ def test_tune_ring_end_to_end(tmp_path, capsys):
         assert r.extras["ring"] == "pallas_ring_hbm"
         assert r.extras["validation"] == "ok"
     lines = (tmp_path / "ringtune.jsonl").read_text().splitlines()
-    assert len(lines) == 2
+    assert len(lines) == 3  # manifest header + 2 candidate records
 
 
 def test_tune_ring_rejects_mkn():
@@ -318,7 +321,8 @@ def test_tune_structural_axes_cli(tmp_path):
                     "--validate", "--confirm-top", "2",
                     "--json-out", str(out)])
     assert records
-    recs = [json.loads(l) for l in out.read_text().splitlines()]
+    recs = [json.loads(l) for l in out.read_text().splitlines()
+            if l and json.loads(l).get("record_type") != "manifest"]
     for rec in recs:
         assert rec["extras"]["grid_order"] == "nmk"
         assert rec["extras"]["ksplit"] == 2
@@ -346,7 +350,8 @@ def test_tune_ksplit_fallback_not_mislabeled(tmp_path):
           "--candidates", "128,128,128",
           "--ksplit", "3",  # 256 % 3 != 0 -> single-pass fallback
           "--confirm-top", "0", "--json-out", str(out)])
-    recs = [json.loads(l) for l in out.read_text().splitlines()]
+    recs = [json.loads(l) for l in out.read_text().splitlines()
+            if json.loads(l).get("record_type") != "manifest"]
     assert recs
     for rec in recs:
         assert "ksplit" not in rec["extras"], rec["extras"]

@@ -34,6 +34,7 @@ from tpu_matmul_bench.utils.device import (
     resolve_devices,
 )
 from tpu_matmul_bench.utils.metrics import matrix_memory_gib
+from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.profiling import maybe_trace
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord, header, report
 
@@ -78,7 +79,8 @@ def run(config: BenchConfig) -> list[BenchmarkRecord]:
         sizes = [s for s in sizes if s % d == 0]
 
     mem_factor = COLLECTIVES[config.mode].mem_factor(d)
-    with maybe_trace(config.profile_dir):
+    with telemetry.session(config.trace_out), \
+            maybe_trace(config.profile_dir):
         records = run_sizes(
             config,
             bench_one,

@@ -22,6 +22,7 @@ import argparse
 import json
 from typing import Sequence
 
+from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord, report
 
 
@@ -241,9 +242,13 @@ def _compare_rows(size, dtype, num_devices, iterations, warmup, precision,
     base = common + (["--num-devices", str(num_devices)] if num_devices else [])
 
     def run_prog(module, argv: list[str]) -> list[BenchmarkRecord]:
-        if isolate:
-            return _run_isolated(module.__name__, argv, mode_timeout)
-        return _run(module.main, argv)
+        label = module.__name__.rsplit(".", 1)[-1]
+        if "--mode" in argv:
+            label += ":" + argv[argv.index("--mode") + 1]
+        with telemetry.span(f"row:{label}"):
+            if isolate:
+                return _run_isolated(module.__name__, argv, mode_timeout)
+            return _run(module.main, argv)
 
     def want(name: str) -> bool:
         # --only: re-run a subset of rows (e.g. the ones a previous
@@ -559,6 +564,9 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
                         "'single,overlap,single_float32_strict') — re-run "
                         "a subset, such as rows a previous --isolate run "
                         "skipped, without paying for the whole table")
+    p.add_argument("--trace-out", type=str, default=None,
+                   help="write a Chrome-trace span timeline of the whole "
+                        "table run (one span per row; '-' = stdout)")
     args = p.parse_args(argv)
 
     from tpu_matmul_bench.utils.reporting import (
@@ -573,16 +581,17 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
         # to itself), so the CLI forces the gate for its whole run
         if args.isolate:
             force_reporting_process(True)
-        results = compare(args.size, args.dtype, args.num_devices,
-                          args.iterations, args.warmup,
-                          precision=args.precision,
-                          isolate=args.isolate,
-                          mode_timeout=args.mode_timeout,
-                          only=(set(args.only.split(","))
-                                if args.only else None),
-                          comm_quant=args.comm_quant,
-                          timing=args.timing)
-        return _finish(args, results)
+        with telemetry.session(args.trace_out):
+            results = compare(args.size, args.dtype, args.num_devices,
+                              args.iterations, args.warmup,
+                              precision=args.precision,
+                              isolate=args.isolate,
+                              mode_timeout=args.mode_timeout,
+                              only=(set(args.only.split(","))
+                                    if args.only else None),
+                              comm_quant=args.comm_quant,
+                              timing=args.timing)
+            return _finish(args, results)
     finally:
         # restore (not clear) after ALL parent-side reporting is done, for
         # in-process callers that keep using this interpreter (tests)
@@ -596,6 +605,8 @@ def _finish(args, results: dict[str, BenchmarkRecord]):
             fh.write(render_markdown(results) + "\n")
     if args.json_out:
         with open(args.json_out, "w") as fh:
+            fh.write(json.dumps(telemetry.build_manifest(),
+                                sort_keys=True) + "\n")
             for name, rec in results.items():
                 fh.write(json.dumps({"comparison_key": name,
                                      **json.loads(rec.to_json())}) + "\n")

@@ -17,18 +17,22 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 from tpu_matmul_bench.benchmarks.runner import run_sizes
 from tpu_matmul_bench.models.workloads import MatmulWorkload, RectMatmulWorkload
 from tpu_matmul_bench.ops.impl_select import auto_extras
 from tpu_matmul_bench.ops.matmul import make_matmul, matmul_2d
-from tpu_matmul_bench.parallel.mesh import make_mesh, sharded_normal
+from tpu_matmul_bench.parallel.mesh import (
+    make_mesh,
+    shard_map_compat as shard_map,
+    sharded_normal,
+)
 from tpu_matmul_bench.parallel.modes import (
     VALIDATION_CORNER,
     corner_validation,
     expected_corner,
 )
+from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.config import BenchConfig, parse_config
 from tpu_matmul_bench.utils.device import (
     collect_device_info,
@@ -46,6 +50,7 @@ from tpu_matmul_bench.utils.timing import (
     fuse_iterations,
     latency_percentiles_ms,
     protocol_extras,
+    sample_extras,
     time_jitted,
 )
 
@@ -117,6 +122,8 @@ def _bench_single(
                                   device_kind, config.dtype))
         if config.percentiles:
             extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
+        if config.samples:
+            extras["samples"] = sample_extras(mm, (a, b), config)
         extras.update(verdict)
     tflops = calculate_tflops(size, t.avg_s)
     return BenchmarkRecord(
@@ -166,6 +173,8 @@ def _bench_all_devices(
                               device_kind, config.dtype))
     if config.percentiles:
         extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
+    if config.samples:
+        extras["samples"] = sample_extras(mm, (a, b), config)
     extras.update(verdict)
     per_device = calculate_tflops(size, t.avg_s)  # each device did one matmul/iter
     return BenchmarkRecord(
@@ -207,6 +216,8 @@ def _bench_rect(
                                   device_kind, config.dtype))
         if config.percentiles:
             extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
+        if config.samples:
+            extras["samples"] = sample_extras(mm, (a, b), config)
         extras.update(verdict)
     tflops = calculate_tflops(max(mkn), t.avg_s, flops=wl.flops)
     return BenchmarkRecord(
@@ -246,7 +257,8 @@ def run(config: BenchConfig, mkn: tuple[int, int, int] | None = None
         wl = RectMatmulWorkload(m, k, n, config.dtype)
         # one "size" through the shared runner: same pre-flight memory
         # guard, OOM backstop, JSON sink, and report pipeline as the sweep
-        with maybe_trace(config.profile_dir):
+        with telemetry.session(config.trace_out), \
+                maybe_trace(config.profile_dir):
             records = run_sizes(
                 config,
                 lambda _s: _bench_rect(config, mkn, info.device_kind,
@@ -267,7 +279,8 @@ def run(config: BenchConfig, mkn: tuple[int, int, int] | None = None
             return _bench_single(config, size, info.device_kind, devices[0])
         return _bench_all_devices(config, size, devices, info.device_kind)
 
-    with maybe_trace(config.profile_dir):
+    with telemetry.session(config.trace_out), \
+            maybe_trace(config.profile_dir):
         records = run_sizes(
             config,
             bench_one,

@@ -28,6 +28,7 @@ from tpu_matmul_bench.utils.device import (
     maybe_init_multihost,
     resolve_devices,
 )
+from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.profiling import maybe_trace
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord, header, report
 
@@ -60,7 +61,8 @@ def run(config: BenchConfig, dp: int, batch: int) -> list[BenchmarkRecord]:
         setup = hybrid_mode(config, mesh, size, batch=batch)
         return run_mode_benchmark(setup, config)
 
-    with maybe_trace(config.profile_dir):
+    with telemetry.session(config.trace_out), \
+            maybe_trace(config.profile_dir):
         records = run_sizes(
             config, bench_one,
             # pure estimator — the guard must never touch the allocator

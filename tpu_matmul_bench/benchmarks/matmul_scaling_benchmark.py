@@ -22,6 +22,7 @@ from tpu_matmul_bench.parallel.modes import (
     estimate_memory_gib,
     run_mode_benchmark,
 )
+from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.config import BenchConfig, parse_config
 from tpu_matmul_bench.utils.profiling import maybe_trace
 from tpu_matmul_bench.utils.device import (
@@ -99,7 +100,8 @@ def run(
                 rec, _single_device_tflops(config, local, size))
         return rec
 
-    with maybe_trace(config.profile_dir):
+    with telemetry.session(config.trace_out), \
+            maybe_trace(config.profile_dir):
         records = run_sizes(
             config,
             bench_one,

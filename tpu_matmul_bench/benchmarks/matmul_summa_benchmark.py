@@ -40,6 +40,7 @@ from tpu_matmul_bench.utils.device import (
     maybe_init_multihost,
     resolve_devices,
 )
+from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.profiling import maybe_trace
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord, header, report
 
@@ -71,7 +72,8 @@ def run(config: BenchConfig, rows: int | None = None) -> list[BenchmarkRecord]:
         setup = summa_mode(config, mesh, size)
         return run_mode_benchmark(setup, config)
 
-    with maybe_trace(config.profile_dir):
+    with telemetry.session(config.trace_out), \
+            maybe_trace(config.profile_dir):
         records = run_sizes(
             config, bench_one,
             memory_gib=lambda s: estimate_memory_gib(

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_matmul_bench.benchmarks.runner import run_sizes
+from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.config import BenchConfig, parse_config
 from tpu_matmul_bench.utils.device import (
     collect_device_info,
@@ -36,6 +37,7 @@ from tpu_matmul_bench.utils.timing import (
     choose_timer,
     effective_warmup,
     protocol_extras,
+    sample_extras,
 )
 
 # STREAM convention: name -> (program(a, b, s), bytes moved per element
@@ -90,6 +92,8 @@ def bench_membw(config: BenchConfig, size: int, op: str,
     )
     if spec:
         rec.extras["pct_of_spec_hbm_bw"] = round(100.0 * gbps / spec, 1)
+    if config.samples:
+        rec.extras["samples"] = sample_extras(jitted, (a, b, s), config)
     return rec
 
 
@@ -124,19 +128,23 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     # run_sizes opens config.json_out in "w" mode per call, so per-op calls
     # run with it cleared and this driver writes the one aggregate file
     sub = dataclasses.replace(config, json_out=None)
-    for op in ops:
-        report(f"\n### membw: {op} " + "#" * 40)
+    with telemetry.session(config.trace_out):
+        for op in ops:
+            report(f"\n### membw: {op} " + "#" * 40)
 
-        def bench_one(size: int, _op=op) -> BenchmarkRecord:
-            return bench_membw(config, size, _op, device)
+            def bench_one(size: int, _op=op) -> BenchmarkRecord:
+                return bench_membw(config, size, _op, device)
 
-        records += run_sizes(
-            sub, bench_one,
-            memory_gib=lambda s: 3 * s * s
-            * jnp.dtype(config.dtype).itemsize / 2**30,
-            memory_limit_gib=info.memory_gib,
-        )
-    with JsonWriter(config.json_out) as jw:
+            with telemetry.span(f"mode:{op}", mode=op):
+                records += run_sizes(
+                    sub, bench_one,
+                    memory_gib=lambda s: 3 * s * s
+                    * jnp.dtype(config.dtype).itemsize / 2**30,
+                    memory_limit_gib=info.memory_gib,
+                )
+    manifest = (telemetry.build_manifest(config)
+                if config.json_out else None)
+    with JsonWriter(config.json_out, manifest=manifest) as jw:
         for rec in records:
             jw.write(rec)
     report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)

@@ -41,6 +41,7 @@ from tpu_matmul_bench.parallel.modes import (
     corner_validation,
     expected_corner,
 )
+from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.config import build_parser, config_from_args
 from tpu_matmul_bench.utils.device import (
     apply_matmul_precision,
@@ -332,8 +333,14 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
     if config.blocks is not None:
         candidates.insert(0, config.blocks)
 
+    def _manifest():
+        # inside the session, so the header cross-references the trace
+        return (telemetry.build_manifest(config)
+                if config.json_out else None)
+
     if args.ring:
-        with JsonWriter(config.json_out) as jw:
+        with telemetry.session(config.trace_out), \
+                JsonWriter(config.json_out, manifest=_manifest()) as jw:
             return _tune_ring(args.ring, candidates, config, devices, info,
                               jw)
 
@@ -343,7 +350,8 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         else [(s, s, s) for s in config.sizes])
 
     records: list[BenchmarkRecord] = []
-    with JsonWriter(config.json_out) as jw:
+    with telemetry.session(config.trace_out), \
+            JsonWriter(config.json_out, manifest=_manifest()) as jw:
         for m, k, n in shapes:
             rect = not (m == k == n)
             label = f"{m}x{k}x{n}" if rect else str(m)

@@ -10,6 +10,7 @@ from __future__ import annotations
 import traceback
 from typing import Callable, Iterable
 
+from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.device import apply_matmul_precision
 from tpu_matmul_bench.utils.errors import (
@@ -47,7 +48,11 @@ def run_sizes(
     # must precede tracing: every program's jit cache keys on the precision
     apply_matmul_precision(config.precision)
     records: list[BenchmarkRecord] = []
-    with JsonWriter(config.json_out) as jw:
+    # the JSONL's provenance header (schema_version, device info, argv,
+    # git SHA — utils/telemetry.py); built only when a sink exists
+    manifest = (telemetry.build_manifest(config)
+                if config.json_out else None)
+    with JsonWriter(config.json_out, manifest=manifest) as jw:
         for size in sizes if sizes is not None else config.sizes:
             report(preamble(size) if preamble is not None
                    else size_preamble(size, config.dtype_name))
@@ -63,7 +68,9 @@ def run_sizes(
                 )
                 continue
             try:
-                rec = bench_one(size).finalize()
+                with telemetry.span(f"size:{size}", size=size,
+                                    mode=config.mode):
+                    rec = bench_one(size).finalize()
             except Exception as e:  # noqa: BLE001 — per-size resilience
                 if is_oom_error(e):
                     report(f"\n  ERROR: Out of memory for {size}x{size} matrices")

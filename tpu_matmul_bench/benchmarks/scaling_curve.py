@@ -20,6 +20,7 @@ from typing import Sequence
 
 from tpu_matmul_bench.benchmarks import matmul_scaling_benchmark as scaling
 from tpu_matmul_bench.parallel.modes import SCALING_MODES
+from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.config import build_parser, config_from_args
 from tpu_matmul_bench.utils.reporting import (
     BenchmarkRecord,
@@ -115,15 +116,21 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
             counts = default_counts(world)
 
     rows: list[tuple[int, BenchmarkRecord]] = []
-    for n in counts:
-        report(f"\n### scaling curve: {config.mode} at {n} device(s) "
-               + "#" * 30)
-        # each count is a full scaling-benchmark run at --num-devices n;
-        # the child writes no JSONL of its own (this driver aggregates)
-        sub = dataclasses.replace(config, num_devices=n, json_out=None)
-        recs = scaling.run(sub)
-        if recs:
-            rows.append((n, recs[-1]))
+    # one session over the whole sweep: scaling.run's inner session call
+    # is re-entrant and keeps this tracker, so the trace shows every
+    # device count's spans on one timeline
+    with telemetry.session(config.trace_out):
+        for n in counts:
+            report(f"\n### scaling curve: {config.mode} at {n} device(s) "
+                   + "#" * 30)
+            # each count is a full scaling-benchmark run at --num-devices n;
+            # the child writes no JSONL of its own (this driver aggregates)
+            sub = dataclasses.replace(config, num_devices=n, json_out=None)
+            with telemetry.span(f"devices:{n}", devices=n,
+                                mode=config.mode):
+                recs = scaling.run(sub)
+            if recs:
+                rows.append((n, recs[-1]))
 
     table = render_curve(config.mode, size, rows)
     report("\n" + table)
@@ -133,7 +140,9 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
         # the same table file
         with open(args.markdown_out, "w") as fh:
             fh.write(table + "\n")
-    with JsonWriter(config.json_out) as jw:
+    manifest = (telemetry.build_manifest(config)
+                if config.json_out else None)
+    with JsonWriter(config.json_out, manifest=manifest) as jw:
         for n, rec in rows:
             rec.extras.setdefault("curve_devices", n)
             jw.write(rec)

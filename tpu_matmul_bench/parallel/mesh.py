@@ -19,6 +19,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_matmul_bench.ops.matmul import random_array
 
+try:  # jax ≥ 0.6 exports shard_map at top level (check_vma spelling)
+    from jax import shard_map as _raw_shard_map
+
+    def shard_map_compat(fn, *, mesh, in_specs, out_specs,
+                         check_vma: bool = True):
+        return _raw_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_vma)
+except ImportError:  # jax 0.4.x: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+    def shard_map_compat(fn, *, mesh, in_specs, out_specs,
+                         check_vma: bool = True):
+        return _raw_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
 
 def make_mesh(
     devices: Sequence[jax.Device] | None = None,
@@ -77,8 +92,8 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def smap(fn, mesh: Mesh, in_specs, out_specs, check_vma: bool = True):
     """jit(shard_map(...)) — the one wrapper every collective/mode uses."""
     return jax.jit(
-        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                      check_vma=check_vma)
+        shard_map_compat(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=check_vma)
     )
 
 

@@ -48,6 +48,7 @@ from tpu_matmul_bench.utils.timing import (
     choose_timer,
     effective_warmup,
     latency_percentiles_ms,
+    sample_extras,
     time_variants,
     time_variants_n,
 )
@@ -628,6 +629,9 @@ def run_mode_benchmark(setup: ModeSetup, config: BenchConfig) -> BenchmarkRecord
         if config.percentiles:
             rec.extras["latency_ms"] = latency_percentiles_ms(
                 setup.compute, setup.operands, config)
+        if config.samples:
+            rec.extras["samples"] = sample_extras(
+                setup.compute, setup.operands, config)
         rec.extras.update(verdict)
         return rec
     t_nocomm = None
@@ -658,6 +662,11 @@ def run_mode_benchmark(setup: ModeSetup, config: BenchConfig) -> BenchmarkRecord
         rec.extras["timing_reliable"] = False
     if config.percentiles:
         rec.extras["latency_ms"] = latency_percentiles_ms(
+            setup.full, setup.operands, config)
+    if config.samples:
+        # sampled on the FULL program — the distribution of the quantity
+        # the headline avg_time_s reports
+        rec.extras["samples"] = sample_extras(
             setup.full, setup.operands, config)
     rec.extras.update(verdict)
     return rec

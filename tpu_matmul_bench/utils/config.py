@@ -52,6 +52,12 @@ class BenchConfig:
     matmul_impl: str
     seed: int
     profile_dir: str | None = None
+    # span timeline: Chrome-trace JSON of nested phase timers
+    # (compile/warmup/measure/sync-calibrate, per-size) — utils/telemetry.py
+    trace_out: str | None = None
+    # per-iteration sampling: attach p50/p95/p99/stddev + warmup-drift
+    # flag to record extras["samples"] (utils/timing.py sample_stats)
+    samples: bool = False
     percentiles: bool = False
     validate: bool = False
     # int8-wire all_reduce for the gradient-sync modes (EQuARX-flavored)
@@ -181,6 +187,20 @@ def build_parser(
              "bf16-vs-fp32 comparison (README.md:50) with a real gap.",
     )
     p.add_argument(
+        "--trace-out", type=str, default=None,
+        help="Write a Chrome-trace-format span timeline here ('-' for "
+             "stdout): nested phase timers (compile, warmup, measure, "
+             "sync-calibrate, per-size) loadable in Perfetto or "
+             "chrome://tracing alongside --profile-dir's XLA trace, plus "
+             "a stdout phase summary (utils/telemetry.py).",
+    )
+    p.add_argument(
+        "--samples", action="store_true",
+        help="Record each timed iteration's wall time (individually "
+             "synced) and attach p50/p95/p99, stddev, and a warmup-drift "
+             "flag to record extras['samples'].",
+    )
+    p.add_argument(
         "--percentiles", action="store_true",
         help="Also measure per-iteration latency percentiles (p50/p90/p99) — "
              "exposes jitter that the whole-loop mean hides",
@@ -247,6 +267,8 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         matmul_impl=args.matmul_impl,
         seed=args.seed,
         profile_dir=getattr(args, "profile_dir", None),
+        trace_out=getattr(args, "trace_out", None),
+        samples=getattr(args, "samples", False),
         percentiles=getattr(args, "percentiles", False),
         validate=getattr(args, "validate", False),
         comm_quant=getattr(args, "comm_quant", None),

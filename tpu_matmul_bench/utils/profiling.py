@@ -13,6 +13,7 @@ from typing import Iterator
 
 import jax
 
+from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.reporting import report
 
 
@@ -22,6 +23,10 @@ def maybe_trace(profile_dir: str | None) -> Iterator[None]:
     if not profile_dir:
         yield
         return
+    # registered before the JSONL sink opens, so the run's manifest
+    # cross-references the profiler artifact (and, via telemetry.session,
+    # the chrome trace cross-references it too)
+    telemetry.note_artifact("profiler_trace_dir", profile_dir)
     report(f"\n[profiler] tracing to {profile_dir}")
     try:
         with jax.profiler.trace(profile_dir):

@@ -11,7 +11,9 @@ so there is no rank gating; multi-host runs gate on process_index == 0.
 from __future__ import annotations
 
 import dataclasses
+import io
 import json
+import os
 import sys
 from typing import Any, IO
 
@@ -226,18 +228,43 @@ def format_record(rec: BenchmarkRecord) -> str:
 
 class JsonWriter:
     """JSON-lines sink for BenchmarkRecords (the structured channel the
-    comparison driver reads instead of scraping stdout)."""
+    comparison driver reads instead of scraping stdout).
 
-    def __init__(self, path: str | None):
+    `manifest` (see `utils.telemetry.build_manifest`) is written as the
+    file's first line, making the JSONL self-describing; consumers
+    recognize it by `record_type == "manifest"` and must skip it when
+    iterating measurements.
+
+    Durability: every line is flushed AND fsynced (when the stream has a
+    real file descriptor) so a killed or OOM-aborted run leaves a
+    readable partial JSONL instead of a truncated buffer — partial
+    artifacts from crashed runs are evidence, not garbage.
+    """
+
+    def __init__(self, path: str | None, manifest: dict[str, Any] | None = None):
         self._path = path
         self._fh: IO[str] | None = None
         if path and is_reporting_process():
             self._fh = sys.stdout if path == "-" else open(path, "w")
+        if self._fh is not None and manifest is not None:
+            self._fh.write(json.dumps(manifest, sort_keys=True) + "\n")
+            self._sync()
+
+    def _sync(self) -> None:
+        fh = self._fh
+        fh.flush()
+        try:
+            os.fsync(fh.fileno())
+        except (AttributeError, OSError, ValueError,
+                io.UnsupportedOperation):
+            # stdout/pipes (EINVAL), captured streams without an fd,
+            # closed descriptors: flush is the best these can do
+            pass
 
     def write(self, rec: BenchmarkRecord) -> None:
         if self._fh is not None:
             self._fh.write(rec.to_json() + "\n")
-            self._fh.flush()
+            self._sync()
 
     def close(self) -> None:
         if self._fh is not None and self._fh is not sys.stdout:

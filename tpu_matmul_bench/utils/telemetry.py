@@ -1,0 +1,286 @@
+"""Run-ledger telemetry: span timeline + provenance manifest (SURVEY §5).
+
+The reference's only observability beyond aggregate timing is
+`NCCL_DEBUG=INFO` and rank-0 stdout scraping; our JSONL records already
+beat that, but they collapse each (benchmark, mode, size) into one
+averaged row with no provenance and no visibility into where wall-clock
+goes. This module adds the two missing channels:
+
+1. **Spans** — lightweight nested phase timers (`compile`, `warmup`,
+   `measure`, `sync-calibrate`, per-size) recorded by a `SpanTracker`
+   and emitted as Chrome-trace-format JSON (``--trace-out trace.json``,
+   loadable in Perfetto or chrome://tracing alongside the
+   ``--profile-dir`` XLA trace) plus a stdout phase summary. Spans nest
+   by interval containment (``"ph": "X"`` complete events on one
+   pid/tid), which is exactly how trace viewers reconstruct the stack.
+   When no tracker is installed (`session` not entered), `span()` is a
+   free null context — the timed loops pay nothing.
+
+2. **Provenance manifest** — one self-describing header record per
+   JSONL file (schema_version, jax/jaxlib versions, device kind and
+   count, mesh shape, precision, CLI argv, git SHA, timestamp) written
+   by `JsonWriter`, so `measurements/*.jsonl` files carry their own
+   provenance instead of relying on hand-curated READMEs. Artifacts
+   produced by the same run (the Chrome trace, the profiler trace
+   directory) are cross-referenced under ``"artifacts"``.
+
+Import direction: telemetry → reporting (for the process gate); nothing
+in utils imports telemetry except timing/profiling, so no cycles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Iterator
+
+# Bump when the JSONL record/manifest shape changes incompatibly.
+# v1: bare BenchmarkRecord lines (rounds r2–r5, no header).
+# v2: manifest header record + extras["samples"] distribution block.
+SCHEMA_VERSION = 2
+
+MANIFEST_RECORD_TYPE = "manifest"
+
+# first-vs-last-quartile slope above which a sample distribution is
+# flagged as warmup drift (early iterations systematically slower →
+# the warmup did not fully absorb compile/autotune/clock-ramp)
+WARMUP_DRIFT_THRESHOLD_PCT = 10.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanEvent:
+    """One closed span, in seconds relative to the tracker's epoch."""
+
+    name: str
+    start_s: float
+    dur_s: float
+    depth: int  # nesting depth at open time (0 = top level)
+    args: dict[str, Any]
+
+
+class SpanTracker:
+    """Collects nested phase spans for one benchmark run."""
+
+    def __init__(self) -> None:
+        self.epoch = time.perf_counter()
+        self.events: list[SpanEvent] = []
+        self._depth = 0
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args: Any) -> Iterator[dict[str, Any]]:
+        """Time a phase. Yields the (mutable) args dict so callers can
+        attach values only known at close time (e.g. the auto-scaled
+        iteration count)."""
+        meta = {k: v for k, v in args.items() if v is not None}
+        start = time.perf_counter() - self.epoch
+        self._depth += 1
+        try:
+            yield meta
+        finally:
+            self._depth -= 1
+            self.events.append(SpanEvent(
+                name=name,
+                start_s=start,
+                dur_s=time.perf_counter() - self.epoch - start,
+                depth=self._depth,
+                args=dict(meta),
+            ))
+
+    def to_chrome_trace(self) -> dict[str, Any]:
+        """Chrome trace event format: complete ("X") events on one
+        pid/tid; viewers nest them by interval containment."""
+        events = sorted(self.events, key=lambda e: (e.start_s, -e.dur_s))
+        return {
+            "displayTimeUnit": "ms",
+            "traceEvents": [
+                {
+                    "name": e.name,
+                    "ph": "X",
+                    "ts": round(e.start_s * 1e6, 3),  # µs
+                    "dur": round(e.dur_s * 1e6, 3),
+                    "pid": os.getpid(),
+                    "tid": 1,
+                    **({"args": e.args} if e.args else {}),
+                }
+                for e in events
+            ],
+        }
+
+    def summary_lines(self) -> list[str]:
+        """Stdout phase summary: total/count per span name, largest
+        first. Nested spans are included under their own name — the
+        table answers "where does wall-clock go" per phase, not a
+        partition of the run."""
+        agg: dict[str, tuple[float, int]] = {}
+        for e in self.events:
+            total, count = agg.get(e.name, (0.0, 0))
+            agg[e.name] = (total + e.dur_s, count + 1)
+        if not agg:
+            return ["[telemetry] no spans recorded"]
+        wall = max((e.start_s + e.dur_s) for e in self.events)
+        lines = ["[telemetry] phase summary "
+                 f"(wall {wall:.3f} s):"]
+        width = max(len(n) for n in agg)
+        for name, (total, count) in sorted(
+                agg.items(), key=lambda kv: -kv[1][0]):
+            pct = 100.0 * total / wall if wall > 0 else 0.0
+            lines.append(f"  {name:<{width}}  {total:9.3f} s "
+                         f"({pct:5.1f}%)  x{count}")
+        return lines
+
+
+_TRACKER: SpanTracker | None = None
+_ARTIFACTS: dict[str, str] = {}
+
+
+def current_tracker() -> SpanTracker | None:
+    return _TRACKER
+
+
+@contextlib.contextmanager
+def _null_span(meta: dict[str, Any]) -> Iterator[dict[str, Any]]:
+    yield meta
+
+
+def span(name: str, **args: Any):
+    """Module-level span: records into the installed tracker, or is a
+    free null context when telemetry is off. Yields the args dict."""
+    tracker = _TRACKER
+    if tracker is None:
+        return _null_span(dict(args))
+    return tracker.span(name, **args)
+
+
+def note_artifact(kind: str, path: str) -> None:
+    """Register a sibling artifact (profiler trace dir, chrome trace)
+    so the manifest cross-references everything the run produced."""
+    _ARTIFACTS[kind] = path
+
+
+def artifacts() -> dict[str, str]:
+    return dict(_ARTIFACTS)
+
+
+def reset_artifacts() -> None:
+    """Test hygiene: artifact notes are process-global."""
+    _ARTIFACTS.clear()
+
+
+@contextlib.contextmanager
+def session(trace_out: str | None) -> Iterator[SpanTracker | None]:
+    """Install a span tracker for one benchmark run; on exit write the
+    Chrome trace to `trace_out` ('-' = stdout) and print the phase
+    summary. No-op when `trace_out` is falsy. Re-entrant: a nested
+    session (scaling_curve drives scaling.run in-process) keeps the
+    outer tracker and writes nothing of its own.
+    """
+    global _TRACKER
+    if not trace_out or _TRACKER is not None:
+        yield _TRACKER
+        return
+    note_artifact("chrome_trace", trace_out)
+    tracker = SpanTracker()
+    _TRACKER = tracker
+    try:
+        yield tracker
+    finally:
+        _TRACKER = None
+        write_trace(tracker, trace_out)
+
+
+def write_trace(tracker: SpanTracker, path: str) -> None:
+    """Serialize the tracker to Chrome-trace JSON at `path` ('-' =
+    stdout) and print the phase summary (reporting process only)."""
+    from tpu_matmul_bench.utils.reporting import is_reporting_process, report
+
+    if not is_reporting_process():
+        return
+    payload = json.dumps(tracker.to_chrome_trace(), sort_keys=True)
+    if path == "-":
+        print(payload, flush=True)
+    else:
+        with open(path, "w") as fh:
+            fh.write(payload + "\n")
+        report(f"[telemetry] chrome trace written to {path} "
+               "(load in Perfetto or chrome://tracing)")
+    report(*tracker.summary_lines())
+
+
+def git_sha() -> str | None:
+    """HEAD of the repo containing this package, or None when the
+    package runs from an installed wheel / git is absent. Monkeypatch
+    target for tests."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10, check=False)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def build_manifest(config: Any = None, *,
+                   argv: list[str] | None = None) -> dict[str, Any]:
+    """The provenance header record for a JSONL file.
+
+    `config` is a BenchConfig (duck-typed to avoid an import cycle with
+    utils.config); None still yields a valid environment-only manifest.
+    Callers must have initialized the backend already (every benchmark
+    resolves devices before opening its JSON sink).
+    """
+    import jax
+
+    devices = jax.devices()
+    manifest: dict[str, Any] = {
+        "record_type": MANIFEST_RECORD_TYPE,
+        "schema_version": SCHEMA_VERSION,
+        "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "created_unix": round(time.time(), 3),
+        "jax_version": jax.__version__,
+        "jaxlib_version": _jaxlib_version(),
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind,
+        "device_count": len(devices),
+        "process_count": jax.process_count(),
+        "argv": list(sys.argv if argv is None else argv),
+        "git_sha": git_sha(),
+    }
+    if config is not None:
+        # 1-D mesh programs: the world the run actually resolved
+        manifest["mesh_shape"] = [config.num_devices or len(devices)]
+        manifest["config"] = {
+            "dtype": config.dtype_name,
+            "precision": config.precision,
+            "timing": config.timing,
+            "matmul_impl": config.matmul_impl,
+            "mode": config.mode,
+            "iterations": config.iterations,
+            "warmup": config.warmup,
+            "seed": config.seed,
+        }
+    if _ARTIFACTS:
+        manifest["artifacts"] = dict(_ARTIFACTS)
+    return manifest
+
+
+def _jaxlib_version() -> str | None:
+    try:
+        import jaxlib
+
+        return getattr(jaxlib, "__version__", None)
+    except Exception:  # noqa: BLE001 — version info is best-effort
+        return None
+
+
+def is_manifest(record: Any) -> bool:
+    """True for the JSONL header record (consumers skip or summarize it
+    instead of treating it as a measurement)."""
+    return (isinstance(record, dict)
+            and record.get("record_type") == MANIFEST_RECORD_TYPE)

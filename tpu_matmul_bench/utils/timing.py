@@ -40,6 +40,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from tpu_matmul_bench.utils import telemetry
+
 
 @jax.jit
 def _to_scalar(x: jax.Array) -> jax.Array:
@@ -86,12 +88,23 @@ class Timing:
 
 def _warm(call: Callable[[], Any], warmup: int) -> tuple[Any, float]:
     """Shared timed-loop preamble: run warmup (≥1, to absorb compilation),
-    sync, and measure the fixed barrier round-trip to subtract later."""
-    out = None
-    for _ in range(max(warmup, 1)):
+    sync, and measure the fixed barrier round-trip to subtract later.
+
+    Telemetry: the first call (which traces + compiles) is recorded as
+    the `compile` span, the remaining warmup dispatches as `warmup`, and
+    the barrier-overhead measurement as `sync-calibrate` — the three
+    setup phases whose cost the averaged records otherwise hide."""
+    with telemetry.span("compile"):
         out = call()
-    sync(out)
-    return out, _measure_sync_overhead(out)
+        sync(out)
+    rest = max(warmup, 1) - 1
+    with telemetry.span("warmup", iterations=rest):
+        for _ in range(rest):
+            out = call()
+        sync(out)
+    with telemetry.span("sync-calibrate"):
+        overhead = _measure_sync_overhead(out)
+    return out, overhead
 
 
 def _measure_sync_overhead(out: Any, samples: int = 3) -> float:
@@ -140,19 +153,21 @@ def time_jitted(
     # barrier. One barrier per loop regardless of scale, so the overhead stays
     # amortized. Capped to keep worst-case wall time bounded.
     factor = 1
-    while True:
-        n = iterations * factor
-        start = time.perf_counter()
-        for _ in range(n):
-            out = fn(*args)
-        sync(out)
-        raw = _agree(time.perf_counter() - start)
-        device_total = raw - overhead
-        if device_total >= 5 * overhead or factor >= 256:
-            break
-        per_iter = max(device_total / n, 1e-9)
-        need = int(5 * overhead / (per_iter * iterations)) + 1
-        factor = min(max(need, factor * 2), 256)
+    with telemetry.span("measure", protocol="dispatch") as meta:
+        while True:
+            n = iterations * factor
+            start = time.perf_counter()
+            for _ in range(n):
+                out = fn(*args)
+            sync(out)
+            raw = _agree(time.perf_counter() - start)
+            device_total = raw - overhead
+            if device_total >= 5 * overhead or factor >= 256:
+                break
+            per_iter = max(device_total / n, 1e-9)
+            need = int(5 * overhead / (per_iter * iterations)) + 1
+            factor = min(max(need, factor * 2), 256)
+        meta["iterations"] = n  # the auto-scaled count, known at close
     return Timing(
         total_s=max(device_total, 1e-12),
         iterations=n,
@@ -431,15 +446,8 @@ def time_percentiles(
     on high-round-trip backends the distribution is of (device + residual
     barrier noise), so read percentiles relative to each other.
     """
-    out, overhead = _warm(lambda: fn(*args), warmup)
-
-    samples = []
-    for _ in range(iterations):
-        start = time.perf_counter()
-        out = fn(*args)
-        sync(out)
-        samples.append(max(time.perf_counter() - start - overhead, 1e-9))
-    arr = np.asarray(samples)
+    arr = np.asarray(record_samples(fn, args, iterations=iterations,
+                                    warmup=warmup))
     return {
         "p50_s": float(np.percentile(arr, 50)),
         "p90_s": float(np.percentile(arr, 90)),
@@ -455,6 +463,72 @@ def latency_percentiles_ms(fn, operands, config) -> dict[str, float]:
     pct = time_percentiles(fn, operands, iterations=config.iterations,
                            warmup=1)
     return {k.removesuffix("_s"): round(v * 1e3, 3) for k, v in pct.items()}
+
+
+def record_samples(
+    fn: Callable[..., Any],
+    args: Sequence[Any],
+    *,
+    iterations: int = 50,
+    warmup: int = 1,
+) -> list[float]:
+    """Per-iteration wall times in seconds, each iteration individually
+    synced with the fixed barrier round-trip subtracted.
+
+    The whole-loop protocols (`time_jitted`/`time_fused`) deliberately
+    amortize the barrier over N iterations, which also erases the
+    distribution; this is the complementary measurement — N samples, one
+    barrier each — that `sample_stats` turns into the
+    `extras["samples"]` block. On high-round-trip backends each sample
+    carries residual barrier noise, so read percentiles relative to each
+    other (same caveat as `time_percentiles`).
+    """
+    out, overhead = _warm(lambda: fn(*args), warmup)
+    samples: list[float] = []
+    with telemetry.span("sample", iterations=iterations):
+        for _ in range(iterations):
+            start = time.perf_counter()
+            out = fn(*args)
+            sync(out)
+            samples.append(
+                max(time.perf_counter() - start - overhead, 1e-9))
+    return samples
+
+
+def sample_stats(samples_s: Sequence[float]) -> dict[str, Any]:
+    """Distribution block for `extras["samples"]`: p50/p95/p99, stddev,
+    and the warmup-drift flag (first-vs-last-quartile slope — early
+    iterations systematically slower than late ones means warmup did not
+    fully absorb compile/autotune/clock-ramp, so the run's mean is
+    biased high)."""
+    arr = np.asarray(list(samples_s), dtype=float)
+    if arr.size == 0:
+        raise ValueError("need at least one sample")
+    ms = arr * 1e3
+    q = max(arr.size // 4, 1)
+    first, last = float(ms[:q].mean()), float(ms[-q:].mean())
+    drift_pct = 100.0 * (first - last) / last if last > 0 else 0.0
+    return {
+        "n": int(arr.size),
+        "mean_ms": round(float(ms.mean()), 4),
+        "stddev_ms": round(float(ms.std()), 4),
+        "p50_ms": round(float(np.percentile(ms, 50)), 4),
+        "p95_ms": round(float(np.percentile(ms, 95)), 4),
+        "p99_ms": round(float(np.percentile(ms, 99)), 4),
+        "min_ms": round(float(ms.min()), 4),
+        "max_ms": round(float(ms.max()), 4),
+        "warmup_drift_pct": round(drift_pct, 2),
+        "warmup_drift": bool(
+            drift_pct > telemetry.WARMUP_DRIFT_THRESHOLD_PCT),
+    }
+
+
+def sample_extras(fn, operands, config) -> dict[str, Any]:
+    """--samples extras: record per-iteration wall times and reduce to
+    the distribution block (the program is already compiled by the main
+    timing loop, so warmup=1)."""
+    return sample_stats(record_samples(
+        fn, operands, iterations=config.iterations, warmup=1))
 
 
 def time_legs(

@@ -95,14 +95,21 @@ def main(paths):
             # the tuner's flag only covers candidates confirmed in the
             # SAME run; after cross-file dedup the top two may come from
             # different runs, so recompute the margin here — a coin-flip
-            # ranking must never print a clean WINNER (ADVICE r4)
+            # ranking must never print a clean WINNER (ADVICE r4). Same
+            # gate as pallas_tune's confirm pass (ADVICE r5): margin
+            # normalized by the RUNNER-UP, 1% threshold — two spellings
+            # of one tie definition would let a ranking pass one gate
+            # and fail the other.
             runner_up = ranked[1][0]
-            margin_pct = ((best["tflops_total"] - runner_up["tflops_total"])
-                          / best["tflops_total"] * 100.0)
-            if margin_pct < 1.0:
-                print(f"  TIE: top-2 margin {margin_pct:.2f}% (across "
-                      "runs/files) is inside the ±1.5% run noise — "
-                      "re-run the head-to-head interleaved before baking")
+            if runner_up["tflops_total"] > 0:
+                margin_pct = ((best["tflops_total"]
+                               - runner_up["tflops_total"])
+                              / runner_up["tflops_total"] * 100.0)
+                if margin_pct < 1.0:
+                    print(f"  TIE: top-2 margin {margin_pct:.2f}% (across "
+                          "runs/files) is inside the 1% confirm-noise "
+                          "gate — re-run the head-to-head interleaved "
+                          "before baking")
         for (rec, p), tag in zip(ranked[:3], ("WINNER", "2nd", "3rd")):
             e = rec["extras"]
             margin = ("" if rec is best else

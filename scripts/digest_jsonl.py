@@ -2,11 +2,19 @@
 """Digest benchmark --json-out JSONL files into ranked one-line summaries.
 
 Usage: python scripts/digest_jsonl.py measurements/r3/*.jsonl
+       python scripts/digest_jsonl.py measurements/r6_campaign
 
 Groups records by (file, shape, dtype, mode) and prints them ranked by
 per-device throughput, with the blocking (tuner records carry it in
 extras) so sweep winners can be read off and baked into
 ops/pallas_matmul.py's tuned tables with provenance.
+
+A campaign directory (one holding a ``journal.jsonl`` or a ``jobs/``
+subdirectory, as written by `python -m tpu_matmul_bench campaign run`)
+digests ALL its job ledgers into one combined table — rows ranked
+across jobs and labeled with their job id, headed by the journal's
+status counts — so a whole round reads in one screen. Plain files and
+non-campaign directories digest exactly as before.
 """
 
 from __future__ import annotations
@@ -15,12 +23,134 @@ import json
 import sys
 from pathlib import Path
 
+_JOURNAL = "journal.jsonl"
+_JOBS_SUBDIR = "jobs"
+
+
+def _rank_key(r):
+    # superseded records sink below everything else regardless of
+    # throughput — the first line must never read as a headline from
+    # a kernel the measurements say is dominated
+    return ("superseded_by" in (r.get("extras") or {}),
+            -(r.get("tflops_per_device") or 0))
+
+
+def _row(r) -> str:
+    ex = r.get("extras") or {}
+    shape = ex.get("shape") or f"{r.get('size')}²"
+    blocks = ""
+    if "block_m" in ex:  # tuner records carry the blocking
+        blocks = (f"({ex.get('block_m')},{ex.get('block_n')},"
+                  f"{ex.get('block_k')})")
+    unit = ex.get("throughput_unit", "TFLOPS")
+    extra_bits = " ".join(
+        f"{k}={ex[k]}" for k in
+        ("overlap_speedup_x", "validation", "timing_reliable",
+         "kernel")
+        if k in ex)
+    if ex.get("confirm_pass"):
+        extra_bits += " [confirm]"
+    if "tie_margin_pct" in ex:
+        extra_bits += f" [TIE {ex['tie_margin_pct']}%]"
+    for k in ("grid_order", "ksplit"):  # r5 structural axes
+        if k in ex:
+            extra_bits += f" {k}={ex[k]}"
+    if "superseded_by" in ex:
+        # e.g. pallas_ring: kept for pedagogy/budget validation,
+        # dominated at every size — never read it as a headline
+        extra_bits += f" [SUPERSEDED by {ex['superseded_by']}]"
+    if "chain" in ex:
+        extra_bits += f" [chain={ex['chain']}: hoist-prone]"
+    smp = ex.get("samples")
+    if isinstance(smp, dict):  # schema v2 per-iteration sampling
+        extra_bits += (f" p50={smp.get('p50_ms')} "
+                       f"p95={smp.get('p95_ms')} "
+                       f"p99={smp.get('p99_ms')} "
+                       f"sd={smp.get('stddev_ms')}ms")
+        if smp.get("warmup_drift"):
+            extra_bits += (" [WARMUP DRIFT "
+                           f"{smp.get('warmup_drift_pct')}%]")
+    return (f"  {r.get('tflops_per_device') or 0:8.2f} {unit:6} "
+            f"{shape:>18} {r.get('mode', ''):24} "
+            f"{str(blocks):>18} it={r.get('iterations')} "
+            f"{extra_bits}")
+
+
+def _is_campaign_dir(p: Path) -> bool:
+    return (p / _JOURNAL).exists() or (p / _JOBS_SUBDIR).is_dir()
+
+
+def _campaign_status_counts(d: Path) -> dict[str, int]:
+    """Job status counts from the journal. Mirrors campaign/state.py's
+    reading (finished = a `done` event EVER, not the latest — resumes
+    append `skipped` after `done`) without importing the package, so
+    the script stays runnable standalone against a copied-off dir."""
+    try:
+        lines = (d / _JOURNAL).read_text().splitlines()
+    except OSError:
+        return {}
+    latest: dict[str, str] = {}
+    ever_done: set[str] = set()
+    for line in lines:
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue  # torn final line from a crash — tolerated
+        if not isinstance(ev, dict) or "fingerprint" not in ev:
+            continue
+        fp, status = ev["fingerprint"], str(ev.get("status"))
+        latest[fp] = status
+        if status == "done":
+            ever_done.add(fp)
+    counts: dict[str, int] = {}
+    for fp, status in latest.items():
+        s = "done" if fp in ever_done else status
+        counts[s] = counts.get(s, 0) + 1
+    return counts
+
+
+def _digest_campaign(d: Path) -> None:
+    ledgers = sorted((d / _JOBS_SUBDIR).glob("*.jsonl")) \
+        if (d / _JOBS_SUBDIR).is_dir() else []
+    counts = _campaign_status_counts(d)
+    bits = ", ".join(f"{n} {s}" for s, n in sorted(counts.items()))
+    print(f"\n## campaign {d} ({len(ledgers)} job ledgers"
+          + (f"; {bits}" if bits else "") + ")")
+    rows: list[tuple[str, dict]] = []
+    for ledger in ledgers:
+        job_id = ledger.stem
+        try:
+            lines = ledger.read_text().splitlines()
+        except OSError as e:
+            print(f"  {ledger}: {e}")
+            continue
+        for line in lines:
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            # per-job manifests are identical boilerplate here — the
+            # campaign's spec.json carries the provenance for the set
+            if not isinstance(r, dict) or r.get("record_type") == "manifest":
+                continue
+            rows.append((job_id, r))
+    if not rows:
+        print("  no measurement records (yet) — see journal.jsonl")
+        return
+    rows.sort(key=lambda jr: _rank_key(jr[1]))
+    for job_id, r in rows:
+        print(_row(r) + f" job={job_id}")
+
 
 def main(paths: list[str]) -> None:
-    # a directory argument (incl. the no-args default) digests its JSONLs
+    # a directory argument (incl. the no-args default) digests its JSONLs;
+    # a CAMPAIGN directory digests its job ledgers as one combined table
     expanded: list[str] = []
     for path in paths:
         if Path(path).is_dir():
+            if _is_campaign_dir(Path(path)):
+                _digest_campaign(Path(path))
+                continue
             expanded += sorted(str(f) for f in Path(path).glob("*.jsonl"))
         else:
             expanded.append(path)
@@ -55,51 +185,9 @@ def main(paths: list[str]) -> None:
                   f"{m.get('device_count')}x{m.get('device_kind')} "
                   f"git={sha} dtype={cfg.get('dtype')} "
                   f"argv={' '.join(m.get('argv') or [])}")
-        # superseded records sink below everything else regardless of
-        # throughput — the first line must never read as a headline from
-        # a kernel the measurements say is dominated
-        recs.sort(key=lambda r: (
-            "superseded_by" in (r.get("extras") or {}),
-            -(r.get("tflops_per_device") or 0)))
+        recs.sort(key=_rank_key)
         for r in recs:
-            ex = r.get("extras") or {}
-            shape = ex.get("shape") or f"{r.get('size')}²"
-            blocks = ""
-            if "block_m" in ex:  # tuner records carry the blocking
-                blocks = (f"({ex.get('block_m')},{ex.get('block_n')},"
-                          f"{ex.get('block_k')})")
-            unit = ex.get("throughput_unit", "TFLOPS")
-            extra_bits = " ".join(
-                f"{k}={ex[k]}" for k in
-                ("overlap_speedup_x", "validation", "timing_reliable",
-                 "kernel")
-                if k in ex)
-            if ex.get("confirm_pass"):
-                extra_bits += " [confirm]"
-            if "tie_margin_pct" in ex:
-                extra_bits += f" [TIE {ex['tie_margin_pct']}%]"
-            for k in ("grid_order", "ksplit"):  # r5 structural axes
-                if k in ex:
-                    extra_bits += f" {k}={ex[k]}"
-            if "superseded_by" in ex:
-                # e.g. pallas_ring: kept for pedagogy/budget validation,
-                # dominated at every size — never read it as a headline
-                extra_bits += f" [SUPERSEDED by {ex['superseded_by']}]"
-            if "chain" in ex:
-                extra_bits += f" [chain={ex['chain']}: hoist-prone]"
-            smp = ex.get("samples")
-            if isinstance(smp, dict):  # schema v2 per-iteration sampling
-                extra_bits += (f" p50={smp.get('p50_ms')} "
-                               f"p95={smp.get('p95_ms')} "
-                               f"p99={smp.get('p99_ms')} "
-                               f"sd={smp.get('stddev_ms')}ms")
-                if smp.get("warmup_drift"):
-                    extra_bits += (" [WARMUP DRIFT "
-                                   f"{smp.get('warmup_drift_pct')}%]")
-            print(f"  {r.get('tflops_per_device') or 0:8.2f} {unit:6} "
-                  f"{shape:>18} {r.get('mode', ''):24} "
-                  f"{str(blocks):>18} it={r.get('iterations')} "
-                  f"{extra_bits}")
+            print(_row(r))
 
 
 if __name__ == "__main__":

@@ -159,6 +159,44 @@ def test_bake_rows_recomputes_cross_file_tie(tmp_path):
     assert "before baking" in out.stdout
 
 
+def test_bake_rows_tie_gate_uses_runner_up_denominator(tmp_path):
+    # the cross-file gate must be the SAME definition as pallas_tune's
+    # confirm gate: margin = (top − runner_up) / RUNNER_UP, 1% threshold.
+    # 101.0 vs 100.0 is exactly 1.00% under that definition — not a tie;
+    # the old top-denominator spelling (1/101 = 0.99%) would have called
+    # it one, so this pins the boundary
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+
+    def run(tflops_pair):
+        srcs = []
+        for i, ((bm, bn, bk), tflops) in enumerate(tflops_pair):
+            src = tmp_path / f"gate_{tflops}_{i}.jsonl"
+            src.write_text(json.dumps({
+                "benchmark": "tune", "mode": "pallas_tune", "size": 8192,
+                "dtype": "int8", "tflops_total": tflops,
+                "extras": {"block_m": bm, "block_n": bn,
+                           "block_k": bk}}) + "\n")
+            srcs.append(str(src))
+        out = subprocess.run(
+            [sys.executable, str(repo / "scripts" / "bake_rows.py"), *srcs],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        return out.stdout
+
+    at_boundary = run((((2048, 1024, 2048), 101.0),
+                       ((1024, 1024, 2048), 100.0)))
+    assert "TIE" not in at_boundary  # exactly 1.00% clears the gate
+    inside = run((((2048, 2048, 2048), 100.9),
+                  ((1024, 2048, 2048), 100.0)))
+    assert "TIE: top-2 margin 0.90%" in inside
+    assert "1% confirm-noise gate" in inside
+
+
 def test_bake_rows_keeps_structural_axes_distinct(tmp_path):
     # r5 structural sweeps: an nmk/ksplit record with the same blocks is a
     # DIFFERENT program — it must not dedupe against the plain row, and a

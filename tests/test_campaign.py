@@ -1,0 +1,396 @@
+"""Unit tests for the campaign subsystem (spec → plan → executor →
+store → gate), all with an injected launcher — no child processes here
+(tests/test_campaign_e2e.py covers the real subprocess path).
+
+The load-bearing properties: plan expansion is deterministic, the config
+fingerprint is a persisted format (pinned against a literal), execution
+policy and the `{dir}` placeholder stay OUT of the fingerprint, the
+journal survives torn lines and a `done` never un-completes, retries
+back off (transport failures at least the watcher's floor), resume
+re-runs only unfinished fingerprints, and the gate's tolerance widens
+with measured sample noise but never below the drift floor.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpu_matmul_bench.campaign import executor, state
+from tpu_matmul_bench.campaign import gate as gate_mod
+from tpu_matmul_bench.campaign.spec import (
+    CampaignSpecError,
+    Job,
+    job_fingerprint,
+    load_spec,
+    spec_from_dict,
+)
+from tpu_matmul_bench.campaign.store import CampaignStore
+
+
+# ---------------------------------------------------------------- spec
+
+def _basic_dict(**overrides):
+    d = {"campaign": {"name": "t"},
+         "job": [{"id": "j1", "program": "matmul",
+                  "flags": ["--sizes", "64", "--iterations", "2"]}]}
+    d.update(overrides)
+    return d
+
+
+def test_fingerprint_pinned_literal():
+    # the fingerprint is a persisted format: journals and baselines key
+    # on it, so a change here orphans every existing campaign dir. If
+    # this test fails, you changed the format — don't update the literal
+    # without a migration story.
+    assert job_fingerprint("matmul", ["--sizes", "64", "--iterations",
+                                      "2"]) == "934da6f2166c10cf"
+
+
+def test_fingerprint_excludes_policy_and_is_order_sensitive():
+    a = Job("a", "matmul", ("--sizes", "64"), timeout_s=1.0, retries=0)
+    b = Job("b", "matmul", ("--sizes", "64"), timeout_s=999.0, retries=9,
+            backoff_s=123.0)
+    assert a.fingerprint == b.fingerprint  # policy is not identity
+    # flag ORDER is identity (order can change program behavior)
+    assert (job_fingerprint("matmul", ["--a", "--b"])
+            != job_fingerprint("matmul", ["--b", "--a"]))
+
+
+def test_dir_placeholder_fingerprinted_unexpanded(tmp_path):
+    job = Job("j", "compare", ("--markdown-out", "{dir}/out.md"))
+    # the same spec run in two different directories is the SAME set of
+    # measurements: {dir} resolves at launch, after fingerprinting
+    cmd_a = executor.job_command(job, tmp_path / "a", tmp_path / "a/l.jsonl")
+    cmd_b = executor.job_command(job, tmp_path / "b", tmp_path / "b/l.jsonl")
+    assert f"{tmp_path}/a/out.md" in cmd_a and f"{tmp_path}/b/out.md" in cmd_b
+    assert "{dir}" not in " ".join(cmd_a)
+    assert job.fingerprint == Job("k", "compare",
+                                  ("--markdown-out", "{dir}/out.md")).fingerprint
+
+
+def test_toml_and_json_specs_expand_identically(tmp_path):
+    toml_text = """
+[campaign]
+name = "parity"
+[defaults]
+flags = ["--timing", "fused"]
+[[job]]
+id = "j1"
+program = "matmul"
+flags = ["--sizes", "64"]
+[[sweep]]
+program = "matmul"
+sizes = [32, 64]
+dtypes = ["bfloat16", "int8"]
+"""
+    json_data = {
+        "campaign": {"name": "parity"},
+        "defaults": {"flags": ["--timing", "fused"]},
+        "job": [{"id": "j1", "program": "matmul",
+                 "flags": ["--sizes", "64"]}],
+        "sweep": [{"program": "matmul", "sizes": [32, 64],
+                   "dtypes": ["bfloat16", "int8"]}],
+    }
+    tp, jp = tmp_path / "s.toml", tmp_path / "s.json"
+    tp.write_text(toml_text)
+    jp.write_text(json.dumps(json_data))
+    try:
+        from_toml = load_spec(tp)
+    except CampaignSpecError as e:  # no TOML parser in this env
+        pytest.skip(str(e))
+    from_json = load_spec(jp)
+    assert [j.job_id for j in from_toml.jobs] == \
+        [j.job_id for j in from_json.jobs]
+    assert [j.fingerprint for j in from_toml.jobs] == \
+        [j.fingerprint for j in from_json.jobs]
+    # and re-expanding is deterministic
+    assert [j.fingerprint for j in load_spec(jp).jobs] == \
+        [j.fingerprint for j in from_json.jobs]
+
+
+def test_sweep_expansion_axis_major_order():
+    spec = spec_from_dict({
+        "sweep": [{"program": "matmul", "id_prefix": "g",
+                   "sizes": [32, 64], "dtypes": ["bfloat16", "int8"],
+                   "flags": ["--iterations", "2"]}]})
+    assert [j.job_id for j in spec.jobs] == [
+        "g_s32_bfloat16", "g_s32_int8", "g_s64_bfloat16", "g_s64_int8"]
+    assert spec.jobs[0].argv == ("--sizes", "32", "--dtype", "bfloat16",
+                                 "--iterations", "2")
+
+
+def test_default_flags_prepended():
+    spec = spec_from_dict(_basic_dict(
+        defaults={"flags": ["--timing", "fused"], "retries": 7}))
+    assert spec.jobs[0].argv[:2] == ("--timing", "fused")
+    assert spec.jobs[0].retries == 7
+
+
+@pytest.mark.parametrize("mutate,match", [
+    (lambda d: d["job"].append(dict(d["job"][0])), "duplicate job id"),
+    (lambda d: d["job"][0].update(program="nope"), "unknown program"),
+    (lambda d: d["job"][0]["flags"].append("--json-out"), "--json-out"),
+    (lambda d: d.update(jobz=[]), "unknown top-level"),
+    (lambda d: d.pop("job"), "no jobs"),
+    (lambda d: d["job"][0].update(program="campaign"), "unknown program"),
+    (lambda d: d["job"][0].update(timeout_s=-1), "timeout_s"),
+])
+def test_spec_validation_errors(mutate, match):
+    d = _basic_dict()
+    mutate(d)
+    with pytest.raises(CampaignSpecError, match=match):
+        spec_from_dict(d)
+
+
+# ------------------------------------------------------------- journal
+
+def test_journal_roundtrip_and_torn_final_line(tmp_path):
+    with state.Journal(tmp_path / state.JOURNAL_NAME) as j:
+        j.record("fp1", "j1", state.PENDING)
+        j.record("fp1", "j1", state.RUNNING, attempt=1)
+        j.record("fp1", "j1", state.DONE, attempt=1, rc=0)
+    # simulate the kill the journal exists to survive: a torn last line
+    with open(tmp_path / state.JOURNAL_NAME, "a") as fh:
+        fh.write('{"fingerprint": "fp2", "status": "runn')
+    events = state.load_events(tmp_path)
+    assert [e.status for e in events] == [state.PENDING, state.RUNNING,
+                                          state.DONE]
+    assert state.finished_fingerprints(events) == {"fp1"}
+
+
+def test_done_never_uncompletes():
+    # a resume appends `skipped` AFTER `done`; latest-event reading would
+    # call the job unfinished and re-run it — membership-ever must not
+    events = [state.JobEvent("fp1", "j1", state.DONE),
+              state.JobEvent("fp1", "j1", state.SKIPPED)]
+    assert state.finished_fingerprints(events) == {"fp1"}
+    assert state.latest_status(events)["fp1"].status == state.SKIPPED
+
+
+# ------------------------------------------------------------ executor
+
+def _spec_one_job(**policy):
+    return spec_from_dict(_basic_dict(defaults=policy))
+
+
+def _ok_launch(records=1):
+    """A launcher that fakes a successful child: writes the ledger the
+    --json-out flag in cmd points at."""
+    def launch(cmd, *, log, timeout_s, env):
+        ledger = cmd[cmd.index("--json-out") + 1]
+        with open(ledger, "w") as fh:
+            fh.write(json.dumps({"record_type": "manifest",
+                                 "schema_version": 2}) + "\n")
+            for i in range(records):
+                fh.write(json.dumps({
+                    "benchmark": "matmul", "mode": "single", "size": 64,
+                    "iterations": 2, "tflops_per_device": 1.0 + i}) + "\n")
+        return executor.LaunchResult(rc=0)
+    return launch
+
+
+def test_success_journal_sequence(tmp_path):
+    spec = _spec_one_job()
+    outcomes = executor.run_campaign(spec, tmp_path, env={},
+                                     launch=_ok_launch(), sleep=lambda s: None)
+    assert [o.status for o in outcomes] == [state.DONE]
+    seq = [(e.job_id, e.status) for e in state.load_events(tmp_path)]
+    assert seq == [("j1", state.PENDING), ("j1", state.RUNNING),
+                   ("j1", state.DONE)]
+    assert (tmp_path / executor.SPEC_COPY_NAME).exists()
+
+
+def test_retry_backoff_on_transport_then_fail(tmp_path):
+    spec = _spec_one_job(retries=2, backoff_s=1.0)
+    delays = []
+
+    def transport_launch(cmd, *, log, timeout_s, env):
+        with open(log, "a") as fh:  # a real Gloo transport signature
+            fh.write("gloo AllReduce failed: Connection closed by peer\n")
+        return executor.LaunchResult(rc=1)
+
+    outcomes = executor.run_campaign(spec, tmp_path, env={},
+                                     launch=transport_launch,
+                                     sleep=delays.append)
+    assert [o.status for o in outcomes] == [state.FAILED]
+    assert outcomes[0].attempts == 3
+    # exponential from base 1.0s but floored at the transport minimum —
+    # the tunnel that dropped the pair is still dropping it a second later
+    assert delays == [executor.TRANSPORT_MIN_BACKOFF_S] * 2
+    running = [e for e in state.load_events(tmp_path)
+               if e.status == state.RUNNING]
+    assert [e.attempt for e in running if not e.detail] == [1, 2, 3]
+    assert sum("retry in" in e.detail for e in running) == 2
+
+
+def test_backoff_exponential_capped_for_plain_errors(tmp_path):
+    job = Job("j", "matmul", ("--sizes", "64"), backoff_s=300.0)
+    assert executor.backoff_delay(job, 1, "error") == 300.0
+    assert executor.backoff_delay(job, 2, "error") == 600.0
+    assert executor.backoff_delay(job, 3, "error") == executor.BACKOFF_CAP_S
+    # plain errors don't get the transport floor
+    assert executor.backoff_delay(Job("j", "matmul", (), backoff_s=1.0),
+                                  1, "error") == 1.0
+
+
+def test_rc0_empty_ledger_is_a_failure(tmp_path):
+    # the r5 multihost flake: clean exit, no results — must not be DONE
+    spec = _spec_one_job(retries=0)
+    outcomes = executor.run_campaign(spec, tmp_path, env={},
+                                     launch=_ok_launch(records=0),
+                                     sleep=lambda s: None)
+    assert outcomes[0].status == state.FAILED
+    assert "no measurement records" in outcomes[0].detail
+
+
+def test_timeout_classified_and_logged(tmp_path):
+    spec = _spec_one_job(retries=1, backoff_s=2.0)
+    delays = []
+
+    def timeout_launch(cmd, *, log, timeout_s, env):
+        return executor.LaunchResult(rc=None, timed_out=True)
+
+    outcomes = executor.run_campaign(spec, tmp_path, env={},
+                                     launch=timeout_launch,
+                                     sleep=delays.append)
+    assert outcomes[0].status == state.FAILED
+    assert outcomes[0].detail == "timeout"
+    assert delays == [2.0]  # no transport floor for timeouts
+
+
+def test_resume_skips_done_without_launching(tmp_path):
+    spec = _spec_one_job()
+    executor.run_campaign(spec, tmp_path, env={}, launch=_ok_launch(),
+                          sleep=lambda s: None)
+
+    def must_not_run(cmd, **kw):
+        raise AssertionError("resume re-launched a finished job")
+
+    outcomes = executor.run_campaign(spec, tmp_path, resume=True, env={},
+                                     launch=must_not_run,
+                                     sleep=lambda s: None)
+    assert [o.status for o in outcomes] == [state.SKIPPED]
+
+
+def test_fresh_run_refuses_existing_journal(tmp_path):
+    spec = _spec_one_job()
+    executor.run_campaign(spec, tmp_path, env={}, launch=_ok_launch(),
+                          sleep=lambda s: None)
+    with pytest.raises(RuntimeError, match="resume"):
+        executor.run_campaign(spec, tmp_path, env={}, launch=_ok_launch(),
+                              sleep=lambda s: None)
+
+
+def test_ledger_unlinked_before_each_attempt(tmp_path):
+    # a timeout-killed attempt leaves a partial ledger; the next attempt
+    # must start from an empty file, not splice two half-runs
+    spec = _spec_one_job(retries=1, backoff_s=0.0)
+    calls = []
+
+    def flaky_launch(cmd, *, log, timeout_s, env):
+        ledger = cmd[cmd.index("--json-out") + 1]
+        calls.append(ledger)
+        if len(calls) == 1:
+            with open(ledger, "w") as fh:  # partial junk, then "killed"
+                fh.write('{"benchmark": "matmul", "tru')
+            return executor.LaunchResult(rc=None, timed_out=True)
+        import os
+        assert not os.path.exists(ledger)  # partial file was unlinked
+        return _ok_launch()(cmd, log=log, timeout_s=timeout_s, env=env)
+
+    def launch(cmd, *, log, timeout_s, env):
+        r = flaky_launch(cmd, log=log, timeout_s=timeout_s, env=env)
+        return r
+
+    outcomes = executor.run_campaign(spec, tmp_path, env={}, launch=launch,
+                                     sleep=lambda s: None)
+    assert outcomes[0].status == state.DONE
+    recs = [json.loads(l) for l in
+            outcomes[0].ledger.read_text().splitlines()]
+    assert sum("benchmark" in r for r in recs) == 1  # one run's output
+
+
+# --------------------------------------------------------- store + gate
+
+def _built_campaign(tmp_path, records=2):
+    spec = spec_from_dict({
+        "campaign": {"name": "s"},
+        "job": [{"id": "j1", "program": "matmul",
+                 "flags": ["--sizes", "64", "--iterations", "2"]},
+                {"id": "j2", "program": "matmul",
+                 "flags": ["--sizes", "32", "--iterations", "2"]}]})
+    executor.run_campaign(spec, tmp_path, env={},
+                          launch=_ok_launch(records=records),
+                          sleep=lambda s: None)
+    return spec
+
+
+def test_store_summary_and_merged_records(tmp_path):
+    spec = _built_campaign(tmp_path, records=3)
+    store = CampaignStore.load(tmp_path)
+    assert store.status_counts() == {state.DONE: 2}
+    summ = store.summary()
+    for job in spec.jobs:
+        row = summ[job.fingerprint]
+        assert row["job_id"] == job.job_id
+        # best-of estimator: max over the job's records (1.0, 2.0, 3.0)
+        assert row["tflops_per_device"] == 3.0
+        assert row["n_records"] == 3
+    merged = store.merged_records()
+    assert len(merged) == 6
+    assert {r["campaign_job_id"] for r in merged} == {"j1", "j2"}
+
+
+def test_gate_self_compare_passes_and_snapshot_roundtrip(tmp_path):
+    _built_campaign(tmp_path / "c")
+    summ = gate_mod.load_summary(tmp_path / "c")
+    report = gate_mod.run_gate(summ, summ)
+    assert report.exit_code == gate_mod.EXIT_PASS
+    snap = tmp_path / "base.json"
+    gate_mod.write_baseline(summ, snap)
+    assert gate_mod.load_summary(snap) == json.loads(
+        json.dumps(summ))  # JSON round-trip normalizes tuples etc.
+    assert gate_mod.run_gate(summ, gate_mod.load_summary(snap)).passed
+
+
+def test_gate_flags_regression_and_missing_and_new():
+    base = {"f1": {"job_id": "a", "tflops_per_device": 100.0},
+            "f2": {"job_id": "b", "tflops_per_device": 50.0}}
+    cur = {"f1": {"job_id": "a", "tflops_per_device": 90.0},  # −10%
+           "f3": {"job_id": "c", "tflops_per_device": 10.0}}
+    report = gate_mod.run_gate(cur, base)
+    verdicts = {r.job_id: r.verdict for r in report.rows}
+    assert verdicts == {"a": "regression", "b": "missing", "c": "new"}
+    assert report.exit_code == gate_mod.EXIT_REGRESSION
+    # a campaign must not pass by dropping its slowest row: missing alone
+    # is also a failure
+    report2 = gate_mod.run_gate(
+        {"f1": {"job_id": "a", "tflops_per_device": 100.0}}, base)
+    assert report2.exit_code == gate_mod.EXIT_REGRESSION
+
+
+def test_gate_tolerance_widens_with_noise_never_below_floor():
+    base = {"job_id": "a", "tflops_per_device": 100.0, "noise_pct": 4.0}
+    cur = {"job_id": "a", "tflops_per_device": 94.0, "noise_pct": 1.0}
+    # 2 × max(noise) = 8% > the 5% threshold: a −6% delta is inside it
+    assert gate_mod.tolerance_pct(5.0, base, cur) == 8.0
+    report = gate_mod.run_gate({"f": cur}, {"f": base})
+    assert report.rows[0].verdict == "ok"
+    # no noise info: the documented drift floor still applies
+    assert gate_mod.tolerance_pct(0.5, {}, {}) == gate_mod.NOISE_FLOOR_PCT
+
+
+def test_gate_no_overlap_is_unusable():
+    report = gate_mod.run_gate(
+        {"f1": {"job_id": "a", "tflops_per_device": 1.0}},
+        {"f2": {"job_id": "b", "tflops_per_device": 1.0}})
+    assert report.exit_code == gate_mod.EXIT_UNUSABLE
+
+
+def test_load_summary_rejects_non_baseline_json(tmp_path):
+    p = tmp_path / "x.json"
+    p.write_text(json.dumps({"jobs": {}}))
+    with pytest.raises(RuntimeError, match="not a campaign baseline"):
+        gate_mod.load_summary(p)

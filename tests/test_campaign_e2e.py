@@ -1,0 +1,198 @@
+"""End-to-end campaign tests on CPU: real child processes through
+`python -m tpu_matmul_bench campaign`, including the crash-safety
+acceptance case — SIGKILL mid-campaign, resume, every ledger present
+exactly once and no finished job re-run.
+
+Tier-1 (not `slow`): the jobs are tiny CPU matmuls; the cost is child
+interpreter startup, bounded by the shared compilation cache
+(tests/envutil.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_matmul_bench.campaign import cli, executor, state
+from tpu_matmul_bench.campaign import gate as gate_mod
+
+from tests.envutil import scrubbed_env
+
+REPO = Path(__file__).resolve().parent.parent
+
+_SMOKE_SPEC = {
+    "campaign": {"name": "smoke"},
+    "defaults": {"timeout_s": 300.0, "retries": 0},
+    # no --samples: the gate's tolerance must stay at the plain threshold
+    # (tiny CPU matmuls measure 30–40% per-iteration jitter, which would
+    # widen a noise-aware tolerance past any injectable regression)
+    "job": [
+        {"id": "small", "program": "matmul",
+         "flags": ["--sizes", "32", "--iterations", "2", "--warmup", "1",
+                   "--num-devices", "1"]},
+        {"id": "large", "program": "matmul",
+         "flags": ["--sizes", "64", "--iterations", "2", "--warmup", "1",
+                   "--num-devices", "1"]},
+    ],
+}
+
+
+def _run_cli(args: list[str], timeout: float = 240.0):
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_matmul_bench", "campaign", *args],
+        cwd=REPO, env=scrubbed_env("cpu"), capture_output=True, text=True,
+        timeout=timeout)
+
+
+@pytest.fixture(scope="module")
+def campaign_dir(tmp_path_factory):
+    """One completed 2-job CPU campaign, shared by the read-only tests."""
+    root = tmp_path_factory.mktemp("campaign_e2e")
+    spec = root / "spec.json"
+    spec.write_text(json.dumps(_SMOKE_SPEC))
+    d = root / "run"
+    out = _run_cli(["run", str(spec), "--dir", str(d)])
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "campaign: 2/2 jobs done" in out.stdout
+    return d
+
+
+def test_smoke_artifacts(campaign_dir):
+    events = state.load_events(campaign_dir)
+    assert len(state.finished_fingerprints(events)) == 2
+    for job_id in ("small", "large"):
+        ledger = campaign_dir / "jobs" / f"{job_id}.jsonl"
+        assert executor.ledger_measurement_count(ledger) >= 1
+        assert (campaign_dir / "jobs" / f"{job_id}.log").exists()
+    assert (campaign_dir / "spec.json").exists()
+
+
+def test_status_and_dry_run_in_process(campaign_dir, tmp_path, capsys):
+    assert cli.main(["status", str(campaign_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "small" in out and "large" in out and "done=2" in out
+
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps(_SMOKE_SPEC))
+    assert cli.main(["run", str(spec), "--dir", str(tmp_path / "nope"),
+                     "--dry-run"]) == 0
+    out = capsys.readouterr().out
+    assert "2 jobs (dry run; nothing executed)" in out
+    assert "--json-out" in out
+    assert not (tmp_path / "nope").exists() or \
+        not list((tmp_path / "nope").iterdir())
+
+
+def test_gate_self_compare_passes(campaign_dir, tmp_path, capsys):
+    snap = tmp_path / "baseline.json"
+    assert cli.main(["gate", str(campaign_dir),
+                     "--baseline", str(campaign_dir),
+                     "--write-baseline", str(snap)]) == 0
+    out = capsys.readouterr().out
+    assert "gate: PASS (2 compared, 0 failing, exit 0)" in out
+    data = json.loads(snap.read_text())
+    assert data["kind"] == gate_mod.BASELINE_KIND
+    assert len(data["jobs"]) == 2
+
+
+def test_gate_fails_on_injected_regression(campaign_dir, tmp_path, capsys):
+    # inflate the baseline 10% above what the campaign measured — the
+    # campaign now reads ~9.1% below baseline, past the 5% threshold
+    summ = gate_mod.load_summary(campaign_dir)
+    inflated = {fp: {**row, "tflops_per_device":
+                     row["tflops_per_device"] * 1.10}
+                for fp, row in summ.items()}
+    snap = tmp_path / "inflated.json"
+    gate_mod.write_baseline(inflated, snap)
+    with pytest.raises(SystemExit) as ei:
+        cli.main(["gate", str(campaign_dir), "--baseline", str(snap)])
+    assert ei.value.code == gate_mod.EXIT_REGRESSION
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "gate: FAIL" in out
+    # ... and the subprocess spelling agrees on the exit code
+    res = _run_cli(["gate", str(campaign_dir), "--baseline", str(snap)])
+    assert res.returncode == gate_mod.EXIT_REGRESSION, res.stdout
+
+
+def test_sigkill_midcampaign_then_resume_completes(tmp_path):
+    """The acceptance case: SIGKILL the campaign (and its in-flight
+    child) after the first job lands, resume, and every job must end
+    done with its ledger present exactly once — the finished job is
+    never re-run, the in-flight one is, none are lost."""
+    spec_d = {
+        "campaign": {"name": "killable"},
+        "defaults": {"timeout_s": 300.0, "retries": 0},
+        # enough per-child work (startup dominates) that job 2 is
+        # reliably in flight when job 1's `done` hits the journal
+        "job": [
+            {"id": f"j{n}", "program": "matmul",
+             "flags": ["--sizes", str(s), "--iterations", "40",
+                       "--warmup", "2", "--num-devices", "1"]}
+            for n, s in enumerate((384, 512, 640), start=1)
+        ],
+    }
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps(spec_d))
+    d = tmp_path / "run"
+    journal = d / state.JOURNAL_NAME
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_matmul_bench", "campaign", "run",
+         str(spec), "--dir", str(d)],
+        cwd=REPO, env=scrubbed_env("cpu"), start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            if journal.exists() and '"status": "done"' in journal.read_text():
+                break
+            if proc.poll() is not None:
+                pytest.fail("campaign exited before first job finished")
+            time.sleep(0.05)
+        else:
+            pytest.fail("no job finished within the deadline")
+        # kill the whole process group: the campaign parent AND the
+        # in-flight benchmark child, like a dropped ssh session would
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+
+    events = state.load_events(d)
+    done_before = state.finished_fingerprints(events)
+    assert 1 <= len(done_before) < 3, \
+        f"kill was not mid-campaign: {len(done_before)} jobs done"
+
+    res = _run_cli(["resume", str(d)], timeout=300.0)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "3/3 jobs done" in res.stdout
+
+    events = state.load_events(d)
+    by_fp_done = {}
+    for ev in events:
+        if ev.status == state.DONE:
+            by_fp_done[ev.fingerprint] = by_fp_done.get(ev.fingerprint, 0) + 1
+    # every job done EXACTLY once: the pre-kill finisher was skipped on
+    # resume (no duplicate run), the killed + pending jobs ran once each
+    assert len(by_fp_done) == 3
+    assert set(by_fp_done.values()) == {1}
+    for fp in done_before:  # the finished job never re-launched
+        attempts = [ev for ev in events if ev.fingerprint == fp
+                    and ev.status == state.RUNNING and not ev.detail]
+        assert len(attempts) == 1
+    for n in (1, 2, 3):  # every ledger present, exactly one run's output
+        ledger = d / "jobs" / f"j{n}.jsonl"
+        assert executor.ledger_measurement_count(ledger) >= 1
+        manifests = sum(
+            1 for line in ledger.read_text().splitlines()
+            if '"record_type": "manifest"' in line or
+            '"record_type":"manifest"' in line)
+        assert manifests <= 1

@@ -79,6 +79,53 @@ def test_samples_columns_and_drift_flag(tmp_path, capsys):
     assert sum("p50=" in l for l in out.splitlines()) == 1
 
 
+def test_campaign_dir_digests_as_one_table(tmp_path, capsys):
+    """A campaign directory (journal.jsonl + jobs/*.jsonl, as written by
+    `campaign run`) digests all job ledgers into ONE ranked table with
+    job-id labels and the journal's status counts in the header."""
+    (tmp_path / "jobs").mkdir()
+    journal = [
+        {"fingerprint": "aa", "job_id": "fast", "status": "pending"},
+        {"fingerprint": "aa", "job_id": "fast", "status": "done"},
+        # a resumed campaign appends `skipped` after `done` — still done
+        {"fingerprint": "aa", "job_id": "fast", "status": "skipped"},
+        {"fingerprint": "bb", "job_id": "slow", "status": "failed"},
+    ]
+    (tmp_path / "journal.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in journal)
+        + '{"fingerprint": "cc", "status": "runn')  # torn line tolerated
+    _write(tmp_path / "jobs", "fast.jsonl", [
+        {"record_type": "manifest", "schema_version": 2},
+        {"benchmark": "matmul", "mode": "single", "size": 64,
+         "iterations": 3, "tflops_per_device": 4.0, "extras": {}},
+    ])
+    _write(tmp_path / "jobs", "slow.jsonl", [
+        {"benchmark": "matmul", "mode": "single", "size": 128,
+         "iterations": 3, "tflops_per_device": 9.0, "extras": {}},
+    ])
+    digest.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert f"## campaign {tmp_path} (2 job ledgers; 1 done, 1 failed)" in out
+    rows = [l for l in out.splitlines() if "job=" in l]
+    assert len(rows) == 2
+    assert "job=slow" in rows[0] and "9.00" in rows[0]  # ranked across jobs
+    assert "job=fast" in rows[1]
+    assert "[manifest]" not in out  # per-job manifests are boilerplate here
+
+
+def test_non_campaign_dir_unchanged(tmp_path, capsys):
+    # a plain directory of JSONLs (no journal, no jobs/) keeps the
+    # per-file sections — the campaign path must not leak into it
+    _write(tmp_path, "a.jsonl", [
+        {"benchmark": "matmul", "mode": "single", "size": 64,
+         "iterations": 3, "tflops_per_device": 1.0, "extras": {}}])
+    digest.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "## campaign" not in out
+    assert f"## {tmp_path / 'a.jsonl'} (1 records)" in out
+    assert "job=" not in out
+
+
 @pytest.mark.parametrize("round_dir", ["r2", "r3", "r4", "r5"])
 def test_pre_v2_round_files_still_digest(round_dir, capsys):
     """Compat check: the hand-measured round files (no manifest, no

@@ -100,24 +100,37 @@ def test_format_blocks_contain_reference_fields():
     assert "Configuration:" in h and "Devices: 2" in h
 
 
-def test_run_sizes_transport_errors_fail_fast():
+def test_run_sizes_transport_errors_fail_fast(monkeypatch):
     # r5 multihost-race root cause: a Gloo 'Connection closed by peer'
     # mid-collective was swallowed by the per-size OOM backstop, leaving
     # a desynced cluster running and a CLEAN exit with no results. The
     # runner must re-raise transport errors (cluster-fatal) while keeping
     # OOM skip-and-continue (reference parity) and generic-error
-    # resilience.
+    # resilience. The re-raise is gated on a cluster actually being
+    # active (ADVICE r5): the signatures are substrings, so a SINGLE-
+    # process run whose exception merely mentions 'Connection refused'
+    # must keep per-size skip semantics.
+    import tpu_matmul_bench.benchmarks.runner as runner_mod
     from tpu_matmul_bench.benchmarks.runner import run_sizes
     from tpu_matmul_bench.utils.config import parse_config
 
     config = parse_config(["--sizes", "64", "128"], "d")
 
-    def boom_transport(size):
-        raise RuntimeError(
-            "Gloo allreduce failed: Connection closed by peer [127.0.0.1]")
+    def transport_then_ok(size):
+        if size == 64:
+            raise RuntimeError(
+                "Gloo allreduce failed: Connection closed by peer "
+                "[127.0.0.1]")
+        return _rec(size=size)
 
+    # single-process (this test env): per-size resilience, no re-raise
+    recs = run_sizes(config, transport_then_ok)
+    assert [r.size for r in recs] == [128]
+
+    # on an active cluster: cluster-fatal, re-raise
+    monkeypatch.setattr(runner_mod, "distributed_active", lambda: True)
     with pytest.raises(RuntimeError, match="Connection closed by peer"):
-        run_sizes(config, boom_transport)
+        run_sizes(config, transport_then_ok)
 
     # OOM still skips and continues to the next size
     calls = []

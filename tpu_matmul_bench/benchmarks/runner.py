@@ -14,6 +14,7 @@ from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.device import apply_matmul_precision
 from tpu_matmul_bench.utils.errors import (
+    distributed_active,
     is_oom_error,
     is_transport_error,
     release_device_memory,
@@ -74,7 +75,7 @@ def run_sizes(
             except Exception as e:  # noqa: BLE001 — per-size resilience
                 if is_oom_error(e):
                     report(f"\n  ERROR: Out of memory for {size}x{size} matrices")
-                elif is_transport_error(e):
+                elif is_transport_error(e) and distributed_active():
                     # r5 root-cause of the multihost "rc==0 with no
                     # results" flake: a Gloo TCP pair dropping mid-
                     # collective was swallowed here as if it were an OOM,
@@ -84,6 +85,10 @@ def run_sizes(
                     # failures are cluster-fatal, not per-size: re-raise
                     # so the run exits nonzero and the launcher retries
                     # the whole cluster (the torchrun-elastic analogue).
+                    # Gated on a cluster actually being active (ADVICE
+                    # r5): the signatures are substrings, and a single-
+                    # process run whose exception merely mentions
+                    # 'Connection refused' keeps per-size skip semantics.
                     report(f"\n  FATAL: cluster transport failure at "
                            f"{size}x{size}: {e}")
                     raise

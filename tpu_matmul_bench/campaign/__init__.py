@@ -1,0 +1,22 @@
+"""Campaign subsystem: declarative sweeps with resumable execution.
+
+A campaign is the repo's unit of *rounds*: a declarative spec (TOML/JSON
+— `spec.py`) expands into a deterministic, fingerprinted job plan; the
+executor (`executor.py`) runs each job as a child process of the
+existing per-program CLIs with per-job timeout and backoff retries; a
+crash-safe journal (`state.py`) makes a SIGKILLed campaign resumable at
+job granularity; the store (`store.py`) merges the per-job schema-v2
+ledgers into one queryable result set; and the gate (`gate.py`) turns a
+campaign-vs-baseline comparison into a single noise-aware pass/fail for
+CI and the round driver. Entry point: `python -m tpu_matmul_bench
+campaign {run,resume,status,gate}` (`cli.py`).
+"""
+
+from tpu_matmul_bench.campaign.spec import (  # noqa: F401
+    CampaignSpec,
+    CampaignSpecError,
+    Job,
+    job_fingerprint,
+    load_spec,
+    spec_from_dict,
+)

@@ -1,0 +1,258 @@
+"""Campaign executor: each job is a child process of a program CLI.
+
+Replaces the bash step driver (`scripts/measure_r5_steps.sh`) as the way
+multi-row rounds run: per-job timeout (a wedged tunnel step slow-fails in
+25 min–2 h; the timeout bounds it), bounded exponential-backoff retries,
+transport-error classification via `utils/errors.py` (a dropped Gloo/ICI
+transport gets the long backoff the r5 watcher gave a dead tunnel —
+retrying instantly re-fails), and a journaled status transition per
+attempt so `--resume` re-runs only unfinished fingerprints.
+
+Each job's `--json-out` schema-v2 ledger lands at
+``<campaign_dir>/jobs/<job_id>.jsonl`` and its merged stdout+stderr at
+``jobs/<job_id>.log``. Success requires BOTH rc == 0 AND at least one
+measurement record in the ledger — the r5 multihost flake (clean exit,
+empty results) must read as a failure here, not a completed job.
+
+The campaign parent never initializes a JAX backend: the children own the
+chips (same reason `compare --isolate` keeps its parent backend-free).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import shlex
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any, Callable, Mapping
+
+from tpu_matmul_bench.campaign import state
+from tpu_matmul_bench.campaign.spec import CampaignSpec, Job
+from tpu_matmul_bench.utils import telemetry
+from tpu_matmul_bench.utils.errors import is_transport_message
+
+JOBS_SUBDIR = "jobs"
+SPEC_COPY_NAME = "spec.json"
+
+# backoff grows base * 2^(attempt-1), capped — a transport-dead tunnel
+# needs minutes, not unbounded hours (measure_r5.sh used 180 s..900 s)
+BACKOFF_CAP_S = 900.0
+# transport failures get at least the r5 watcher's short backoff: the
+# tunnel that dropped the TCP pair is still dropping it one second later
+TRANSPORT_MIN_BACKOFF_S = 60.0
+
+# how many trailing log bytes the failure classifier reads
+_LOG_TAIL_BYTES = 64 * 1024
+
+
+@dataclasses.dataclass
+class LaunchResult:
+    """What one attempt of one job produced."""
+
+    rc: int | None  # None = killed on timeout
+    timed_out: bool = False
+    error: str = ""  # launcher-level failure (spawn error), not job output
+
+
+@dataclasses.dataclass
+class JobOutcome:
+    job: Job
+    status: str  # state.DONE / state.FAILED / state.SKIPPED
+    attempts: int
+    ledger: Path
+    detail: str = ""
+
+
+def job_paths(campaign_dir: str | Path, job: Job) -> tuple[Path, Path]:
+    """(ledger, log) paths for a job inside the campaign directory."""
+    jobs = Path(campaign_dir) / JOBS_SUBDIR
+    return jobs / f"{job.job_id}.jsonl", jobs / f"{job.job_id}.log"
+
+
+def job_command(job: Job, campaign_dir: str | Path,
+                ledger: Path) -> list[str]:
+    """The child argv: the program CLI with the per-job ledger injected.
+    `{dir}` placeholders resolve here — after fingerprinting."""
+    argv = [a.replace("{dir}", str(campaign_dir)) for a in job.argv]
+    return [sys.executable, "-m", "tpu_matmul_bench", job.program,
+            *argv, "--json-out", str(ledger)]
+
+
+def _default_launch(cmd: list[str], *, log: Path, timeout_s: float,
+                    env: Mapping[str, str] | None) -> LaunchResult:
+    with open(log, "a") as fh:
+        fh.write(f"+ {shlex.join(cmd)}\n")
+        fh.flush()
+        try:
+            proc = subprocess.run(
+                cmd, stdout=fh, stderr=subprocess.STDOUT,
+                timeout=timeout_s or None,
+                env=dict(env) if env is not None else None)
+        except subprocess.TimeoutExpired:
+            fh.write(f"\n[campaign] TIMEOUT after {timeout_s:.0f}s "
+                     "(child killed)\n")
+            return LaunchResult(rc=None, timed_out=True)
+        except OSError as e:
+            return LaunchResult(rc=None, error=f"spawn failed: {e}")
+    return LaunchResult(rc=proc.returncode)
+
+
+def ledger_measurement_count(ledger: Path) -> int:
+    """Measurement records in a job ledger (manifest header excluded)."""
+    if not ledger.exists():
+        return 0
+    n = 0
+    for line in ledger.read_text().splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and not telemetry.is_manifest(d) \
+                and "benchmark" in d:
+            n += 1
+    return n
+
+
+def _classify_failure(result: LaunchResult, log: Path) -> str:
+    """'timeout' | 'transport' | 'error' — drives the backoff policy."""
+    if result.timed_out:
+        return "timeout"
+    if result.error:
+        return "error"
+    try:
+        with open(log, "rb") as fh:
+            fh.seek(0, 2)
+            fh.seek(max(0, fh.tell() - _LOG_TAIL_BYTES))
+            tail = fh.read().decode(errors="replace")
+    except OSError:
+        tail = ""
+    return "transport" if is_transport_message(tail) else "error"
+
+
+def backoff_delay(job: Job, attempt: int, kind: str) -> float:
+    """Exponential backoff before attempt N+1: base · 2^(N−1), capped;
+    transport failures take at least the watcher's short backoff."""
+    delay = min(job.backoff_s * (2.0 ** (attempt - 1)), BACKOFF_CAP_S)
+    if kind == "transport":
+        delay = max(delay, TRANSPORT_MIN_BACKOFF_S)
+    return delay
+
+
+def _campaign_env(env: Mapping[str, str] | None) -> dict[str, str] | None:
+    """Children share a persistent compilation cache (measure_r5.sh's
+    setup): a timed-out cold compile still populates the cache, so the
+    retry runs warm. The package root rides PYTHONPATH so `python -m
+    tpu_matmul_bench` resolves in the child from any working directory
+    (the package runs uninstalled from the repo checkout)."""
+    import os
+
+    out = dict(os.environ if env is None else env)
+    out.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    out.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    pkg_root = str(Path(__file__).resolve().parents[2])
+    parts = out.get("PYTHONPATH", "").split(os.pathsep)
+    if pkg_root not in parts:
+        out["PYTHONPATH"] = os.pathsep.join([pkg_root] + [p for p in parts if p])
+    return out
+
+
+def prepare_campaign_dir(spec: CampaignSpec, campaign_dir: str | Path, *,
+                         resume: bool) -> Path:
+    """Create the directory layout and persist the canonical spec copy.
+    A fresh `run` refuses a directory that already has a journal (that is
+    what `--resume`/`resume` are for — never silently restart a half-done
+    campaign); `resume` reuses the persisted spec copy byte-for-byte."""
+    d = Path(campaign_dir)
+    journal = d / state.JOURNAL_NAME
+    if journal.exists() and not resume:
+        raise RuntimeError(
+            f"{d} already holds a campaign journal; use "
+            f"`campaign resume {d}` (or run --resume) to continue it")
+    (d / JOBS_SUBDIR).mkdir(parents=True, exist_ok=True)
+    spec_copy = d / SPEC_COPY_NAME
+    if not spec_copy.exists():
+        spec_copy.write_text(spec.to_json() + "\n")
+    return d
+
+
+def run_campaign(
+    spec: CampaignSpec,
+    campaign_dir: str | Path,
+    *,
+    resume: bool = False,
+    env: Mapping[str, str] | None = None,
+    launch: Callable[..., LaunchResult] | None = None,
+    sleep: Callable[[float], Any] = time.sleep,
+) -> list[JobOutcome]:
+    """Run every unfinished job in the plan, journaling each transition.
+
+    `launch` and `sleep` are injectable for tests (fault injection,
+    backoff assertions); production uses subprocess + time.sleep.
+    """
+    d = prepare_campaign_dir(spec, campaign_dir, resume=resume)
+    launch = launch or _default_launch
+    env = _campaign_env(env)
+    done_fps = state.finished_fingerprints(state.load_events(d))
+    outcomes: list[JobOutcome] = []
+
+    with state.Journal(d / state.JOURNAL_NAME) as journal:
+        # roster first: a kill during job 1 must still leave the full
+        # plan visible to `status` (pending = journaled, not implicit)
+        for job in spec.jobs:
+            if job.fingerprint not in done_fps:
+                journal.record(job.fingerprint, job.job_id, state.PENDING)
+
+        for job in spec.jobs:
+            ledger, log = job_paths(d, job)
+            if job.fingerprint in done_fps:
+                journal.record(job.fingerprint, job.job_id, state.SKIPPED,
+                               detail="resume: already done")
+                outcomes.append(JobOutcome(job, state.SKIPPED, 0, ledger,
+                                           "already done"))
+                continue
+            outcomes.append(_run_one(job, d, ledger, log, journal,
+                                     launch=launch, env=env, sleep=sleep))
+    return outcomes
+
+
+def _run_one(job: Job, d: Path, ledger: Path, log: Path,
+             journal: state.Journal, *, launch, env, sleep) -> JobOutcome:
+    cmd = job_command(job, d, ledger)
+    max_attempts = job.retries + 1
+    detail = ""
+    for attempt in range(1, max_attempts + 1):
+        journal.record(job.fingerprint, job.job_id, state.RUNNING,
+                       attempt=attempt)
+        with telemetry.span(f"job:{job.job_id}", attempt=attempt,
+                            program=job.program):
+            # a retried job's ledger must not splice two half-runs: the
+            # child reopens --json-out in "w" mode, but a timeout-killed
+            # attempt may have left a partial file a later VALID attempt
+            # would sit after — unlink so the ledger is one run's output
+            ledger.unlink(missing_ok=True)
+            result = launch(cmd, log=log, timeout_s=job.timeout_s, env=env)
+        if result.rc == 0:
+            n = ledger_measurement_count(ledger)
+            if n > 0:
+                journal.record(job.fingerprint, job.job_id, state.DONE,
+                               attempt=attempt, rc=0,
+                               detail=f"{n} records")
+                return JobOutcome(job, state.DONE, attempt, ledger)
+            # rc==0 with no results: the r5 multihost flake — a failure
+            kind = "error"
+            detail = "rc=0 but ledger has no measurement records"
+        else:
+            kind = _classify_failure(result, log)
+            detail = result.error or kind
+        if attempt < max_attempts:
+            delay = backoff_delay(job, attempt, kind)
+            journal.record(job.fingerprint, job.job_id, state.RUNNING,
+                           attempt=attempt, rc=result.rc,
+                           detail=f"retry in {delay:.0f}s: {detail}")
+            sleep(delay)
+    journal.record(job.fingerprint, job.job_id, state.FAILED,
+                   attempt=max_attempts, rc=result.rc, detail=detail)
+    return JobOutcome(job, state.FAILED, max_attempts, ledger, detail)

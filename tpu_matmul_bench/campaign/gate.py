@@ -1,0 +1,163 @@
+"""Regression gate: compare a campaign against a baseline, exit nonzero
+on regression — the single pass/fail CI and the round driver consume.
+
+Comparison is fingerprint-to-fingerprint (same program, same argv — the
+identity `spec.job_fingerprint` hashes), so only like measurements are
+ever compared; jobs present on one side only are reported, and a job the
+BASELINE measured that the current campaign lost is itself a failure (a
+campaign must not pass by dropping its slowest rows).
+
+The threshold is noise-aware: a job regresses only when its headline
+throughput falls more than ``max(threshold, noise_floor, 2·noise_pct)``
+below baseline, where `noise_pct` is the per-iteration sample jitter
+(`extras["samples"]`, when either side ran `--samples`) and the floor is
+the documented ±1.5% single-run drift of the tunneled chip
+(RESULTS_TPU.md r4) — a 2% wobble at 16k must not page anyone, a real 5%
+loss must.
+
+Baselines: another campaign directory, or a baseline snapshot JSON
+(written by ``campaign gate --write-baseline BASELINE_CAMPAIGN.json``) so
+a round's blessed numbers can be checked in and gated against without
+carrying the whole campaign dir.
+
+Exit codes (``campaign gate``): 0 = pass; 1 = regression or lost job;
+2 = unusable input (no overlapping fingerprints, unreadable dirs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any
+
+from tpu_matmul_bench.campaign.store import CampaignStore
+
+# single runs on the tunneled chip drift ±1.5% minutes apart
+# (RESULTS_TPU.md r4) — no gate should be tighter than the instrument
+NOISE_FLOOR_PCT = 1.5
+DEFAULT_THRESHOLD_PCT = 5.0
+
+BASELINE_KIND = "campaign_baseline"
+
+EXIT_PASS = 0
+EXIT_REGRESSION = 1
+EXIT_UNUSABLE = 2
+
+
+@dataclasses.dataclass
+class GateRow:
+    fingerprint: str
+    job_id: str
+    verdict: str  # 'ok' | 'regression' | 'missing' | 'new'
+    baseline: float | None = None
+    current: float | None = None
+    delta_pct: float | None = None
+    tolerance_pct: float | None = None
+
+    def format(self) -> str:
+        if self.verdict == "new":
+            return (f"  NEW        {self.job_id}: {self.current:.2f} "
+                    "(no baseline row)")
+        if self.verdict == "missing":
+            return (f"  MISSING    {self.job_id}: baseline has "
+                    f"{self.baseline:.2f}, campaign has no result")
+        tag = "REGRESSION" if self.verdict == "regression" else "ok"
+        return (f"  {tag:<10} {self.job_id}: {self.baseline:.2f} → "
+                f"{self.current:.2f} ({self.delta_pct:+.2f}%, "
+                f"tolerance ±{self.tolerance_pct:.2f}%)")
+
+
+@dataclasses.dataclass
+class GateReport:
+    rows: list[GateRow]
+    exit_code: int
+
+    @property
+    def passed(self) -> bool:
+        return self.exit_code == EXIT_PASS
+
+    def format(self) -> str:
+        order = {"regression": 0, "missing": 1, "new": 2, "ok": 3}
+        lines = [r.format() for r in
+                 sorted(self.rows, key=lambda r: (order[r.verdict],
+                                                  r.job_id))]
+        n_bad = sum(r.verdict in ("regression", "missing")
+                    for r in self.rows)
+        lines.append(f"gate: {'PASS' if self.exit_code == EXIT_PASS else 'FAIL'}"
+                     f" ({len(self.rows)} compared, {n_bad} failing,"
+                     f" exit {self.exit_code})")
+        return "\n".join(lines)
+
+
+def load_summary(path: str | Path) -> dict[str, dict[str, Any]]:
+    """A gate side: a campaign directory, or a baseline snapshot JSON."""
+    p = Path(path)
+    if p.is_dir():
+        return CampaignStore.load(p).summary()
+    try:
+        data = json.loads(p.read_text())
+    except (OSError, ValueError) as e:
+        raise RuntimeError(f"unreadable baseline {p}: {e}") from e
+    if not isinstance(data, dict) or data.get("kind") != BASELINE_KIND \
+            or not isinstance(data.get("jobs"), dict):
+        raise RuntimeError(
+            f"{p} is not a campaign baseline snapshot "
+            f'(expected {{"kind": "{BASELINE_KIND}", "jobs": ...}})')
+    return data["jobs"]
+
+
+def write_baseline(summary: dict[str, dict[str, Any]],
+                   path: str | Path) -> None:
+    """Snapshot a campaign's summary as a checked-in-able baseline."""
+    payload = {"kind": BASELINE_KIND, "schema_version": 1, "jobs": summary}
+    Path(path).write_text(json.dumps(payload, indent=2, sort_keys=True)
+                          + "\n")
+
+
+def tolerance_pct(threshold_pct: float,
+                  baseline_row: dict[str, Any],
+                  current_row: dict[str, Any]) -> float:
+    """The noise-aware allowance for one job: the configured threshold,
+    never tighter than the drift floor, widened to 2× the measured
+    per-iteration jitter when either side sampled it."""
+    noises = [r.get("noise_pct") for r in (baseline_row, current_row)
+              if isinstance(r.get("noise_pct"), (int, float))]
+    measured = max(noises) if noises else 0.0
+    return max(threshold_pct, NOISE_FLOOR_PCT, 2.0 * measured)
+
+
+def run_gate(current: dict[str, dict[str, Any]],
+             baseline: dict[str, dict[str, Any]],
+             *, threshold_pct: float = DEFAULT_THRESHOLD_PCT) -> GateReport:
+    rows: list[GateRow] = []
+    for fp, base in sorted(baseline.items(),
+                           key=lambda kv: kv[1].get("job_id", kv[0])):
+        b = base.get("tflops_per_device")
+        cur = current.get(fp)
+        if cur is None or not isinstance(cur.get("tflops_per_device"),
+                                         (int, float)):
+            rows.append(GateRow(fp, base.get("job_id", fp), "missing",
+                                baseline=b))
+            continue
+        c = cur["tflops_per_device"]
+        if not isinstance(b, (int, float)) or b <= 0:
+            rows.append(GateRow(fp, base.get("job_id", fp), "new",
+                                current=c))
+            continue
+        tol = tolerance_pct(threshold_pct, base, cur)
+        delta = 100.0 * (c - b) / b
+        verdict = "regression" if delta < -tol else "ok"
+        rows.append(GateRow(fp, cur.get("job_id") or base.get("job_id", fp),
+                            verdict, baseline=b, current=c,
+                            delta_pct=delta, tolerance_pct=tol))
+    for fp, cur in sorted(current.items(),
+                          key=lambda kv: kv[1].get("job_id", kv[0])):
+        if fp not in baseline:
+            rows.append(GateRow(fp, cur.get("job_id", fp), "new",
+                                current=cur.get("tflops_per_device")))
+    compared = [r for r in rows if r.verdict in ("ok", "regression")]
+    if not compared:
+        return GateReport(rows, EXIT_UNUSABLE)
+    failing = any(r.verdict in ("regression", "missing") for r in rows)
+    return GateReport(rows, EXIT_REGRESSION if failing else EXIT_PASS)

@@ -1,0 +1,151 @@
+"""Campaign result store: job ledgers indexed by fingerprint, merged.
+
+A completed campaign directory holds one schema-v2 JSONL ledger per job
+(`jobs/<id>.jsonl`, manifest header + measurement records — see
+`utils/telemetry.py`), the status journal, and the canonical spec copy.
+This module joins the three into one queryable result set:
+
+- `CampaignStore.load(dir)` — parse everything, keyed by fingerprint;
+- `merged_records()` — every measurement record across all jobs, each
+  stamped with its campaign job id + fingerprint (the cross-job analogue
+  of one ledger file);
+- `summary()` — the per-job headline the regression gate compares: best
+  throughput, its time, and a noise estimate from the record's
+  `extras["samples"]` distribution when the run carried `--samples`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any
+
+from tpu_matmul_bench.campaign import state
+from tpu_matmul_bench.campaign.executor import JOBS_SUBDIR, SPEC_COPY_NAME
+from tpu_matmul_bench.campaign.spec import (
+    CampaignSpec,
+    CampaignSpecError,
+    spec_from_dict,
+)
+from tpu_matmul_bench.utils import telemetry
+
+
+@dataclasses.dataclass
+class JobLedger:
+    """One job's parsed ledger."""
+
+    job_id: str
+    fingerprint: str
+    status: str  # latest journaled status; state.PENDING if never journaled
+    manifest: dict[str, Any] | None
+    records: list[dict[str, Any]]
+
+
+@dataclasses.dataclass
+class CampaignStore:
+    campaign_dir: Path
+    spec: CampaignSpec
+    jobs: dict[str, JobLedger]  # fingerprint → ledger
+
+    @classmethod
+    def load(cls, campaign_dir: str | Path) -> "CampaignStore":
+        d = Path(campaign_dir)
+        spec_copy = d / SPEC_COPY_NAME
+        if not spec_copy.exists():
+            raise FileNotFoundError(
+                f"{d} is not a campaign directory (no {SPEC_COPY_NAME})")
+        try:
+            spec = spec_from_dict(json.loads(spec_copy.read_text()))
+        except (ValueError, CampaignSpecError) as e:
+            raise RuntimeError(f"unreadable campaign spec in {d}: {e}") from e
+        latest = state.latest_status(state.load_events(d))
+        done = state.finished_fingerprints(state.load_events(d))
+        jobs: dict[str, JobLedger] = {}
+        for job in spec.jobs:
+            fp = job.fingerprint
+            manifest, records = _read_ledger(
+                d / JOBS_SUBDIR / f"{job.job_id}.jsonl")
+            if fp in done:
+                status = state.DONE
+            elif fp in latest:
+                status = latest[fp].status
+            else:
+                status = state.PENDING
+            jobs[fp] = JobLedger(job_id=job.job_id, fingerprint=fp,
+                                 status=status, manifest=manifest,
+                                 records=records)
+        return cls(campaign_dir=d, spec=spec, jobs=jobs)
+
+    def status_counts(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for jl in self.jobs.values():
+            counts[jl.status] = counts.get(jl.status, 0) + 1
+        return counts
+
+    def merged_records(self) -> list[dict[str, Any]]:
+        """All measurement records, each stamped with provenance keys
+        (`campaign_job_id`, `campaign_fingerprint`) on a copy."""
+        merged = []
+        for jl in self.jobs.values():
+            for rec in jl.records:
+                merged.append({**rec, "campaign_job_id": jl.job_id,
+                               "campaign_fingerprint": jl.fingerprint})
+        return merged
+
+    def summary(self) -> dict[str, dict[str, Any]]:
+        """Fingerprint → the gate's comparison row. The headline metric is
+        the job's best `tflops_per_device` (the repo's best-of estimator:
+        single runs drift ±1.5%, the max over a job's records is the
+        stable throughput reading); `noise_pct` comes from the best
+        record's per-iteration sample stddev when present."""
+        out: dict[str, dict[str, Any]] = {}
+        for fp, jl in self.jobs.items():
+            rows = [r for r in jl.records
+                    if isinstance(r.get("tflops_per_device"), (int, float))]
+            if not rows:
+                continue
+            best = max(rows, key=lambda r: r["tflops_per_device"])
+            out[fp] = {
+                "job_id": jl.job_id,
+                "status": jl.status,
+                "tflops_per_device": best["tflops_per_device"],
+                "avg_time_s": best.get("avg_time_s"),
+                "n_records": len(rows),
+                "noise_pct": _noise_pct(best),
+            }
+        return out
+
+
+def _noise_pct(rec: dict[str, Any]) -> float | None:
+    """Relative per-iteration jitter (stddev/p50) of a record's sample
+    distribution, as a percentage — the measured noise the gate widens
+    its tolerance by. None when the run did not carry `--samples`."""
+    smp = (rec.get("extras") or {}).get("samples")
+    if not isinstance(smp, dict):
+        return None
+    sd, p50 = smp.get("stddev_ms"), smp.get("p50_ms")
+    if not isinstance(sd, (int, float)) or not isinstance(p50, (int, float)) \
+            or p50 <= 0:
+        return None
+    return 100.0 * sd / p50
+
+
+def _read_ledger(path: Path) -> tuple[dict[str, Any] | None,
+                                      list[dict[str, Any]]]:
+    if not path.exists():
+        return None, []
+    manifest = None
+    records = []
+    for line in path.read_text().splitlines():
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        if telemetry.is_manifest(d):
+            manifest = d
+        elif "benchmark" in d:
+            records.append(d)
+    return manifest, records

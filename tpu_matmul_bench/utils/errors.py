@@ -42,6 +42,15 @@ _TRANSPORT_SIGNATURES = (
 _GLOO_OP_FAILED = re.compile(r"gloo \w+ failed", re.IGNORECASE)
 
 
+def is_transport_message(msg: str) -> bool:
+    """Text-level transport classification, for callers that only have a
+    captured message — a child process's stderr tail (campaign executor)
+    or a formatted exception."""
+    low = msg.lower()
+    return (any(sig.lower() in low for sig in _TRANSPORT_SIGNATURES)
+            or _GLOO_OP_FAILED.search(low) is not None)
+
+
 def is_transport_error(e: BaseException) -> bool:
     """A dropped cluster transport (e.g. Gloo 'Connection closed by peer'
     mid-collective, observed under heavy host load — tests/test_multihost
@@ -51,9 +60,27 @@ def is_transport_error(e: BaseException) -> bool:
     cluster risks deadlock or silent corruption. Callers must fail fast —
     the launcher/harness retries the whole cluster cleanly (the torchrun-
     elastic analogue), which is the only sound recovery unit."""
-    msg = str(e).lower()
-    return (any(sig.lower() in msg for sig in _TRANSPORT_SIGNATURES)
-            or _GLOO_OP_FAILED.search(msg) is not None)
+    return is_transport_message(str(e))
+
+
+def distributed_active() -> bool:
+    """True when this process is part of a multi-process cluster — the
+    only regime where a transport failure is cluster-fatal. The signature
+    match is substring-based ('Connection refused', 'Broken pipe'), so a
+    single-process run whose per-size exception merely mentions such a
+    phrase (a wrapped I/O error, say) must NOT lose per-size resilience
+    (ADVICE r5): callers gate the fail-fast re-raise on this."""
+    try:
+        if jax.distributed.is_initialized():
+            return True
+    except AttributeError:  # jax < 0.5 has no is_initialized
+        state = getattr(jax.distributed, "global_state", None)
+        if getattr(state, "client", None) is not None:
+            return True
+    try:
+        return jax.process_count() > 1
+    except RuntimeError:
+        return False  # backend not initialized: trivially single-process
 
 
 def release_device_memory(*arrays: object) -> None:

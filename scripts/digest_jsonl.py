@@ -35,8 +35,34 @@ def _rank_key(r):
             -(r.get("tflops_per_device") or 0))
 
 
+def _serve_row(r, s) -> str:
+    """Serve records headline latency under load, not throughput: the
+    p50/p95/p99 ladder, achieved vs offered QPS, shed %, cache hit rate."""
+    ex = r.get("extras") or {}
+    shape = ex.get("shape") or f"{r.get('size')}²"
+    qps = f"{s.get('achieved_qps')}qps"
+    if "offered_qps" in s:
+        qps += f"/{s.get('offered_qps')}"
+    cache = s.get("cache") or {}
+    bits = (f"p50={s.get('p50_ms')} p95={s.get('p95_ms')} "
+            f"p99={s.get('p99_ms')} max={s.get('max_ms')}ms "
+            f"{qps} shed={s.get('shed_rate_pct')}% "
+            f"cache={cache.get('hit_rate_pct')}%hit")
+    if cache.get("evictions"):
+        bits += f" evict={cache.get('evictions')}"
+    if s.get("cold_requests"):
+        bits += f" cold={s.get('cold_requests')}"
+    if s.get("padding_overhead_pct"):
+        bits += f" pad={s.get('padding_overhead_pct')}%"
+    return (f"  {'serve':>8} {s.get('load_mode', ''):6} "
+            f"{shape:>18} {r.get('mode', ''):24} "
+            f"{'':>18} it={r.get('iterations')} {bits}")
+
+
 def _row(r) -> str:
     ex = r.get("extras") or {}
+    if r.get("benchmark") == "serve" and isinstance(ex.get("serve"), dict):
+        return _serve_row(r, ex["serve"])
     shape = ex.get("shape") or f"{r.get('size')}²"
     blocks = ""
     if "block_m" in ex:  # tuner records carry the blocking

@@ -84,3 +84,33 @@ def test_cli_matmul_trace_and_manifest_end_to_end():
 
     # stdout phase summary accompanied the trace
     assert "phase summary" in out.stdout
+
+
+def test_cli_serve_selftest_validates_its_own_ledger():
+    """`serve selftest` is the serving path's CI hook: it must exit 0,
+    emit a manifest-headed schema-v2 ledger on stdout, and re-validate
+    the serve record contract in-process (nonzero exit on violation)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu_matmul_bench", "serve", "selftest",
+         "--mix", "64", "--json-out", "-"],
+        env=scrubbed_env(platforms="cpu", device_count=1),
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "selftest ok" in out.stdout
+    parsed = []
+    for line in out.stdout.splitlines():
+        try:
+            parsed.append(json.loads(line))
+        except ValueError:
+            continue
+    manifests = [d for d in parsed if isinstance(d, dict)
+                 and d.get("record_type") == "manifest"]
+    records = [d for d in parsed if isinstance(d, dict)
+               and d.get("benchmark") == "serve"]
+    assert len(manifests) == 1 and len(records) == 1
+    assert manifests[0]["schema_version"] >= 2
+    assert manifests[0]["serve_config"]["load_mode"] == "selftest"
+    s = records[0]["extras"]["serve"]
+    assert s["requests"] > 0 and s["p50_ms"] <= s["p99_ms"]
+    assert s["cache"]["misses"] == 1  # one mix entry → one executable

@@ -79,6 +79,30 @@ def test_samples_columns_and_drift_flag(tmp_path, capsys):
     assert sum("p50=" in l for l in out.splitlines()) == 1
 
 
+def test_serve_records_render_latency_table(tmp_path, capsys):
+    p = _write(tmp_path, "serve.jsonl", [
+        {"benchmark": "serve", "mode": "open", "size": 512,
+         "iterations": 95, "tflops_per_device": 0.005,
+         "extras": {"shape": "256,512:0.5", "serve": {
+             "load_mode": "open", "p50_ms": 4.7, "p95_ms": 9.1,
+             "p99_ms": 12.3, "max_ms": 20.0, "achieved_qps": 47.6,
+             "offered_qps": 50.0, "shed_rate_pct": 2.1,
+             "cold_requests": 2, "padding_overhead_pct": 8.5,
+             "cache": {"hit_rate_pct": 97.9, "evictions": 3}}}},
+        {"benchmark": "matmul", "mode": "single", "size": 64,
+         "iterations": 3, "tflops_per_device": 1.5, "extras": {}},
+    ])
+    digest.main([str(p)])
+    out = capsys.readouterr().out
+    line = next(l for l in out.splitlines() if "p50=" in l)
+    for bit in ("p50=4.7", "p95=9.1", "p99=12.3", "max=20.0ms",
+                "47.6qps/50.0", "shed=2.1%", "cache=97.9%hit",
+                "evict=3", "cold=2", "pad=8.5%", "256,512:0.5"):
+        assert bit in line, f"{bit!r} missing from: {line}"
+    # non-serve rows in the same file keep the throughput format
+    assert any("1.50 TFLOPS" in l for l in out.splitlines())
+
+
 def test_campaign_dir_digests_as_one_table(tmp_path, capsys):
     """A campaign directory (journal.jsonl + jobs/*.jsonl, as written by
     `campaign run`) digests all job ledgers into ONE ranked table with

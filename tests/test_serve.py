@@ -1,0 +1,352 @@
+"""Serving subsystem tests: the padded-shape bucketing, the AOT
+executable cache's key discipline and LRU accounting, shed-on-overflow
+backpressure (the queue must answer "no" fast, never block the
+producer), load-schedule determinism under a seed, the latency-direction
+regression gate, and a CPU end-to-end smoke of
+`python -m tpu_matmul_bench serve bench` (manifest + monotone
+percentiles + warm-cache hits on an appended second window).
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from tests.envutil import scrubbed_env
+from tpu_matmul_bench.campaign import gate as gate_mod
+from tpu_matmul_bench.serve.cache import ExecKey, ExecutableCache
+from tpu_matmul_bench.serve.loadgen import (
+    closed_loop_shapes,
+    open_loop_schedule,
+    parse_mix,
+)
+from tpu_matmul_bench.serve.queue import AdmissionQueue, Request, ShapeGrid
+from tpu_matmul_bench.utils.errors import QueueOverflowError, is_overload_error
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------------ bucketing
+
+def test_grid_picks_smallest_covering_point():
+    g = ShapeGrid((128, 256, 512))
+    assert g.bucket_dim(1) == 128
+    assert g.bucket_dim(128) == 128  # exact point maps to itself
+    assert g.bucket_dim(129) == 256
+    assert g.bucket_dim(300) == 512
+    assert g.bucket(129, 512, 1) == (256, 512, 128)
+
+
+def test_grid_beyond_top_rounds_to_multiple_of_top():
+    g = ShapeGrid((128, 512))
+    assert g.bucket_dim(513) == 1024
+    assert g.bucket_dim(1024) == 1024
+    assert g.bucket_dim(1025) == 1536
+
+
+def test_grid_rejects_nonsense():
+    with pytest.raises(ValueError):
+        ShapeGrid(())
+    with pytest.raises(ValueError):
+        ShapeGrid((0, 128))
+    with pytest.raises(ValueError):
+        ShapeGrid((128,)).bucket_dim(0)
+
+
+# ------------------------------------------------------------ exec cache
+
+def _build(key: ExecKey):
+    return lambda a, b: a @ b
+
+
+def test_cache_key_pinning_and_label():
+    # the key IS the executable identity: any axis change is a new entry
+    k = ExecKey(256, 512, 1024, "bfloat16", "xla", (4,))
+    assert k.label == "256x512x1024/bfloat16/xla"
+    assert k == ExecKey(256, 512, 1024, "bfloat16", "xla", (4,))
+    for other in (ExecKey(256, 512, 1024, "float32", "xla", (4,)),
+                  ExecKey(256, 512, 1024, "bfloat16", "pallas", (4,)),
+                  ExecKey(256, 512, 1024, "bfloat16", "xla", (8,)),
+                  ExecKey(512, 512, 1024, "bfloat16", "xla", (4,))):
+        assert k != other
+
+
+def test_cache_compiles_once_then_hits():
+    cache = ExecutableCache(_build, capacity=4)
+    key = ExecKey(8, 8, 8, "float32", "xla")
+    e1 = cache.get(key)
+    e2 = cache.get(key)
+    assert e1 is e2
+    assert (cache.hits, cache.misses) == (1, 1)
+    assert e1.cold_compile_s > 0
+    assert cache.stats()["by_entry"][key.label]["hits"] == 1
+    import numpy as np
+
+    out = e1.compiled(np.ones((8, 8), "float32"), np.ones((8, 8), "float32"))
+    assert out.shape == (8, 8) and float(out[0, 0]) == 8.0
+
+
+def test_cache_lru_evicts_oldest_not_recently_used():
+    cache = ExecutableCache(_build, capacity=2)
+    k1, k2, k3 = (ExecKey(8, 8, 8, "float32", f"i{i}") for i in range(3))
+    cache.get(k1)
+    cache.get(k2)
+    cache.get(k1)  # refresh k1: k2 is now LRU
+    cache.get(k3)  # evicts k2
+    assert k1 in cache and k3 in cache and k2 not in cache
+    assert cache.evictions == 1
+
+
+# ------------------------------------------------------- admission queue
+
+def _req(rid, n=64, dtype="float32"):
+    return Request(rid=rid, m=n, k=n, n=n, dtype=dtype)
+
+
+def test_queue_overflow_sheds_fast_instead_of_blocking():
+    q = AdmissionQueue(ShapeGrid((64,)), max_depth=2, window_s=0)
+    q.submit(_req(0))
+    q.submit(_req(1))
+    t0 = time.perf_counter()
+    with pytest.raises(QueueOverflowError) as exc:
+        q.submit(_req(2))
+    assert time.perf_counter() - t0 < 0.1  # shed, not a blocked producer
+    assert q.shed == 1 and q.submitted == 2
+    assert is_overload_error(exc.value)
+    assert is_overload_error(str(exc.value))  # classifiable from text too
+    assert exc.value.max_depth == 2
+
+
+def test_queue_microbatch_groups_same_bucket_fifo():
+    q = AdmissionQueue(ShapeGrid((64, 128)), max_depth=16, window_s=0,
+                       max_batch=8)
+    q.submit(_req(0, 64))
+    q.submit(_req(1, 128))
+    q.submit(_req(2, 60))  # buckets with rid 0
+    q.submit(_req(3, 128))
+    b1 = q.take_batch()
+    assert [r.rid for r in b1] == [0, 2]  # head's bucket, FIFO, gap skipped
+    b2 = q.take_batch()
+    assert [r.rid for r in b2] == [1, 3]
+    q.close()
+    assert q.take_batch() is None
+
+
+def test_queue_batch_capped_and_window_waits_for_stragglers():
+    q = AdmissionQueue(ShapeGrid((64,)), max_depth=16, window_s=0,
+                       max_batch=2)
+    for rid in range(3):
+        q.submit(_req(rid))
+    assert [r.rid for r in q.take_batch()] == [0, 1]
+    # a straggler arriving inside the window joins the head's batch
+    q2 = AdmissionQueue(ShapeGrid((64,)), max_depth=16, window_s=0.2,
+                        max_batch=8)
+    q2.submit(_req(0))
+    threading.Timer(0.05, lambda: q2.submit(_req(1))).start()
+    assert [r.rid for r in q2.take_batch()] == [0, 1]
+
+
+def test_submit_stamps_bucket_and_closed_queue_refuses():
+    q = AdmissionQueue(ShapeGrid((64, 128)), max_depth=4)
+    req = q.submit(_req(0, 100))
+    assert req.bucket == (128, 128, 128) and req.submitted_at > 0
+    q.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        q.submit(_req(1))
+
+
+# --------------------------------------------------------------- loadgen
+
+def test_parse_mix_shapes_weights_and_errors():
+    entries = parse_mix("256, 1024x512x128:2.5")
+    assert [(e.m, e.k, e.n, e.weight) for e in entries] == [
+        (256, 256, 256, 1.0), (1024, 512, 128, 2.5)]
+    for bad in ("", "0", "64x64", "64:-1", "64:0", "ax64"):
+        with pytest.raises(ValueError):
+            parse_mix(bad)
+
+
+def test_open_loop_schedule_deterministic_under_seed():
+    mix = parse_mix("64,128:3")
+    a = open_loop_schedule(mix, qps=200, duration_s=1.0, dtype="float32",
+                           seed=7)
+    b = open_loop_schedule(mix, qps=200, duration_s=1.0, dtype="float32",
+                           seed=7)
+    assert [(r.rid, r.m, r.arrival_s) for r in a] == \
+        [(r.rid, r.m, r.arrival_s) for r in b]
+    c = open_loop_schedule(mix, qps=200, duration_s=1.0, dtype="float32",
+                           seed=8)
+    assert [(r.m, r.arrival_s) for r in a] != [(r.m, r.arrival_s) for r in c]
+    assert all(0 <= r.arrival_s < 1.0 for r in a)
+    assert [r.rid for r in a] == list(range(len(a)))
+    # ~200 arrivals expected; Poisson spread stays well inside 4 sigma
+    assert 130 < len(a) < 270
+
+
+def test_closed_loop_shapes_deterministic_and_weighted():
+    mix = parse_mix("64:1,128:9")
+    it = closed_loop_shapes(mix, dtype="float32", seed=3)
+    first = [next(it).m for _ in range(200)]
+    it2 = closed_loop_shapes(mix, dtype="float32", seed=3)
+    assert first == [next(it2).m for _ in range(200)]
+    assert first.count(128) > first.count(64)  # weights bite
+
+
+# ------------------------------------------------- latency-direction gate
+
+def _serve_row(p99, noise=2.0):
+    return {"job_id": "s", "p99_latency_ms": p99, "noise_pct": noise,
+            "tflops_per_device": 1.0}
+
+
+def test_gate_latency_regresses_up_not_down():
+    base = {"f": _serve_row(10.0)}
+    assert gate_mod.run_gate({"f": _serve_row(10.3)}, base).passed  # +3% ok
+    assert gate_mod.run_gate({"f": _serve_row(5.0)}, base).passed  # faster!
+    report = gate_mod.run_gate({"f": _serve_row(16.0)}, base)  # +60%
+    assert report.exit_code == gate_mod.EXIT_REGRESSION
+    row = report.rows[0]
+    assert row.metric == gate_mod.LATENCY_METRIC
+    assert "ms p99" in row.format()
+    # throughput rows would have called −50% a regression; latency gate
+    # must not reward a slowdown dressed as one
+    assert gate_mod.run_gate({"f": _serve_row(16.0)}, base).rows[0].verdict \
+        == "regression"
+
+
+def test_gate_latency_tolerance_uses_capped_serve_noise():
+    base = {"f": _serve_row(10.0, noise=15.0)}
+    cur = {"f": _serve_row(12.5, noise=15.0)}  # +25% < 2×15% tolerance
+    assert gate_mod.run_gate(cur, base).passed
+    assert gate_mod.run_gate({"f": _serve_row(14.0, noise=15.0)},
+                             base).exit_code == gate_mod.EXIT_REGRESSION
+
+
+def test_gate_mixed_sides_fall_back_to_throughput():
+    # a pre-serve baseline snapshot has no p99 key: both sides still
+    # gate, on the metric both carry
+    base = {"f": {"job_id": "s", "tflops_per_device": 10.0}}
+    cur = {"f": _serve_row(99.0) | {"tflops_per_device": 10.1}}
+    report = gate_mod.run_gate(cur, base)
+    assert report.passed
+    assert report.rows[0].metric == gate_mod.THROUGHPUT_METRIC
+
+
+def test_store_summary_headlines_min_p99_for_serve_jobs():
+    from tpu_matmul_bench.campaign.store import CampaignStore, JobLedger
+
+    def srec(p99, noise):
+        return {"benchmark": "serve", "tflops_per_device": 0.01,
+                "extras": {"serve": {"p50_ms": 1.0, "p99_ms": p99,
+                                     "shed_rate_pct": 0.0,
+                                     "p99_noise_pct": noise}}}
+
+    store = CampaignStore(
+        campaign_dir=Path("."), spec=None,
+        jobs={"fp": JobLedger(job_id="s1", fingerprint="fp", status="done",
+                              manifest=None,
+                              records=[srec(12.0, 3.0), srec(9.0, 4.0)])})
+    row = store.summary()["fp"]
+    # best-of with the axis flipped: min p99 across the job's records,
+    # noise from the serve harness's capped estimate (not stddev/p50)
+    assert row["p99_latency_ms"] == 9.0
+    assert row["noise_pct"] == 4.0
+    assert row["n_records"] == 2
+
+
+# ------------------------------------------------------- record contract
+
+def test_validate_serve_record_catches_tampering():
+    from tpu_matmul_bench.serve.service import validate_serve_record
+    from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+
+    def rec():
+        return BenchmarkRecord(
+            benchmark="serve", mode="open", size=64, dtype="float32",
+            world=1, iterations=3, warmup=0, avg_time_s=0.01,
+            tflops_per_device=1.0, tflops_total=1.0,
+            extras={"serve": {
+                "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0, "max_ms": 4.0,
+                "shed_rate_pct": 0.0, "achieved_qps": 10.0, "requests": 3,
+                "cache": {"hits": 2, "misses": 1},
+                "queue": {"submitted": 3, "shed": 0}}})
+
+    assert validate_serve_record(rec()) == []
+    r = rec()
+    r.extras["serve"]["p95_ms"] = 9.0  # breaks monotonicity
+    assert any("monotone" in p for p in validate_serve_record(r))
+    r = rec()
+    del r.extras["serve"]["p99_ms"]
+    assert any("p99_ms" in p for p in validate_serve_record(r))
+    r = rec()
+    r.extras["serve"]["cache"] = {"hits": 0, "misses": 1}
+    assert any("cover" in p for p in validate_serve_record(r))
+    r = rec()
+    del r.extras["serve"]
+    assert validate_serve_record(r) == ["extras['serve'] block missing"]
+
+
+# ------------------------------------------------------------ e2e smoke
+
+def _run_serve(args, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_matmul_bench", "serve", *args],
+        env=scrubbed_env(platforms="cpu", device_count=1),
+        capture_output=True, text=True, timeout=timeout, cwd=str(REPO))
+
+
+def _ledger(path):
+    manifests, records = [], []
+    for line in Path(path).read_text().splitlines():
+        d = json.loads(line)
+        (manifests if d.get("record_type") == "manifest"
+         else records).append(d)
+    return manifests, records
+
+
+def test_serve_bench_end_to_end_appended_windows(tmp_path):
+    """Two short load windows appended into one ledger: one manifest,
+    two records, monotone latency percentiles, and a warm cache (nonzero
+    hits) on the second window."""
+    ledger = tmp_path / "serve.jsonl"
+    args = ["bench", "--qps", "40", "--duration", "1", "--mix", "64,128:0.5",
+            "--prewarm", "--seed", "0", "--json-out", str(ledger), "--append"]
+    for i in range(2):
+        out = _run_serve(args)
+        assert out.returncode == 0, out.stderr[-2000:]
+    manifests, records = _ledger(ledger)
+    assert len(manifests) == 1, "append must not duplicate the manifest"
+    assert manifests[0]["schema_version"] >= 2
+    assert manifests[0]["serve_config"]["mix"] == "64,128:0.5"
+    assert len(records) == 2
+    for rec in records:
+        s = rec["extras"]["serve"]
+        assert rec["benchmark"] == "serve" and rec["mode"] == "open"
+        assert s["p50_ms"] <= s["p95_ms"] <= s["p99_ms"] <= s["max_ms"]
+        assert s["requests"] == rec["iterations"] > 0
+        assert s["shed"] == 0 and s["shed_rate_pct"] == 0.0
+        assert rec["extras"]["samples"]["n"] == s["requests"]
+    # both windows served many requests over 2 executables: warm hits
+    assert records[1]["extras"]["serve"]["cache"]["hits"] > 0
+    # identical seed + mix + qps → identical offered schedule length
+    assert records[0]["extras"]["serve"]["queue"]["submitted"] == \
+        records[1]["extras"]["serve"]["queue"]["submitted"]
+
+
+def test_serve_bench_sheds_under_tiny_depth(tmp_path):
+    """A depth-1 queue under burst load must shed (and say so in the
+    ledger) rather than serve everything late."""
+    ledger = tmp_path / "shed.jsonl"
+    out = _run_serve(["bench", "--qps", "300", "--duration", "1",
+                      "--mix", "256", "--max-depth", "1",
+                      "--json-out", str(ledger)])
+    assert out.returncode == 0, out.stderr[-2000:]
+    _, records = _ledger(ledger)
+    s = records[0]["extras"]["serve"]
+    assert s["shed"] > 0
+    assert s["shed_rate_pct"] > 0
+    assert s["queue"]["shed"] == s["shed"]

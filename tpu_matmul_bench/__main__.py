@@ -23,6 +23,10 @@ _PROGRAMS = {
     "summa": "tpu_matmul_bench.benchmarks.matmul_summa_benchmark",
     "compare": "tpu_matmul_bench.benchmarks.compare_benchmarks",
     "doctor": "tpu_matmul_bench.benchmarks.doctor",
+    # the serving harness: AOT executable cache + admission queue under a
+    # load generator, reporting latency percentiles instead of sustained
+    # TFLOP/s (serve/cli.py) — the latency-SLO complement to the sweeps
+    "serve": "tpu_matmul_bench.serve.cli",
     # the round driver: declarative sweeps over the programs above, with
     # resumable execution and a regression gate (campaign/cli.py). Not a
     # benchmark itself — campaign specs name the other programs as jobs.

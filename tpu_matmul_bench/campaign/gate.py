@@ -15,6 +15,13 @@ the documented ±1.5% single-run drift of the tunneled chip
 (RESULTS_TPU.md r4) — a 2% wobble at 16k must not page anyone, a real 5%
 loss must.
 
+Serve jobs gate on **p99 latency** (their summary rows carry
+`p99_latency_ms`): the same tolerance machinery, with the failing
+direction flipped — latency regresses UP. Their `noise_pct` is the serve
+harness's capped half-split p99 estimate, not sample stddev/p50 (a
+latency distribution under Poisson load is load-spread, not instrument
+jitter).
+
 Baselines: another campaign directory, or a baseline snapshot JSON
 (written by ``campaign gate --write-baseline BASELINE_CAMPAIGN.json``) so
 a round's blessed numbers can be checked in and gated against without
@@ -45,6 +52,10 @@ EXIT_REGRESSION = 1
 EXIT_UNUSABLE = 2
 
 
+THROUGHPUT_METRIC = "tflops_per_device"  # higher is better
+LATENCY_METRIC = "p99_latency_ms"  # lower is better (serve jobs)
+
+
 @dataclasses.dataclass
 class GateRow:
     fingerprint: str
@@ -54,17 +65,19 @@ class GateRow:
     current: float | None = None
     delta_pct: float | None = None
     tolerance_pct: float | None = None
+    metric: str = THROUGHPUT_METRIC
 
     def format(self) -> str:
+        unit = " ms p99" if self.metric == LATENCY_METRIC else ""
         if self.verdict == "new":
-            return (f"  NEW        {self.job_id}: {self.current:.2f} "
+            return (f"  NEW        {self.job_id}: {self.current:.2f}{unit} "
                     "(no baseline row)")
         if self.verdict == "missing":
             return (f"  MISSING    {self.job_id}: baseline has "
-                    f"{self.baseline:.2f}, campaign has no result")
+                    f"{self.baseline:.2f}{unit}, campaign has no result")
         tag = "REGRESSION" if self.verdict == "regression" else "ok"
         return (f"  {tag:<10} {self.job_id}: {self.baseline:.2f} → "
-                f"{self.current:.2f} ({self.delta_pct:+.2f}%, "
+                f"{self.current:.2f}{unit} ({self.delta_pct:+.2f}%, "
                 f"tolerance ±{self.tolerance_pct:.2f}%)")
 
 
@@ -127,35 +140,55 @@ def tolerance_pct(threshold_pct: float,
     return max(threshold_pct, NOISE_FLOOR_PCT, 2.0 * measured)
 
 
+def _metric_for(*rows: dict[str, Any] | None) -> str:
+    """The comparison metric for a fingerprint: latency when EVERY present
+    side carries the serve headline (`p99_latency_ms`), else throughput.
+    Fingerprints hash (program, argv), so mixed sides only occur against a
+    pre-serve baseline snapshot — which gates on throughput, the metric
+    both sides have."""
+    present = [r for r in rows if r is not None]
+    if present and all(isinstance(r.get(LATENCY_METRIC), (int, float))
+                       for r in present):
+        return LATENCY_METRIC
+    return THROUGHPUT_METRIC
+
+
 def run_gate(current: dict[str, dict[str, Any]],
              baseline: dict[str, dict[str, Any]],
              *, threshold_pct: float = DEFAULT_THRESHOLD_PCT) -> GateReport:
     rows: list[GateRow] = []
     for fp, base in sorted(baseline.items(),
                            key=lambda kv: kv[1].get("job_id", kv[0])):
-        b = base.get("tflops_per_device")
         cur = current.get(fp)
-        if cur is None or not isinstance(cur.get("tflops_per_device"),
-                                         (int, float)):
+        metric = _metric_for(base, cur)
+        b = base.get(metric)
+        if cur is None or not isinstance(cur.get(metric), (int, float)):
             rows.append(GateRow(fp, base.get("job_id", fp), "missing",
-                                baseline=b))
+                                baseline=b, metric=metric))
             continue
-        c = cur["tflops_per_device"]
+        c = cur[metric]
         if not isinstance(b, (int, float)) or b <= 0:
             rows.append(GateRow(fp, base.get("job_id", fp), "new",
-                                current=c))
+                                current=c, metric=metric))
             continue
         tol = tolerance_pct(threshold_pct, base, cur)
         delta = 100.0 * (c - b) / b
-        verdict = "regression" if delta < -tol else "ok"
+        # latency regresses UP, throughput regresses DOWN — same noise-
+        # aware tolerance, opposite failing direction
+        if metric == LATENCY_METRIC:
+            verdict = "regression" if delta > tol else "ok"
+        else:
+            verdict = "regression" if delta < -tol else "ok"
         rows.append(GateRow(fp, cur.get("job_id") or base.get("job_id", fp),
                             verdict, baseline=b, current=c,
-                            delta_pct=delta, tolerance_pct=tol))
+                            delta_pct=delta, tolerance_pct=tol,
+                            metric=metric))
     for fp, cur in sorted(current.items(),
                           key=lambda kv: kv[1].get("job_id", kv[0])):
         if fp not in baseline:
+            metric = _metric_for(cur, None)
             rows.append(GateRow(fp, cur.get("job_id", fp), "new",
-                                current=cur.get("tflops_per_device")))
+                                current=cur.get(metric), metric=metric))
     compared = [r for r in rows if r.verdict in ("ok", "regression")]
     if not compared:
         return GateReport(rows, EXIT_UNUSABLE)

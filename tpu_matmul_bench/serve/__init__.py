@@ -1,0 +1,23 @@
+"""Serving subsystem: matmul-as-a-service under latency SLOs.
+
+Every other entry point in this repo is an offline throughput benchmark —
+one shape, compiled fresh, timed in bulk. The serving regime the ROADMAP
+north star names ("heavy traffic from millions of users") is the
+opposite: mixed request shapes arriving concurrently, where what matters
+is cold-compile vs warm-cache dispatch, queueing delay, and tail latency
+under load. This package measures that regime:
+
+- `cache`   — AOT executable cache (`jit(...).lower(...).compile()`),
+  keyed by (M, K, N, dtype, impl, mesh shape), LRU-bounded, with
+  hit/miss/eviction counters and per-entry cold-compile vs warm-dispatch
+  latency;
+- `queue`   — admission queue that buckets requests onto a padded shape
+  grid (distinct request sizes share executables), micro-batches within
+  a window, and sheds on overflow instead of blocking;
+- `loadgen` — deterministic open-loop (Poisson) and closed-loop (fixed
+  concurrency) request generators over a declarative mix spec;
+- `service` — the worker loop wiring cache + queue onto the existing ops,
+  timing each request with the `utils/timing.py` sync discipline and
+  emitting schema-v2 ledgers with per-request latency samples;
+- `cli`     — `python -m tpu_matmul_bench serve {bench,selftest}`.
+"""

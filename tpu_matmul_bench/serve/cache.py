@@ -1,0 +1,167 @@
+"""AOT executable cache — the serving-side replacement for warmup loops.
+
+An offline benchmark absorbs compilation in its warmup and never sees it
+again; a service has no warmup — the first request of a new shape pays
+the full `jit` trace + XLA compile (hundreds of ms to minutes) while its
+successors want pure dispatch (µs–ms). The cache makes that split
+explicit: executables are built ahead-of-time via
+``jax.jit(fn).lower(*ShapeDtypeStructs).compile()`` and retained under a
+structural key, so the compile cost is paid once per (shape, dtype,
+impl, mesh) class and every later request dispatches the cached
+`Compiled` directly — no retrace, no signature dispatch, no cache probe
+inside jit's own machinery.
+
+Entries record what serving dashboards actually need: when the compile
+happened, how long it took (cold path), and the measured warm-dispatch
+latency of the compiled program (one dispatch + sync right after the
+build, the same barrier discipline as `utils/timing.sync`). Counters
+(hits/misses/evictions) feed the ledger's cache statistics.
+
+Capacity is LRU-bounded: a long-lived service facing an adversarial
+shape mix must not grow its executable set without bound (each compiled
+program pins host and device memory). Eviction is the signal the padding
+grid is too fine — the queue's bucketing exists precisely to keep the
+working set of executables small.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+
+from tpu_matmul_bench.utils import telemetry
+
+DEFAULT_CAPACITY = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecKey:
+    """Identity of one cached executable: the padded problem class.
+
+    `impl` is the matmul implementation / serving mode the builder
+    resolves ("xla", "pallas", "auto"); `mesh_shape` the device mesh the
+    program was compiled for — the same program text compiled for a
+    different mesh is a different executable.
+    """
+
+    m: int
+    k: int
+    n: int
+    dtype: str
+    impl: str
+    mesh_shape: tuple[int, ...] = (1,)
+
+    @property
+    def label(self) -> str:
+        return f"{self.m}x{self.k}x{self.n}/{self.dtype}/{self.impl}"
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """One compiled executable plus its measured cost split."""
+
+    key: ExecKey
+    compiled: Callable[..., Any]
+    cold_compile_s: float  # trace + lower + compile wall time
+    warm_dispatch_s: float  # one dispatch + sync of the compiled program
+    hits: int = 0
+    built_at: float = 0.0
+
+
+class ExecutableCache:
+    """LRU cache of AOT-compiled executables.
+
+    ``build(key)`` returns the *traceable* callable for a key (e.g. the
+    matmul the ops layer selects); the cache owns lowering and
+    compilation. ``operands(key)`` (optional) returns the concrete
+    arrays used for the post-compile warm-dispatch measurement — without
+    it the warm dispatch is skipped and recorded as 0.
+    """
+
+    def __init__(
+        self,
+        build: Callable[[ExecKey], Callable[..., Any]],
+        *,
+        capacity: int = DEFAULT_CAPACITY,
+        operands: Callable[[ExecKey], tuple[Any, ...]] | None = None,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._build = build
+        self._operands = operands
+        self._capacity = capacity
+        self._entries: collections.OrderedDict[ExecKey, CacheEntry] = (
+            collections.OrderedDict())
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: ExecKey) -> bool:
+        return key in self._entries
+
+    def get(self, key: ExecKey) -> CacheEntry:
+        """The entry for `key`, compiling on miss. Hits refresh LRU order."""
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+            self.hits += 1
+            entry.hits += 1
+            return entry
+        self.misses += 1
+        entry = self._compile(key)
+        self._entries[key] = entry
+        while len(self._entries) > self._capacity:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+        return entry
+
+    def _compile(self, key: ExecKey) -> CacheEntry:
+        shapes = (
+            jax.ShapeDtypeStruct((key.m, key.k), key.dtype),
+            jax.ShapeDtypeStruct((key.k, key.n), key.dtype),
+        )
+        with telemetry.span(f"aot-compile:{key.label}"):
+            t0 = time.perf_counter()
+            compiled = jax.jit(self._build(key)).lower(*shapes).compile()
+            cold_s = time.perf_counter() - t0
+        warm_s = 0.0
+        if self._operands is not None:
+            from tpu_matmul_bench.utils.timing import sync
+
+            ops = self._operands(key)
+            # first dispatch of a fresh executable can still page in
+            # buffers; measure the second, which is the steady warm path
+            sync(compiled(*ops))
+            t0 = time.perf_counter()
+            sync(compiled(*ops))
+            warm_s = time.perf_counter() - t0
+        return CacheEntry(key=key, compiled=compiled, cold_compile_s=cold_s,
+                          warm_dispatch_s=warm_s, built_at=time.time())
+
+    def stats(self) -> dict[str, Any]:
+        """Ledger-ready counters + per-entry cost split (ms, rounded)."""
+        total = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "entries": len(self._entries),
+            "capacity": self._capacity,
+            "hit_rate_pct": round(100.0 * self.hits / total, 2)
+            if total else 0.0,
+            "by_entry": {
+                e.key.label: {
+                    "cold_compile_ms": round(e.cold_compile_s * 1e3, 3),
+                    "warm_dispatch_ms": round(e.warm_dispatch_s * 1e3, 3),
+                    "hits": e.hits,
+                }
+                for e in self._entries.values()
+            },
+        }

@@ -1,0 +1,154 @@
+"""`python -m tpu_matmul_bench serve {bench,selftest}`.
+
+`bench` runs one load window — open loop (Poisson at `--qps`, the
+default) or closed loop (`--concurrency N`) — over a declarative
+request mix, and writes one schema-v2 ledger record whose extras carry
+the full serving headline set (p50/p95/p99/max latency, achieved QPS,
+shed rate, cache hit/miss/eviction counters, per-bucket breakdown).
+
+`selftest` is the no-load CI hook: compile one executable, serve a
+handful of requests synchronously, and exit nonzero unless the ledger
+contract holds (percentile monotonicity, counter consistency, the
+extras["serve"] key set).
+
+Both are campaign-able: the executor appends `--json-out <ledger>` after
+the subcommand's flags, so a `[[job]] program = "serve"` with
+`flags = ["bench", "--qps", "50", ...]` produces a gated serve ledger
+like any other program (specs/serve.toml is the reference spec).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Sequence
+
+from tpu_matmul_bench.serve.loadgen import DEFAULT_MIX
+from tpu_matmul_bench.serve.queue import (
+    DEFAULT_GRID,
+    DEFAULT_MAX_BATCH,
+    DEFAULT_MAX_DEPTH,
+)
+from tpu_matmul_bench.serve.service import ServeConfig, run_bench, run_selftest
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--mix", default=DEFAULT_MIX,
+                   help="request mix, 'MxKxN:weight,...' (bare N = square "
+                        "NxNxN, weight defaults to 1; default %(default)r)")
+    p.add_argument("--dtype", dest="dtype_name", default="float32",
+                   help="operand dtype for every request (default "
+                        "%(default)s)")
+    p.add_argument("--grid", default=None,
+                   help="padding grid points, comma-separated (default "
+                        f"{','.join(str(g) for g in DEFAULT_GRID)})")
+    p.add_argument("--window-ms", type=float, default=2.0,
+                   help="micro-batch window after the head request's "
+                        "enqueue (default %(default)s ms)")
+    p.add_argument("--max-depth", type=int, default=DEFAULT_MAX_DEPTH,
+                   help="admission queue depth; submissions beyond it are "
+                        "shed (default %(default)s)")
+    p.add_argument("--max-batch", type=int, default=DEFAULT_MAX_BATCH,
+                   help="micro-batch size cap (default %(default)s)")
+    p.add_argument("--cache-capacity", type=int, default=None,
+                   help="executable cache LRU capacity (default 64)")
+    p.add_argument("--matmul-impl", default="auto",
+                   choices=["auto", "xla", "pallas"],
+                   help="matmul implementation the executables are built "
+                        "from (default %(default)s)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="load schedule + operand seed (default %(default)s)")
+    p.add_argument("--device", default=None,
+                   help="jax platform to serve on (default: jax default)")
+    p.add_argument("--num-devices", type=int, default=None,
+                   help="device count (default: all visible)")
+    p.add_argument("--json-out", default=None,
+                   help="schema-v2 JSONL ledger path ('-' for stdout)")
+    p.add_argument("--append", action="store_true",
+                   help="append to an existing ledger instead of "
+                        "truncating (the manifest is written only once)")
+    p.add_argument("--trace-out", default=None,
+                   help="Chrome-trace span timeline ('-' for stdout)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_matmul_bench serve",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    bench = sub.add_parser("bench", help="one load window → one ledger")
+    bench.add_argument("--qps", type=float, default=50.0,
+                       help="open-loop offered load, Poisson arrivals "
+                            "(default %(default)s)")
+    bench.add_argument("--duration", type=float, default=2.0,
+                       dest="duration_s",
+                       help="load window length in seconds "
+                            "(default %(default)s)")
+    bench.add_argument("--concurrency", type=int, default=None,
+                       help="closed loop with N clients instead of the "
+                            "open-loop Poisson process (--qps is then "
+                            "ignored: arrivals are completion-driven)")
+    bench.add_argument("--prewarm", action="store_true",
+                       help="compile every mix bucket before the load "
+                            "window, so latencies are steady-state (the "
+                            "gated configuration)")
+    _add_common(bench)
+
+    selftest = sub.add_parser(
+        "selftest", help="no-load ledger-contract check (CI hook)")
+    _add_common(selftest)
+    return p
+
+
+def _parse_grid(spec: str | None) -> tuple[int, ...] | None:
+    if spec is None:
+        return None
+    try:
+        points = tuple(int(s) for s in spec.split(",") if s.strip())
+    except ValueError:
+        raise SystemExit(f"serve: bad --grid {spec!r} (want comma-separated "
+                         f"integers)")
+    if not points:
+        raise SystemExit(f"serve: empty --grid {spec!r}")
+    return points
+
+
+def _config_from(args: argparse.Namespace) -> ServeConfig:
+    kwargs = dict(
+        mix=args.mix,
+        dtype_name=args.dtype_name,
+        grid=_parse_grid(args.grid),
+        window_ms=args.window_ms,
+        max_depth=args.max_depth,
+        max_batch=args.max_batch,
+        seed=args.seed,
+        matmul_impl=args.matmul_impl,
+        device=args.device,
+        num_devices=args.num_devices,
+        json_out=args.json_out,
+        append_ledger=args.append,
+        trace_out=args.trace_out,
+    )
+    if args.cache_capacity is not None:
+        kwargs["cache_capacity"] = args.cache_capacity
+    if args.command == "bench":
+        kwargs.update(qps=args.qps, duration_s=args.duration_s,
+                      concurrency=args.concurrency, prewarm=args.prewarm)
+    return ServeConfig(**kwargs)
+
+
+def main(argv: Sequence[str] | None = None):
+    args = build_parser().parse_args(argv)
+    try:
+        config = _config_from(args)
+        config.mix_entries  # validate the mix spec before touching devices
+    except ValueError as e:
+        raise SystemExit(f"serve: {e}")
+    if args.command == "selftest":
+        return run_selftest(config)
+    return run_bench(config)
+
+
+if __name__ == "__main__":
+    main()

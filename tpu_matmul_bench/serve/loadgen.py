@@ -1,0 +1,128 @@
+"""Load generators: declarative request mixes, deterministic under a seed.
+
+Two canonical load shapes (the serving-benchmark pair T3's request-driven
+framing implies):
+
+- **open loop** — arrivals are a Poisson process at a target QPS,
+  independent of service completions. This is how real traffic behaves:
+  users do not wait for each other, so a slow server accumulates queue
+  depth and its tail latency explodes. The honest regime for SLO
+  measurement.
+- **closed loop** — a fixed number of concurrent clients, each issuing
+  its next request only after the previous completes. Measures best-case
+  pipeline latency and saturation throughput, but *hides* queueing
+  collapse (the arrival rate politely slows with the server), which is
+  why open loop is the default.
+
+The mix spec is declarative: weighted (M, K, N) shapes plus a dtype,
+written on the CLI as ``MxKxN:weight,...`` (bare ``N`` means the square
+NxNxN; ``:weight`` defaults to 1). Everything is driven by one
+`random.Random(seed)`, so two runs with the same spec and seed produce
+byte-identical schedules — the property the regression gate and the
+resume story lean on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Iterator, Sequence
+
+from tpu_matmul_bench.serve.queue import Request
+
+
+@dataclasses.dataclass(frozen=True)
+class MixEntry:
+    """One weighted shape class in a request mix."""
+
+    m: int
+    k: int
+    n: int
+    weight: float = 1.0
+
+    @property
+    def label(self) -> str:
+        return f"{self.m}x{self.k}x{self.n}"
+
+
+DEFAULT_MIX = "256,512:0.5"
+
+
+def parse_mix(spec: str) -> tuple[MixEntry, ...]:
+    """``MxKxN:weight,...`` → mix entries. Bare ``N`` is the square
+    NxNxN; a missing ``:weight`` is 1. Raises ValueError on nonsense."""
+    entries: list[MixEntry] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        shape_s, _, weight_s = part.partition(":")
+        weight = 1.0
+        if weight_s:
+            weight = float(weight_s)
+            if weight <= 0:
+                raise ValueError(f"mix weight must be > 0 in {part!r}")
+        dims = [int(d) for d in shape_s.lower().split("x")]
+        if len(dims) == 1:
+            dims = dims * 3
+        if len(dims) != 3 or any(d < 1 for d in dims):
+            raise ValueError(
+                f"bad mix shape {shape_s!r} (want N or MxKxN, dims >= 1)")
+        entries.append(MixEntry(*dims, weight=weight))
+    if not entries:
+        raise ValueError(f"empty request mix {spec!r}")
+    return tuple(entries)
+
+
+def _shape_stream(mix: Sequence[MixEntry],
+                  rng: random.Random) -> Iterator[MixEntry]:
+    weights = [e.weight for e in mix]
+    while True:
+        yield rng.choices(mix, weights=weights, k=1)[0]
+
+
+def open_loop_schedule(
+    mix: Sequence[MixEntry],
+    *,
+    qps: float,
+    duration_s: float,
+    dtype: str,
+    seed: int = 0,
+) -> list[Request]:
+    """Poisson arrivals at `qps` for `duration_s`: exponential
+    inter-arrival gaps, shapes drawn by weight — all from one seeded
+    RNG, so the schedule is a pure function of (mix, qps, duration,
+    seed)."""
+    if qps <= 0 or duration_s <= 0:
+        raise ValueError(f"need qps > 0 and duration > 0, got "
+                         f"qps={qps} duration={duration_s}")
+    rng = random.Random(seed)
+    shapes = _shape_stream(mix, rng)
+    schedule: list[Request] = []
+    t = rng.expovariate(qps)
+    rid = 0
+    while t < duration_s:
+        e = next(shapes)
+        schedule.append(Request(rid=rid, m=e.m, k=e.k, n=e.n,
+                                dtype=dtype, arrival_s=t))
+        rid += 1
+        t += rng.expovariate(qps)
+    return schedule
+
+
+def closed_loop_shapes(
+    mix: Sequence[MixEntry],
+    *,
+    dtype: str,
+    seed: int = 0,
+) -> Iterator[Request]:
+    """Endless deterministic request stream for closed-loop clients —
+    arrival times are completion-driven, so only the shape sequence is
+    part of the schedule identity."""
+    rng = random.Random(seed)
+    shapes = _shape_stream(mix, rng)
+    rid = 0
+    while True:
+        e = next(shapes)
+        yield Request(rid=rid, m=e.m, k=e.k, n=e.n, dtype=dtype)
+        rid += 1

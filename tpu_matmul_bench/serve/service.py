@@ -1,0 +1,566 @@
+"""The serving worker loop: cache + queue + loadgen → schema-v2 ledger.
+
+One process, two threads: a **producer** replaying the load schedule
+(sleeping to each request's planned arrival, or acting as N closed-loop
+clients) into the admission queue, and the **worker** (the main thread —
+the only thread that touches JAX) draining micro-batches, resolving each
+batch's bucket to an AOT-compiled executable, and running every request
+with the repo's sync discipline (`utils.timing.sync` after each dispatch
+— a request is complete when its result is provably materialized, not
+when it was enqueued on the device stream).
+
+Request latency is wall clock from successful admission to post-sync
+completion, so it includes queue wait, a cold compile when the request
+is first of its bucket, and service time — exactly what a client would
+observe. The shed count, cache counters, and the full latency
+distribution (per-request samples reduced by `utils.timing.sample_stats`)
+land in the record's extras, making serve ledgers first-class citizens
+of `digest_jsonl`, `campaign`, and the regression gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+import time
+from typing import Any, Iterator, Sequence
+
+import numpy as np
+
+from tpu_matmul_bench.ops.matmul import matmul_2d, random_operands
+from tpu_matmul_bench.serve.cache import DEFAULT_CAPACITY, ExecKey, ExecutableCache
+from tpu_matmul_bench.serve.loadgen import (
+    DEFAULT_MIX,
+    MixEntry,
+    closed_loop_shapes,
+    open_loop_schedule,
+    parse_mix,
+)
+from tpu_matmul_bench.serve.queue import (
+    DEFAULT_MAX_BATCH,
+    DEFAULT_MAX_DEPTH,
+    AdmissionQueue,
+    Request,
+    ShapeGrid,
+)
+from tpu_matmul_bench.utils import telemetry
+from tpu_matmul_bench.utils.errors import QueueOverflowError
+from tpu_matmul_bench.utils.reporting import (
+    BenchmarkRecord,
+    JsonWriter,
+    header,
+    report,
+)
+from tpu_matmul_bench.utils.timing import sample_stats, sync
+
+# within-run p99 stability estimate (first-half vs second-half p99) is
+# capped before it widens the gate: a short window's halves can differ
+# a lot under Poisson arrivals without saying anything about run-to-run
+# drift, and an uncapped estimate would let a real regression hide
+# inside a self-widened tolerance (campaign/gate.py uses 2x noise)
+P99_NOISE_CAP_PCT = 15.0
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Parsed `serve` CLI configuration (see serve/cli.py for the flags)."""
+
+    mix: str = DEFAULT_MIX
+    dtype_name: str = "float32"
+    qps: float = 50.0
+    duration_s: float = 2.0
+    concurrency: int | None = None  # None → open loop
+    window_ms: float = 2.0
+    max_depth: int = DEFAULT_MAX_DEPTH
+    max_batch: int = DEFAULT_MAX_BATCH
+    grid: tuple[int, ...] | None = None
+    cache_capacity: int = DEFAULT_CAPACITY
+    seed: int = 0
+    matmul_impl: str = "auto"
+    device: str | None = None
+    num_devices: int | None = None
+    json_out: str | None = None
+    append_ledger: bool = False
+    trace_out: str | None = None
+    prewarm: bool = False
+
+    @property
+    def mix_entries(self) -> tuple[MixEntry, ...]:
+        return parse_mix(self.mix)
+
+    @property
+    def load_mode(self) -> str:
+        return "closed" if self.concurrency else "open"
+
+
+@dataclasses.dataclass
+class Sample:
+    """One completed request's measured split."""
+
+    rid: int
+    bucket: str
+    latency_s: float  # admission → post-sync completion (client view)
+    service_s: float  # dispatch → post-sync (executable alone)
+    cold: bool  # this request triggered the bucket's compile
+
+
+class _OperandPool:
+    """Per-bucket operand arrays, generated once and reused — serving
+    measures dispatch/latency behavior, not data movement of fresh
+    payloads, so every request of a bucket shares one (A, B) pair."""
+
+    def __init__(self, seed: int) -> None:
+        self._seed = seed
+        self._pool: dict[tuple[int, int, int, str], tuple[Any, ...]] = {}
+
+    def get(self, key: ExecKey) -> tuple[Any, ...]:
+        pk = (key.m, key.k, key.n, key.dtype)
+        ops = self._pool.get(pk)
+        if ops is None:
+            (a,) = random_operands(self._seed, (key.m, key.k), key.dtype,
+                                   count=1)
+            (b,) = random_operands(self._seed + 1, (key.k, key.n), key.dtype,
+                                   count=1)
+            ops = (a, b)
+            self._pool[pk] = ops
+        return ops
+
+
+def _make_cache(config: ServeConfig, device_kind: str,
+                pool: _OperandPool) -> ExecutableCache:
+    def build(key: ExecKey):
+        return matmul_2d(key.impl, None, device_kind)
+
+    return ExecutableCache(build, capacity=config.cache_capacity,
+                           operands=pool.get)
+
+
+def _worker_drain(
+    q: AdmissionQueue,
+    cache: ExecutableCache,
+    pool: _OperandPool,
+    samples: list[Sample],
+    *,
+    impl: str,
+    mesh_shape: tuple[int, ...],
+    on_complete=None,
+) -> None:
+    """Drain the queue to exhaustion (producer closes it). Runs on the
+    main thread — the only JAX-touching thread in the harness."""
+    while (batch := q.take_batch()) is not None:
+        m, k, n = batch[0].bucket
+        key = ExecKey(m=m, k=k, n=n, dtype=batch[0].dtype, impl=impl,
+                      mesh_shape=mesh_shape)
+        was_cached = key in cache
+        a, b = pool.get(key)
+        for req in batch:
+            t0 = time.perf_counter()
+            # per-request get: the batch's first miss pays the cold
+            # compile inside its own latency; the rest are counted hits
+            # (hit rate is then a per-request service property, not an
+            # artifact of how requests happened to batch)
+            entry = cache.get(key)
+            out = entry.compiled(a, b)
+            sync(out)
+            done = time.perf_counter()
+            samples.append(Sample(
+                rid=req.rid, bucket=key.label,
+                latency_s=done - req.submitted_at,
+                service_s=done - t0,
+                cold=not was_cached))
+            was_cached = True  # only the batch's first request was cold
+            if on_complete is not None:
+                on_complete(req)
+
+
+def _open_loop_producer(q: AdmissionQueue, schedule: Sequence[Request],
+                        t0: float) -> None:
+    for req in schedule:
+        delay = t0 + req.arrival_s - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            q.submit(req)
+        except QueueOverflowError:
+            pass  # counted by the queue; open-loop arrivals never block
+    q.close()
+
+
+def _closed_loop_producer(q: AdmissionQueue, requests: Iterator[Request],
+                          t_end: float, sem: threading.Semaphore) -> None:
+    for req in requests:
+        remaining = t_end - time.perf_counter()
+        if remaining <= 0 or not sem.acquire(timeout=remaining):
+            break
+        if time.perf_counter() >= t_end:
+            sem.release()
+            break
+        try:
+            q.submit(req)
+        except QueueOverflowError:
+            sem.release()
+    q.close()
+
+
+def _percentiles_ms(values_s: Sequence[float]) -> dict[str, float]:
+    if not values_s:  # a fully-shed window still produces a ledger
+        return {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0}
+    arr = np.asarray(list(values_s), dtype=float) * 1e3
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "p95_ms": round(float(np.percentile(arr, 95)), 3),
+        "p99_ms": round(float(np.percentile(arr, 99)), 3),
+        "max_ms": round(float(arr.max()), 3),
+    }
+
+
+def _p99_noise_pct(latencies_s: Sequence[float]) -> float:
+    """First-half vs second-half p99 disagreement (capped): the within-run
+    proxy for run-to-run p99 stability the gate widens its tolerance by."""
+    n = len(latencies_s)
+    if n < 8:
+        return P99_NOISE_CAP_PCT  # too short to estimate: assume noisy
+    arr = np.asarray(list(latencies_s), dtype=float)
+    a = float(np.percentile(arr[: n // 2], 99))
+    b = float(np.percentile(arr[n // 2:], 99))
+    mid = (a + b) / 2 or 1e-12
+    return round(min(100.0 * abs(a - b) / mid / 2, P99_NOISE_CAP_PCT), 2)
+
+
+def serve_stats(
+    samples: Sequence[Sample],
+    q: AdmissionQueue,
+    cache: ExecutableCache,
+    *,
+    load_mode: str,
+    offered_qps: float | None,
+    wall_s: float,
+    requested_flops: float,
+    executed_flops: float,
+) -> dict[str, Any]:
+    """The ledger's `extras["serve"]` block — every serving headline in
+    one self-describing dict (digest_jsonl renders it as the latency
+    table; campaign/store.py reads p99_ms + p99_noise_pct for the gate)."""
+    lat = [s.latency_s for s in samples]
+    submitted = q.submitted + q.shed  # offered = admitted + shed
+    stats: dict[str, Any] = {
+        "load_mode": load_mode,
+        "requests": len(samples),
+        "shed": q.shed,
+        "shed_rate_pct": round(100.0 * q.shed / submitted, 2)
+        if submitted else 0.0,
+        "achieved_qps": round(len(samples) / wall_s, 2) if wall_s > 0 else 0.0,
+        "wall_s": round(wall_s, 4),
+        **_percentiles_ms(lat),
+        "service_p50_ms": _percentiles_ms(
+            [s.service_s for s in samples])["p50_ms"],
+        "p99_noise_pct": _p99_noise_pct(lat),
+        "cold_requests": sum(s.cold for s in samples),
+        "padding_overhead_pct": round(
+            100.0 * (executed_flops - requested_flops) / requested_flops, 2)
+        if requested_flops else 0.0,
+        "queue": q.stats(),
+        "cache": cache.stats(),
+        "buckets": _bucket_breakdown(samples),
+    }
+    if offered_qps is not None:
+        stats["offered_qps"] = round(offered_qps, 2)
+    return stats
+
+
+def _bucket_breakdown(samples: Sequence[Sample]) -> dict[str, Any]:
+    by: dict[str, list[float]] = {}
+    for s in samples:
+        by.setdefault(s.bucket, []).append(s.latency_s)
+    return {
+        label: {"count": len(lat), **_percentiles_ms(lat)}
+        for label, lat in sorted(by.items())
+    }
+
+
+def _serve_record(config: ServeConfig, stats: dict[str, Any],
+                  samples: Sequence[Sample], device_kind: str, world: int,
+                  *, mode: str, executed_flops: float,
+                  wall_s: float, prewarmed: int) -> BenchmarkRecord:
+    lat = [s.latency_s for s in samples]
+    tflops_total = executed_flops / wall_s / 1e12 if wall_s > 0 else 0.0
+    max_bucket = max((max(s.bucket.split("/")[0].split("x"), key=int)
+                      for s in samples), key=int, default="0")
+    rec = BenchmarkRecord(
+        benchmark="serve",
+        mode=mode,
+        size=int(max_bucket),
+        dtype=config.dtype_name,
+        world=world,
+        iterations=len(samples),
+        warmup=prewarmed,
+        avg_time_s=float(np.mean(lat)) if lat else 0.0,
+        tflops_per_device=tflops_total / world if world else 0.0,
+        tflops_total=tflops_total,
+        device_kind=device_kind,
+        # mean executed FLOPs per request: serve records are mixed-shape,
+        # so the square-sweep derived metrics (roofline) must not engage
+        flops_per_op=executed_flops / len(samples) if samples else 0.0,
+        extras={
+            "shape": config.mix if len(config.mix) <= 18
+            else f"mix:{len(config.mix_entries)} shapes",
+            "serve": stats,
+            "samples": sample_stats(lat) if lat else None,
+        },
+    )
+    if rec.extras["samples"] is None:
+        del rec.extras["samples"]
+    return rec
+
+
+def _report_summary(stats: dict[str, Any]) -> None:
+    cache = stats["cache"]
+    lines = [
+        "\nServing results:",
+        f"  - Requests completed: {stats['requests']} "
+        f"({stats['achieved_qps']} QPS achieved"
+        + (f", {stats['offered_qps']} offered" if "offered_qps" in stats
+           else "") + ")",
+        f"  - Latency p50/p95/p99/max: {stats['p50_ms']} / "
+        f"{stats['p95_ms']} / {stats['p99_ms']} / {stats['max_ms']} ms",
+        f"  - Shed: {stats['shed']} ({stats['shed_rate_pct']}%)",
+        f"  - Cache: {cache['hits']} hits / {cache['misses']} misses "
+        f"({cache['hit_rate_pct']}% hit rate, "
+        f"{cache['evictions']} evictions)",
+        f"  - Padding overhead: {stats['padding_overhead_pct']}% extra FLOPs",
+    ]
+    for label, e in cache["by_entry"].items():
+        lines.append(
+            f"      {label}: cold compile {e['cold_compile_ms']} ms, "
+            f"warm dispatch {e['warm_dispatch_ms']} ms, {e['hits']} hits")
+    report(*lines)
+
+
+def _setup(config: ServeConfig):
+    """Device + plumbing shared by bench and selftest."""
+    from tpu_matmul_bench.utils.device import (
+        collect_device_info,
+        device_banner,
+        resolve_devices,
+    )
+
+    devices = resolve_devices(config.device, config.num_devices)
+    info = collect_device_info(devices)
+    report(device_banner(info))
+    pool = _OperandPool(config.seed)
+    cache = _make_cache(config, info.device_kind, pool)
+    grid = ShapeGrid(config.grid) if config.grid else ShapeGrid()
+    q = AdmissionQueue(grid, max_depth=config.max_depth,
+                       window_s=config.window_ms / 1e3,
+                       max_batch=config.max_batch)
+    return devices, info, pool, cache, q
+
+
+def _prewarm(config: ServeConfig, grid: ShapeGrid, cache: ExecutableCache,
+             world: int) -> int:
+    """Compile every mix bucket before load so the measured window is
+    steady-state (the campaign gate's serve spec uses this — a p99 that
+    sometimes contains a cold compile gates nothing)."""
+    keys = {ExecKey(*grid.bucket(e.m, e.k, e.n), dtype=config.dtype_name,
+                    impl=config.matmul_impl, mesh_shape=(world,))
+            for e in config.mix_entries}
+    with telemetry.span("prewarm", buckets=len(keys)):
+        for key in sorted(keys, key=lambda kk: kk.label):
+            cache.get(key)
+    return len(keys)
+
+
+def _flops(samples: Sequence[Sample],
+           schedule_shapes: dict[int, tuple[int, int, int]]) -> tuple[float, float]:
+    """(requested, executed) FLOPs over the completed samples: requested
+    at the asked shape, executed at the padded bucket shape."""
+    requested = executed = 0.0
+    for s in samples:
+        bm, bk, bn = (int(d) for d in s.bucket.split("/")[0].split("x"))
+        executed += 2.0 * bm * bk * bn
+        rm, rk, rn = schedule_shapes.get(s.rid, (bm, bk, bn))
+        requested += 2.0 * rm * rk * rn
+    return requested, executed
+
+
+def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
+    """The `serve bench` program: one load run → one ledger."""
+    devices, info, pool, cache, q = _setup(config)
+    world = len(devices)
+    report(header(
+        "Matmul Serving Benchmark (latency under load)",
+        {
+            "Load mode": config.load_mode
+            + (f" (concurrency {config.concurrency})"
+               if config.concurrency else f" ({config.qps} QPS Poisson)"),
+            "Duration": f"{config.duration_s} s",
+            "Request mix": config.mix,
+            "Data type": config.dtype_name,
+            "Micro-batch window": f"{config.window_ms} ms",
+            "Queue depth": config.max_depth,
+            "Matmul implementation": config.matmul_impl,
+        },
+    ))
+
+    samples: list[Sample] = []
+    schedule_shapes: dict[int, tuple[int, int, int]] = {}
+    with telemetry.session(config.trace_out):
+        prewarmed = _prewarm(config, q.grid, cache, world) \
+            if config.prewarm else 0
+        with telemetry.span("load", mode=config.load_mode):
+            t0 = time.perf_counter()
+            if config.concurrency:
+                requests = closed_loop_shapes(
+                    config.mix_entries, dtype=config.dtype_name,
+                    seed=config.seed)
+                seen = _recording(requests, schedule_shapes)
+                sem = threading.Semaphore(config.concurrency)
+                producer = threading.Thread(
+                    target=_closed_loop_producer,
+                    args=(q, seen, t0 + config.duration_s, sem),
+                    daemon=True)
+                producer.start()
+                _worker_drain(q, cache, pool, samples,
+                              impl=config.matmul_impl, mesh_shape=(world,),
+                              on_complete=lambda _r: sem.release())
+            else:
+                schedule = open_loop_schedule(
+                    config.mix_entries, qps=config.qps,
+                    duration_s=config.duration_s,
+                    dtype=config.dtype_name, seed=config.seed)
+                schedule_shapes.update(
+                    {r.rid: (r.m, r.k, r.n) for r in schedule})
+                producer = threading.Thread(
+                    target=_open_loop_producer, args=(q, schedule, t0),
+                    daemon=True)
+                producer.start()
+                _worker_drain(q, cache, pool, samples,
+                              impl=config.matmul_impl, mesh_shape=(world,))
+            producer.join()
+            wall_s = time.perf_counter() - t0
+
+        requested_f, executed_f = _flops(samples, schedule_shapes)
+        stats = serve_stats(
+            samples, q, cache, load_mode=config.load_mode,
+            offered_qps=None if config.concurrency else config.qps,
+            wall_s=wall_s, requested_flops=requested_f,
+            executed_flops=executed_f)
+        rec = _serve_record(config, stats, samples, info.device_kind, world,
+                            mode=config.load_mode,
+                            executed_flops=executed_f, wall_s=wall_s,
+                            prewarmed=prewarmed)
+        _report_summary(stats)
+        with JsonWriter(config.json_out,
+                        manifest=telemetry.build_manifest(
+                            extra={"serve_config": _config_manifest(config)}),
+                        append=config.append_ledger) as writer:
+            writer.write(rec)
+    return [rec]
+
+
+def _recording(requests: Iterator[Request],
+               shapes: dict[int, tuple[int, int, int]]) -> Iterator[Request]:
+    for req in requests:
+        shapes[req.rid] = (req.m, req.k, req.n)
+        yield req
+
+
+def _config_manifest(config: ServeConfig,
+                     load_mode: str | None = None) -> dict[str, Any]:
+    return {
+        "mix": config.mix,
+        "dtype": config.dtype_name,
+        "load_mode": load_mode or config.load_mode,
+        "qps": config.qps,
+        "duration_s": config.duration_s,
+        "concurrency": config.concurrency,
+        "window_ms": config.window_ms,
+        "max_depth": config.max_depth,
+        "max_batch": config.max_batch,
+        "seed": config.seed,
+        "matmul_impl": config.matmul_impl,
+        "prewarm": config.prewarm,
+    }
+
+
+SELFTEST_REQUESTS = 10
+
+
+def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
+    """No-load sanity pass: compile one entry, serve SELFTEST_REQUESTS
+    requests synchronously, validate the ledger contract. Exits nonzero
+    on any violated invariant — the CI hook that keeps the serving path
+    honest without a load run."""
+    devices, info, pool, cache, q = _setup(config)
+    world = len(devices)
+    report(header("Serve selftest (no load)", {
+        "Requests": SELFTEST_REQUESTS,
+        "Request mix": config.mix,
+        "Data type": config.dtype_name,
+    }))
+    e = config.mix_entries[0]
+    samples: list[Sample] = []
+    with telemetry.session(config.trace_out):
+        t0 = time.perf_counter()
+        for rid in range(SELFTEST_REQUESTS):
+            q.submit(Request(rid=rid, m=e.m, k=e.k, n=e.n,
+                             dtype=config.dtype_name))
+        q.close()
+        _worker_drain(q, cache, pool, samples, impl=config.matmul_impl,
+                      mesh_shape=(world,))
+        wall_s = time.perf_counter() - t0
+        requested_f, executed_f = _flops(samples, {})
+        stats = serve_stats(samples, q, cache, load_mode="selftest",
+                            offered_qps=None, wall_s=wall_s,
+                            requested_flops=requested_f,
+                            executed_flops=executed_f)
+        rec = _serve_record(config, stats, samples, info.device_kind, world,
+                            mode="selftest", executed_flops=executed_f,
+                            wall_s=wall_s, prewarmed=0)
+        _report_summary(stats)
+        with JsonWriter(config.json_out,
+                        manifest=telemetry.build_manifest(
+                            extra={"serve_config": _config_manifest(
+                                config, "selftest")}),
+                        append=config.append_ledger) as writer:
+            writer.write(rec)
+    problems = validate_serve_record(rec)
+    if problems:
+        report(*[f"selftest FAILED: {p}" for p in problems],
+               file=sys.stderr)
+        raise SystemExit(1)
+    report("selftest ok: 1 executable compiled, "
+           f"{len(samples)} requests served, ledger contract holds")
+    return [rec]
+
+
+def validate_serve_record(rec: BenchmarkRecord) -> list[str]:
+    """The serve-ledger schema contract, as checkable invariants. Empty
+    list = valid. Shared by `serve selftest` and the tests."""
+    problems: list[str] = []
+    s = rec.extras.get("serve")
+    if not isinstance(s, dict):
+        return ["extras['serve'] block missing"]
+    for key in ("p50_ms", "p95_ms", "p99_ms", "max_ms", "shed_rate_pct",
+                "achieved_qps", "requests", "cache", "queue"):
+        if key not in s:
+            problems.append(f"extras['serve'] lacks {key!r}")
+    if problems:
+        return problems
+    if not (s["p50_ms"] <= s["p95_ms"] <= s["p99_ms"] <= s["max_ms"]):
+        problems.append(
+            f"latency percentiles not monotone: {s['p50_ms']} / "
+            f"{s['p95_ms']} / {s['p99_ms']} / {s['max_ms']}")
+    cache = s["cache"]
+    # every served request took exactly one cache access; prewarm adds
+    # misses on top, so accesses >= requests always holds
+    if cache["hits"] + cache["misses"] < s["requests"]:
+        problems.append(
+            f"cache accesses ({cache['hits']} + {cache['misses']}) don't "
+            f"cover the {s['requests']} served requests")
+    if rec.benchmark != "serve":
+        problems.append(f"benchmark field is {rec.benchmark!r}, not 'serve'")
+    if rec.iterations != s["requests"]:
+        problems.append("iterations != completed requests")
+    return problems

@@ -83,6 +83,36 @@ def distributed_active() -> bool:
         return False  # backend not initialized: trivially single-process
 
 
+# Marker prefix for admission-queue sheds. Like the transport signatures
+# above it survives formatting/stringification, so a shed is classifiable
+# from a logged message as well as from the live exception.
+_OVERLOAD_MARKER = "ADMISSION_QUEUE_FULL"
+
+
+class QueueOverflowError(RuntimeError):
+    """The serving admission queue is at max depth: the request was SHED,
+    not queued. Sheds are load feedback, not faults — a correct service
+    under overload answers "no" fast rather than queueing into timeout
+    (the serve harness counts them into the ledger's shed rate). Defined
+    here (not in serve/) so classification needs no serve import."""
+
+    def __init__(self, depth: int, max_depth: int):
+        super().__init__(
+            f"{_OVERLOAD_MARKER}: depth {depth} at configured max "
+            f"{max_depth}; request shed")
+        self.depth = depth
+        self.max_depth = max_depth
+
+
+def is_overload_error(e: BaseException | str) -> bool:
+    """Overload-shed classification, by type for live exceptions and by
+    marker for captured text (log tails, formatted messages) — the same
+    dual convention as the transport classifiers above."""
+    if isinstance(e, QueueOverflowError):
+        return True
+    return _OVERLOAD_MARKER in str(e)
+
+
 def release_device_memory(*arrays: object) -> None:
     """Drop operand references and collect, ≙ `torch.cuda.empty_cache()`
     between sizes (reference `matmul_scaling_benchmark.py:344`)."""

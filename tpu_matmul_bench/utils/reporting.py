@@ -226,6 +226,23 @@ def format_record(rec: BenchmarkRecord) -> str:
     return "\n".join(lines)
 
 
+def _has_manifest(path: str) -> bool:
+    """True when `path` exists and its first line is a manifest record —
+    the append-mode dedup test (one header per ledger, ever)."""
+    try:
+        with open(path) as fh:
+            first = fh.readline()
+    except OSError:
+        return False
+    if not first.strip():
+        return False
+    try:
+        rec = json.loads(first)
+    except json.JSONDecodeError:
+        return False
+    return isinstance(rec, dict) and rec.get("record_type") == "manifest"
+
+
 class JsonWriter:
     """JSON-lines sink for BenchmarkRecords (the structured channel the
     comparison driver reads instead of scraping stdout).
@@ -239,13 +256,27 @@ class JsonWriter:
     real file descriptor) so a killed or OOM-aborted run leaves a
     readable partial JSONL instead of a truncated buffer — partial
     artifacts from crashed runs are evidence, not garbage.
+
+    `append=True` extends an existing ledger instead of truncating it
+    (long-lived services emit one record per load window into one file).
+    A manifest is only written when the target does not already start
+    with one — appending must not interleave a second header mid-file,
+    but a fresh/empty target still gets its self-description. The check
+    reads the literal `record_type == "manifest"` marker rather than
+    importing utils.telemetry (telemetry imports this module).
     """
 
-    def __init__(self, path: str | None, manifest: dict[str, Any] | None = None):
+    def __init__(self, path: str | None, manifest: dict[str, Any] | None = None,
+                 *, append: bool = False):
         self._path = path
         self._fh: IO[str] | None = None
         if path and is_reporting_process():
-            self._fh = sys.stdout if path == "-" else open(path, "w")
+            if path == "-":
+                self._fh = sys.stdout
+            else:
+                if append and manifest is not None and _has_manifest(path):
+                    manifest = None
+                self._fh = open(path, "a" if append else "w")
         if self._fh is not None and manifest is not None:
             self._fh.write(json.dumps(manifest, sort_keys=True) + "\n")
             self._sync()

@@ -227,13 +227,17 @@ def git_sha() -> str | None:
 
 
 def build_manifest(config: Any = None, *,
-                   argv: list[str] | None = None) -> dict[str, Any]:
+                   argv: list[str] | None = None,
+                   extra: dict[str, Any] | None = None) -> dict[str, Any]:
     """The provenance header record for a JSONL file.
 
     `config` is a BenchConfig (duck-typed to avoid an import cycle with
     utils.config); None still yields a valid environment-only manifest.
-    Callers must have initialized the backend already (every benchmark
-    resolves devices before opening its JSON sink).
+    `extra` merges program-specific top-level keys (e.g. the serve
+    harness's load configuration) without competing with the reserved
+    environment keys — reserved names win. Callers must have initialized
+    the backend already (every benchmark resolves devices before opening
+    its JSON sink).
     """
     import jax
 
@@ -265,6 +269,9 @@ def build_manifest(config: Any = None, *,
             "warmup": config.warmup,
             "seed": config.seed,
         }
+    if extra:
+        for key, value in extra.items():
+            manifest.setdefault(key, value)
     if _ARTIFACTS:
         manifest["artifacts"] = dict(_ARTIFACTS)
     return manifest

@@ -102,6 +102,30 @@ def _row(r) -> str:
             f"{extra_bits}")
 
 
+def _digest_lint(recs: list[dict]) -> None:
+    """Lint findings ledger: rule-ID x severity table + per-rule example,
+    ranked most-severe first (the digest counterpart of `python -m
+    tpu_matmul_bench lint --json-out`)."""
+    findings = [r for r in recs if r.get("record_type") == "lint_finding"]
+    sev_rank = {"error": 0, "warn": 1, "info": 2}
+    by_rule: dict[str, list[dict]] = {}
+    for f in findings:
+        by_rule.setdefault(str(f.get("rule")), []).append(f)
+    totals = {"error": 0, "warn": 0, "info": 0}
+    for f in findings:
+        totals[str(f.get("severity"))] = totals.get(str(f.get("severity")), 0) + 1
+    print(f"  {'rule':<12} {'severity':<9} {'count':>5}  example")
+    for rule, fs in sorted(
+            by_rule.items(),
+            key=lambda kv: (sev_rank.get(str(kv[1][0].get("severity")), 9),
+                            kv[0])):
+        ex = fs[0]
+        print(f"  {rule:<12} {str(ex.get('severity')):<9} {len(fs):>5}  "
+              f"{ex.get('where')}: {ex.get('message')}")
+    print(f"  total: {totals.get('error', 0)} error(s), "
+          f"{totals.get('warn', 0)} warning(s), {totals.get('info', 0)} info")
+
+
 def _is_campaign_dir(p: Path) -> bool:
     return (p / _JOURNAL).exists() or (p / _JOBS_SUBDIR).is_dir()
 
@@ -211,6 +235,10 @@ def main(paths: list[str]) -> None:
                   f"{m.get('device_count')}x{m.get('device_kind')} "
                   f"git={sha} dtype={cfg.get('dtype')} "
                   f"argv={' '.join(m.get('argv') or [])}")
+        if any(r.get("record_type") in ("lint_finding", "lint_summary")
+               for r in recs):
+            _digest_lint(recs)
+            continue
         recs.sort(key=_rank_key)
         for r in recs:
             print(_row(r))

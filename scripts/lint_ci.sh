@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# CI lint gate: ruff (when available) + the static contract auditor.
+#
+# Two layers, cheapest first:
+#   1. ruff — pyflakes (F) + import hygiene (I), configured in
+#      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
+#      installed (the benchmark containers don't ship it; dev machines and
+#      CI runners do).
+#   2. python -m tpu_matmul_bench lint — traces every impl x mode on a
+#      CPU mesh and audits dtype discipline, collective inventory vs the
+#      comms model, timed-region purity, donation contracts, Pallas grids,
+#      and the shipped campaign specs. Fails on error-severity findings.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check =="
+    ruff check .
+else
+    echo "== ruff not installed; skipping style/import lint =="
+fi
+
+echo "== bench lint (static contract audit) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench lint --fail-on error "$@"

@@ -114,3 +114,43 @@ def test_cli_serve_selftest_validates_its_own_ledger():
     s = records[0]["extras"]["serve"]
     assert s["requests"] > 0 and s["p50_ms"] <= s["p99_ms"]
     assert s["cache"]["misses"] == 1  # one mix entry → one executable
+
+
+def test_cli_lint_full_audit_exits_zero(tmp_path):
+    """Acceptance bar: `python -m tpu_matmul_bench lint --fail-on error`
+    must exit 0 on the shipped tree, and its --json-out ledger must be a
+    manifest-headed schema-v2 JSONL with a lint_summary trailer."""
+    ledger = tmp_path / "lint.jsonl"
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu_matmul_bench", "lint",
+         "--fail-on", "error", "--json-out", str(ledger)],
+        env=scrubbed_env(platforms="cpu", device_count=8),
+        capture_output=True, text=True, timeout=600, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "lint: 0 error(s)" in out.stdout
+    recs = [json.loads(line) for line in ledger.read_text().splitlines()]
+    assert recs[0]["record_type"] == "manifest"
+    assert recs[0]["schema_version"] >= 2
+    assert recs[-1]["record_type"] == "lint_summary"
+    assert recs[-1]["error"] == 0
+    findings = [r for r in recs if r.get("record_type") == "lint_finding"]
+    assert all(r["rule"] and r["severity"] in ("info", "warn", "error")
+               for r in findings)
+
+
+def test_cli_spec_lint_over_shipped_specs():
+    """The spec-only path (everything else skipped) validates every
+    shipped specs/*.toml and stays fast — this is what `campaign run
+    --lint` leans on before burning device time."""
+    specs = sorted(str(p) for p in (REPO / "specs").glob("*.toml"))
+    assert specs, "shipped specs/*.toml missing"
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu_matmul_bench", "lint",
+         "--fail-on", "warn", "--skip", "modes", "impls", "donation",
+         "pallas", "registry", "--specs", *specs],
+        env=scrubbed_env(platforms="cpu", device_count=8),
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "lint: 0 error(s), 0 warning(s)" in out.stdout

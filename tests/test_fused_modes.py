@@ -5,7 +5,6 @@ program; Pallas RDMA kernels opt out (ModeSetup.fusable=False) and demote
 to the dispatch protocol, tagging what actually ran.
 """
 
-import pytest
 
 from tpu_matmul_bench.parallel.modes import run_mode_benchmark
 from tpu_matmul_bench.parallel.overlap import OVERLAP_MODES, overlap_mode

@@ -39,7 +39,6 @@ from tpu_matmul_bench.parallel.mesh import sharded_normal
 from tpu_matmul_bench.utils.config import parse_config
 from jax.sharding import PartitionSpec as P
 
-import jax.numpy as jnp
 
 SIZE = 64
 

@@ -1,6 +1,5 @@
 """Tests for the hybrid 2-D (dp×tp) mesh mode and its CLI program."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

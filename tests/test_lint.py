@@ -1,0 +1,310 @@
+"""The static contract auditor (`analysis/`): every mode's traced
+collective inventory must match BOTH the analytic comms model and the
+committed golden fixture at two distinct mesh shapes, the shipped tree
+must audit clean, and — the teeth — each seeded contract violation
+(extra downcast, dead donation, wrong collective, misaligned Pallas
+grid, bad spec key, ...) must produce exactly its expected rule ID at
+its expected severity. A linter whose violations aren't pinned down by
+fixtures rots into a linter that flags nothing."""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_matmul_bench.analysis import auditor
+from tpu_matmul_bench.analysis import jaxpr_tools as jt
+from tpu_matmul_bench.analysis import spec_lint
+from tpu_matmul_bench.analysis.comms_model import expected_collectives
+from tpu_matmul_bench.analysis.findings import (
+    RULES,
+    Finding,
+    should_fail,
+    summarize,
+    worst_severity,
+    write_ledger,
+)
+from tpu_matmul_bench.parallel.mesh import make_mesh
+
+GOLDEN = Path(__file__).parent / "golden" / "lint_inventory.json"
+SIZE = auditor.AUDIT_SIZE
+
+
+def _rule_sevs(findings):
+    return sorted((f.rule, f.severity) for f in findings)
+
+
+def _mode_jaxpr(mode, world, devices):
+    cfg = auditor._audit_config()
+    mesh = make_mesh(devices[:world])
+    setup = auditor._all_modes()[mode](cfg, mesh, SIZE)
+    fn = setup.full if setup.full is not None else setup.compute
+    return jax.make_jaxpr(fn)(*setup.operands)
+
+
+# ---------------------------------------------------------------- golden
+
+@pytest.mark.parametrize("world", [4, 8])
+def test_every_mode_matches_comms_model(world, devices):
+    """Acceptance bar: collective inventory == analytic model for every
+    mode in parallel/modes.py at two distinct mesh shapes."""
+    for mode in auditor._all_modes():
+        jx = _mode_jaxpr(mode, world, devices)
+        findings = auditor._inventory_findings(
+            jx, mode, world, SIZE, jnp.bfloat16, f"golden:{mode}@d{world}")
+        assert findings == [], [f.message for f in findings]
+
+
+@pytest.mark.parametrize("world", [4, 8])
+def test_traced_inventory_matches_golden_fixture(world, devices):
+    """The committed fixture pins the ACTUAL traced collectives, not just
+    the model — a refactor that changes both in lockstep (e.g. silently
+    doubling a payload and 'fixing' the model to match) still trips."""
+    golden = json.loads(GOLDEN.read_text())
+    assert set(golden) == set(auditor._all_modes())
+    for mode, per_world in golden.items():
+        jx = _mode_jaxpr(mode, world, devices)
+        observed = sorted(
+            [u.kind, u.payload_bytes] for u in jt.collective_inventory(jx))
+        assert observed == per_world[f"d{world}"], mode
+
+
+def test_golden_fixture_agrees_with_model():
+    golden = json.loads(GOLDEN.read_text())
+    for mode, per_world in golden.items():
+        for dkey, inv in per_world.items():
+            world = int(dkey[1:])
+            expected = sorted(
+                [e.kind, e.payload_bytes]
+                for e in expected_collectives(mode, world, SIZE, jnp.bfloat16,
+                                              batch=auditor.AUDIT_BATCH))
+            assert [list(x) for x in expected] == inv, (mode, dkey)
+
+
+def test_shipped_tree_audits_clean():
+    """No error-severity finding anywhere in the shipped code + specs —
+    the same bar `python -m tpu_matmul_bench lint --fail-on error` holds
+    in CI (scripts/lint_ci.sh)."""
+    repo = Path(__file__).resolve().parent.parent
+    specs = sorted(str(p) for p in (repo / "specs").glob("*.toml"))
+    findings = auditor.run_all(spec_paths=specs)
+    errors = [f for f in findings if f.severity == "error"]
+    assert errors == [], [(f.rule, f.where, f.message) for f in errors]
+
+
+# ----------------------------------------------------- seeded violations
+
+def test_seeded_extra_downcast_flags_dtype001():
+    def two_downcasts(a, b):
+        # accumulate high, downcast, re-widen, downcast AGAIN — the
+        # classic refactor scar DTYPE-001/-002 exist to catch
+        acc = jnp.matmul(a, b, preferred_element_type=jnp.float32)
+        return acc.astype(jnp.bfloat16).astype(jnp.float32).astype(
+            jnp.bfloat16)
+
+    aval = jax.ShapeDtypeStruct((64, 64), jnp.bfloat16)
+    jx = jax.make_jaxpr(two_downcasts)(aval, aval)
+    findings = auditor._dtype_findings(jx, "seed:two-downcasts")
+    rules = _rule_sevs(findings)
+    assert ("DTYPE-001", "error") in rules
+    assert ("DTYPE-002", "error") in rules  # the bf16→f32 round-trip
+
+
+def test_clean_single_downcast_passes():
+    def one_downcast(a, b):
+        acc = jnp.matmul(a, b, preferred_element_type=jnp.float32)
+        return acc.astype(jnp.bfloat16)
+
+    aval = jax.ShapeDtypeStruct((64, 64), jnp.bfloat16)
+    jx = jax.make_jaxpr(one_downcast)(aval, aval)
+    assert auditor._dtype_findings(jx, "seed:clean") == []
+
+
+def test_seeded_dead_donation_flags_donate001(monkeypatch):
+    # int8 operands, int32 output: no shape/dtype-compatible output, so
+    # the declared donation is dead — XLA emits no alias marker
+    def widening(a, b):
+        return jnp.matmul(a.astype(jnp.int32), b.astype(jnp.int32))
+
+    aval = jax.ShapeDtypeStruct((64, 64), jnp.int8)
+    assert jt.donation_alias_count(widening, (aval, aval),
+                                   donate_argnums=(0,)) == 0
+    monkeypatch.setattr(
+        auditor, "donation_contracts",
+        lambda: [("seed:widening-int8", widening, (aval, aval), (0,))])
+    findings = auditor.audit_donation()
+    assert _rule_sevs(findings) == [("DONATE-001", "error")]
+
+
+def test_live_donation_counts_alias():
+    def inplace(a, b):
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(
+            jnp.bfloat16)
+
+    aval = jax.ShapeDtypeStruct((64, 64), jnp.bfloat16)
+    assert jt.donation_alias_count(inplace, (aval, aval),
+                                   donate_argnums=(0,)) >= 1
+
+
+def test_seeded_wrong_collective_flags_coll001(devices):
+    # model_parallel's all_reduce audited against matrix_parallel's
+    # expected all_gather: kind mismatch, COLL-001
+    jx = _mode_jaxpr("model_parallel", 4, devices)
+    findings = auditor._inventory_findings(
+        jx, "matrix_parallel", 4, SIZE, jnp.bfloat16, "seed:wrong-mode")
+    assert _rule_sevs(findings) == [("COLL-001", "error")]
+
+
+def test_seeded_wrong_payload_flags_coll002(devices):
+    # right collective kind, wrong problem size: byte mismatch, COLL-002
+    jx = _mode_jaxpr("model_parallel", 4, devices)
+    findings = auditor._inventory_findings(
+        jx, "model_parallel", 4, 2 * SIZE, jnp.bfloat16, "seed:wrong-size")
+    assert _rule_sevs(findings) == [("COLL-002", "error")]
+
+
+def test_seeded_host_callback_flags_pure001():
+    def chatty(a, b):
+        jax.debug.print("iteration {x}", x=a[0, 0])
+        return jnp.matmul(a, b)
+
+    aval = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    jx = jax.make_jaxpr(chatty)(aval, aval)
+    findings = auditor._purity_findings(jx, "seed:debug-print")
+    assert _rule_sevs(findings) == [("PURE-001", "error")]
+
+
+def test_seeded_misaligned_pallas_grid():
+    # bn=100 breaks the 128-lane alignment → PALLAS-002 (and nothing else:
+    # 100 divides nothing, so pin the dims to multiples to isolate it)
+    findings = auditor.check_pallas_blocks(
+        "seed:misaligned", 512, 500, 512, 8, 100, 128)
+    assert _rule_sevs(findings) == [("PALLAS-002", "error")]
+
+
+def test_seeded_indivisible_pallas_grid():
+    findings = auditor.check_pallas_blocks(
+        "seed:indivisible", 500, 512, 512, 8, 128, 128)
+    assert _rule_sevs(findings) == [("PALLAS-001", "error")]
+
+
+def test_seeded_oversized_pallas_blocks():
+    # f32 4096³ blocks: ~200 MiB of VMEM against the 128 MiB cap
+    findings = auditor.check_pallas_blocks(
+        "seed:oversized", 4096, 4096, 4096, 4096, 4096, 4096,
+        in_dtype=jnp.float32)
+    assert ("PALLAS-003", "error") in _rule_sevs(findings)
+
+
+def test_seeded_unknown_spec_key(tmp_path):
+    spec = tmp_path / "bad_key.toml"
+    spec.write_text(
+        '[campaign]\nname = "seeded"\n\n'
+        '[[job]]\nid = "j1"\nprogram = "matmul"\n'
+        'timout_s = 60\nflags = ["--sizes", "64"]\n')
+    findings = spec_lint.lint_spec_file(spec)
+    assert _rule_sevs(findings) == [("SPEC-002", "error")]
+    assert findings[0].details["key"] == "timout_s"
+
+
+def test_seeded_fingerprint_collision(tmp_path):
+    spec = tmp_path / "collide.toml"
+    spec.write_text(
+        '[campaign]\nname = "seeded"\n\n'
+        '[[job]]\nid = "a"\nprogram = "matmul"\nflags = ["--sizes", "64"]\n\n'
+        '[[job]]\nid = "b"\nprogram = "matmul"\nflags = ["--sizes", "64"]\n')
+    findings = spec_lint.lint_spec_file(spec)
+    assert _rule_sevs(findings) == [("SPEC-004", "error")]
+
+
+def test_seeded_unparseable_spec(tmp_path):
+    spec = tmp_path / "torn.toml"
+    spec.write_text('[campaign\nname = "torn"\n')
+    findings = spec_lint.lint_spec_file(spec)
+    assert _rule_sevs(findings) == [("SPEC-001", "error")]
+
+
+def test_seeded_indivisible_sweep_size(tmp_path):
+    spec = tmp_path / "indiv.toml"
+    spec.write_text(
+        '[campaign]\nname = "seeded"\n\n'
+        '[[sweep]]\nid_prefix = "s"\nprogram = "distributed"\n'
+        'sizes = [100]\nmodes = ["model_parallel"]\nnum_devices = [8]\n')
+    findings = spec_lint.lint_spec_file(spec)
+    assert _rule_sevs(findings) == [("SPEC-003", "warn")]
+
+
+def test_shipped_specs_lint_clean():
+    repo = Path(__file__).resolve().parent.parent
+    paths = sorted(str(p) for p in (repo / "specs").glob("*.toml"))
+    assert paths, "shipped specs/*.toml missing"
+    assert spec_lint.lint_specs(paths) == []
+
+
+def test_seeded_unprovenance_registry_tier(monkeypatch):
+    from tpu_matmul_bench.ops import impl_select
+
+    monkeypatch.setattr(
+        auditor, "_REGISTRY_SIZES", (4096,))
+    monkeypatch.setattr(auditor, "_REGISTRY_RECTS", ())
+    monkeypatch.setattr(auditor, "_REGISTRY_DTYPES", ("bfloat16",))
+    monkeypatch.setattr(
+        impl_select, "select_impl",
+        lambda m, n, k, kind, dt: impl_select.ImplChoice(
+            "pallas", "felt fast on my laptop"))
+    findings = auditor.audit_registry()
+    assert _rule_sevs(findings) == [("REG-001", "warn")]
+
+
+# ---------------------------------------------------------- findings API
+
+def test_finding_severity_defaults_from_rule():
+    f = Finding("DTYPE-001", "x", "m")
+    assert f.severity == "error"
+    assert Finding("REG-002", "x", "m").severity == "info"
+    with pytest.raises(ValueError):
+        Finding("NOPE-999", "x", "m")
+    with pytest.raises(ValueError):
+        Finding("DTYPE-001", "x", "m", severity="fatal")
+
+
+def test_should_fail_thresholds():
+    info = Finding("REG-002", "x", "m")
+    warn = Finding("REG-001", "x", "m")
+    err = Finding("DTYPE-001", "x", "m")
+    assert not should_fail([info], "warn")
+    assert should_fail([warn], "warn")
+    assert not should_fail([warn], "error")
+    assert should_fail([err, warn, info], "error")
+    assert worst_severity([info, warn]) == "warn"
+    assert summarize([err, warn, info]) == {"error": 1, "warn": 1, "info": 1}
+
+
+def test_ledger_roundtrip(tmp_path):
+    out = tmp_path / "lint.jsonl"
+    write_ledger(out, [Finding("REG-001", "w", "m")], argv=["lint"],
+                 extra={"fail_on": "error"})
+    recs = [json.loads(line) for line in out.read_text().splitlines()]
+    from tpu_matmul_bench.utils import telemetry
+
+    assert telemetry.is_manifest(recs[0])
+    kinds = [r.get("record_type") for r in recs]
+    assert kinds[1:] == ["lint_finding", "lint_summary"]
+    assert recs[1]["rule"] == "REG-001" and recs[1]["severity"] == "warn"
+    assert recs[2]["warn"] == 1 and recs[2]["error"] == 0
+    assert recs[0]["lint"] == {"fail_on": "error"}
+
+
+def test_rule_catalog_is_stable():
+    # the README/DESIGN rule catalog and the ledger schema key on these
+    # exact IDs — adding is fine, renaming/retiring needs a migration note
+    assert set(RULES) >= {
+        "DTYPE-001", "DTYPE-002", "COLL-001", "COLL-002", "COLL-003",
+        "PURE-001", "DONATE-001", "PALLAS-001", "PALLAS-002", "PALLAS-003",
+        "SPEC-001", "SPEC-002", "SPEC-003", "SPEC-004",
+        "REG-001", "REG-002"}
+    for rule, (sev, blurb) in RULES.items():
+        assert sev in ("info", "warn", "error"), rule
+        assert blurb, rule

@@ -5,7 +5,6 @@ This promotes the reference's dead `validate_result` helper
 into an actually-enforced check, on the virtual 8-device mesh.
 """
 
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -5,6 +5,8 @@ non-square grids whose lcm(r, c) panel walk exercises owner indexing in
 both dimensions — plus int8 exactness, quantized-wire broadcasts, the
 mode record, and the CLI."""
 
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -128,7 +130,12 @@ def test_cli_end_to_end(tmp_path, capsys):
     assert "validation: ok" in out
     assert len(records) == 1
     assert records[0].extras["algorithm"].startswith("SUMMA")
-    assert (tmp_path / "summa.jsonl").read_text().count("\n") == 1
+    # ledger = manifest header + one record (schema v2)
+    lines = (tmp_path / "summa.jsonl").read_text().splitlines()
+    assert len(lines) == 2
+    from tpu_matmul_bench.utils import telemetry
+
+    assert telemetry.is_manifest(json.loads(lines[0]))
 
 
 def test_size_helpers():

@@ -27,6 +27,10 @@ _PROGRAMS = {
     # load generator, reporting latency percentiles instead of sustained
     # TFLOP/s (serve/cli.py) — the latency-SLO complement to the sweeps
     "serve": "tpu_matmul_bench.serve.cli",
+    # the static contract auditor: jaxpr/HLO checks for every impl x mode
+    # plus offline spec validation — CPU-only, trace-time, no TPU needed
+    # (analysis/cli.py)
+    "lint": "tpu_matmul_bench.analysis.cli",
     # the round driver: declarative sweeps over the programs above, with
     # resumable execution and a regression gate (campaign/cli.py). Not a
     # benchmark itself — campaign specs name the other programs as jobs.

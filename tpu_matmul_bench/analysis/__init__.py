@@ -1,0 +1,27 @@
+"""Static contract auditor for the benchmark ("bench lint").
+
+Every performance claim in this repo rests on contracts the runtime never
+checks: low-precision paths must accumulate high and downcast exactly once,
+each parallelism mode must emit exactly the collectives its comms model
+predicts, timed regions must be free of host callbacks, declared-reusable
+buffers must actually donate, Pallas grids must divide their shapes and fit
+VMEM, and campaign/serve specs must be well-formed before a multi-hour run
+starts. All of these are decidable at trace time on a CPU host — no TPU
+required — by walking the jaxpr / lowered StableHLO of every registered
+impl × parallelism mode at small representative shapes.
+
+This package is that auditor. Entry point:
+
+    JAX_PLATFORMS=cpu python -m tpu_matmul_bench lint \
+        [--fail-on warn|error] [--json-out findings.jsonl]
+
+Findings carry stable rule IDs (see `findings.RULES`) and severities, and
+the ledger is the same schema-v2 JSONL the benchmarks emit (manifest header
++ one record per finding), so `scripts/digest_jsonl.py` renders it.
+"""
+
+from tpu_matmul_bench.analysis.findings import (  # noqa: F401
+    Finding,
+    RULES,
+    Severity,
+)

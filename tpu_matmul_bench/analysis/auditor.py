@@ -1,0 +1,425 @@
+"""The audits: trace every impl × mode and diff reality against contract.
+
+Each `audit_*` function returns a list of `Finding`s and touches no TPU —
+programs are traced/lowered at small representative shapes on whatever
+backend is active (the lint CLI forces an 8-virtual-device CPU host).
+`run_all` is the CLI's entry point.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+
+from tpu_matmul_bench.analysis import jaxpr_tools as jt
+from tpu_matmul_bench.analysis.comms_model import (
+    RING_WIRE_FACTOR,
+    expected_collectives,
+)
+from tpu_matmul_bench.analysis.findings import Finding
+
+# representative problem for mode tracing: big enough that every mode's
+# sharding divides (256 % 8 == 0), small enough to trace in milliseconds
+AUDIT_SIZE = 256
+AUDIT_BATCH = 4
+# two distinct world sizes so a mode whose collective payload is
+# accidentally world-independent (or world-quadratic) can't pass by luck
+AUDIT_WORLDS = (4, 8)
+
+
+def _all_modes() -> dict[str, Callable[..., Any]]:
+    from tpu_matmul_bench.parallel.modes import (
+        DISTRIBUTED_MODES,
+        SCALING_MODES,
+    )
+
+    merged = dict(SCALING_MODES)
+    merged.update(DISTRIBUTED_MODES)
+    return merged
+
+
+def _audit_config(dtype_name: str = "bfloat16", impl: str = "xla"):
+    from tpu_matmul_bench.utils.config import BenchConfig
+
+    return BenchConfig(
+        sizes=[AUDIT_SIZE], iterations=1, warmup=0, dtype_name=dtype_name,
+        mode=None, device=None, num_devices=None, json_out=None,
+        matmul_impl=impl, seed=0)
+
+
+def _dtype_findings(jaxpr: Any, where: str) -> list[Finding]:
+    """DTYPE-001/-002 for one traced program."""
+    findings = []
+    downs = [c for c in jt.float_converts(jaxpr) if c.direction == "down"]
+    if len(downs) > 1:
+        findings.append(Finding(
+            "DTYPE-001", where,
+            f"{len(downs)} float downcasts in one program (expected at most "
+            "one: accumulate high, downcast once on store)",
+            details={"downcasts": [(c.src, c.dst) for c in downs]}))
+    for narrow, wide in jt.roundtrip_converts(jaxpr):
+        findings.append(Finding(
+            "DTYPE-002", where,
+            f"round-trip: value downcast to {narrow} then widened to {wide} "
+            "— the narrowing loses precision and saves nothing",
+            details={"narrow": narrow, "wide": wide}))
+    return findings
+
+
+def _purity_findings(jaxpr: Any, where: str) -> list[Finding]:
+    prims = jt.callback_prims(jaxpr)
+    if not prims:
+        return []
+    return [Finding(
+        "PURE-001", where,
+        f"host callback primitive(s) {sorted(set(prims))} inside a timed "
+        "program — every iteration round-trips to the host",
+        details={"primitives": prims})]
+
+
+def _inventory_findings(jaxpr: Any, mode: str, world: int, size: int,
+                        dtype: Any, where: str,
+                        batch: int = AUDIT_BATCH) -> list[Finding]:
+    """COLL-001/COLL-002: traced collectives vs the analytic comms model."""
+    observed = jt.collective_inventory(jaxpr)
+    expected = expected_collectives(mode, world, size, dtype, batch=batch)
+    findings: list[Finding] = []
+
+    obs_kinds = sorted(u.kind for u in observed)
+    exp_kinds = sorted(e.kind for e in expected)
+    if obs_kinds != exp_kinds:
+        findings.append(Finding(
+            "COLL-001", where,
+            f"collective inventory {obs_kinds or '[]'} does not match the "
+            f"comms model {exp_kinds or '[]'} for {mode} at d={world}",
+            details={
+                "observed": [
+                    {"kind": u.kind, "prim": u.prim,
+                     "payload_bytes": u.payload_bytes} for u in observed],
+                "expected": [
+                    {"kind": e.kind, "payload_bytes": e.payload_bytes}
+                    for e in expected],
+            }))
+        return findings  # byte comparison is meaningless on a kind mismatch
+
+    for kind in sorted(set(exp_kinds)):
+        obs_bytes = sorted(u.payload_bytes for u in observed
+                           if u.kind == kind)
+        exp_bytes = sorted(e.payload_bytes for e in expected
+                           if e.kind == kind)
+        if obs_bytes != exp_bytes:
+            findings.append(Finding(
+                "COLL-002", where,
+                f"{kind} payload bytes {obs_bytes} != model {exp_bytes} "
+                f"for {mode} at d={world}",
+                details={
+                    "kind": kind,
+                    "observed_bytes": obs_bytes,
+                    "expected_bytes": exp_bytes,
+                    "ring_wire_factor": RING_WIRE_FACTOR[kind](world),
+                }))
+    return findings
+
+
+def audit_modes(worlds: Iterable[int] = AUDIT_WORLDS,
+                dtype_name: str = "bfloat16") -> list[Finding]:
+    """Trace every parallelism mode at every audit world size and check
+    collective inventory, compute-leg purity, and dtype discipline."""
+    from tpu_matmul_bench.parallel.mesh import make_mesh
+
+    config = _audit_config(dtype_name)
+    findings: list[Finding] = []
+    devices = jax.devices()
+    for world in worlds:
+        if world > len(devices):
+            findings.append(Finding(
+                "COLL-001", f"mesh:d{world}",
+                f"cannot audit world={world}: only {len(devices)} devices "
+                "(run under XLA_FLAGS=--xla_force_host_platform_device_count)",
+                severity="warn", details={"available": len(devices)}))
+            continue
+        mesh = make_mesh(devices[:world])
+        for mode, builder in _all_modes().items():
+            where = f"mode:{mode}@d{world}"
+            setup = builder(config, mesh, AUDIT_SIZE)
+            compute_jx = jax.make_jaxpr(setup.compute)(*setup.operands)
+
+            # compute legs must be comm-free: the compute/comm split the
+            # records report depends on it
+            compute_colls = jt.collective_inventory(compute_jx)
+            if compute_colls:
+                findings.append(Finding(
+                    "COLL-003", where,
+                    f"compute-only program contains collectives "
+                    f"{sorted(set(u.kind for u in compute_colls))}",
+                    details={"collectives": [u.prim for u in compute_colls]}))
+            findings.extend(_purity_findings(compute_jx, where + "/compute"))
+            findings.extend(_dtype_findings(compute_jx, where + "/compute"))
+
+            if setup.full is None:
+                full_jx = None
+            else:
+                full_jx = jax.make_jaxpr(setup.full)(*setup.operands)
+                findings.extend(_purity_findings(full_jx, where + "/full"))
+                findings.extend(_dtype_findings(full_jx, where + "/full"))
+            findings.extend(_inventory_findings(
+                full_jx if full_jx is not None else compute_jx,
+                mode, world, AUDIT_SIZE, config.dtype, where))
+    return findings
+
+
+# (impl, dtype) pairs every build must keep clean; ksplit rides along as
+# the structurally distinct Pallas path (multi-pass accumulation)
+_IMPL_MATRIX = (
+    ("xla", "bfloat16"), ("xla", "float32"), ("xla", "int8"),
+    ("pallas", "bfloat16"), ("pallas", "float32"), ("pallas", "int8"),
+)
+
+
+def _impl_fn(impl: str) -> Callable[..., Any]:
+    from tpu_matmul_bench.ops.matmul import matmul_2d
+    from tpu_matmul_bench.ops.pallas_matmul import pallas_matmul_ksplit
+
+    if impl == "pallas_ksplit":
+        return lambda a, b: pallas_matmul_ksplit(a, b, splits=2)
+    return matmul_2d(impl)
+
+
+def audit_impls(size: int = AUDIT_SIZE) -> list[Finding]:
+    """Trace every registered matmul impl at every benchmark dtype and
+    check dtype discipline + timed-region purity."""
+    findings: list[Finding] = []
+    cases = list(_IMPL_MATRIX) + [("pallas_ksplit", "bfloat16"),
+                                  ("pallas_ksplit", "float32")]
+    for impl, dtype_name in cases:
+        dtype = jnp.dtype(dtype_name)
+        where = f"impl:{impl}/{dtype_name}"
+        aval = jax.ShapeDtypeStruct((size, size), dtype)
+        jaxpr = jax.make_jaxpr(_impl_fn(impl))(aval, aval)
+        findings.extend(_dtype_findings(jaxpr, where))
+        findings.extend(_purity_findings(jaxpr, where))
+        colls = jt.collective_inventory(jaxpr)
+        if colls:
+            findings.append(Finding(
+                "COLL-003", where,
+                "single-device matmul impl contains collectives "
+                f"{sorted(set(u.kind for u in colls))}",
+                details={"collectives": [u.prim for u in colls]}))
+    return findings
+
+
+def donation_contracts() -> list[tuple[str, Callable[..., Any], tuple,
+                                       tuple[int, ...]]]:
+    """(name, fn, avals, donate_argnums) for every buffer-reuse contract
+    the suite declares. Today: the fused-loop timing protocol chains N
+    matmuls through one carry whose shape/dtype match operand 0, so the
+    operand buffer must be donatable into the output — if a refactor
+    breaks that (e.g. the carry picks up a cast), the reuse is silently
+    dead and peak memory doubles."""
+    from tpu_matmul_bench.ops.matmul import matmul_2d
+    from tpu_matmul_bench.utils.timing import fuse_iterations
+
+    aval = jax.ShapeDtypeStruct((AUDIT_SIZE, AUDIT_SIZE), jnp.bfloat16)
+    return [
+        ("timing.fuse_iterations(xla-matmul, 3)",
+         fuse_iterations(matmul_2d("xla"), 3), (aval, aval), (0,)),
+        ("ops.matmul_2d(xla) out-aliases A",
+         matmul_2d("xla"), (aval, aval), (0,)),
+    ]
+
+
+def audit_donation() -> list[Finding]:
+    """DONATE-001 for every declared reuse contract: lower with the
+    declared donations and require at least one alias/donor marker in the
+    StableHLO."""
+    findings = []
+    for name, fn, avals, donate in donation_contracts():
+        count = jt.donation_alias_count(fn, avals, donate_argnums=donate)
+        if count == 0:
+            findings.append(Finding(
+                "DONATE-001", f"donation:{name}",
+                f"no donation alias in lowering (donate_argnums={donate}) "
+                "— the declared buffer reuse is dead",
+                details={"donate_argnums": list(donate)}))
+    return findings
+
+
+def _pallas_dtypes(in_dtype: Any) -> tuple[Any, Any]:
+    """(out_dtype, acc_dtype) the kernel uses for an input dtype."""
+    dt = jnp.dtype(in_dtype)
+    if jnp.issubdtype(dt, jnp.integer):
+        return jnp.dtype(jnp.int32), jnp.dtype(jnp.int32)
+    return dt, jnp.dtype(jnp.float32)
+
+
+def check_pallas_blocks(where: str, m: int, n: int, k: int,
+                        bm: int, bn: int, bk: int,
+                        in_dtype: Any = jnp.bfloat16) -> list[Finding]:
+    """The three Pallas static checks for one (problem, blocking):
+    grid divisibility, tile alignment, VMEM budget."""
+    from tpu_matmul_bench.ops.pallas_matmul import (
+        VMEM_LIMIT_CAP,
+        vmem_bytes_estimate,
+    )
+
+    findings = []
+    bad_div = [(dim_name, dim, blk)
+               for dim_name, dim, blk in (("m", m, bm), ("n", n, bn),
+                                          ("k", k, bk))
+               if blk <= 0 or dim % blk]
+    if bad_div:
+        findings.append(Finding(
+            "PALLAS-001", where,
+            "block does not divide its dim: " + ", ".join(
+                f"{d}={dim} %% b{d}={blk}" for d, dim, blk in bad_div),
+            details={"bad": [{"dim": d, "size": dim, "block": blk}
+                             for d, dim, blk in bad_div]}))
+    misaligned = []
+    if bm % 8:
+        misaligned.append(("bm", bm, 8))
+    for dim_name, blk in (("bn", bn), ("bk", bk)):
+        if blk % 128:
+            misaligned.append((dim_name, blk, 128))
+    if misaligned:
+        findings.append(Finding(
+            "PALLAS-002", where,
+            "block misaligned to the (8, 128) tile / 128-lane MXU: "
+            + ", ".join(f"{nm}={blk} %% {al}" for nm, blk, al in misaligned),
+            details={"misaligned": [{"block": nm, "value": blk,
+                                     "alignment": al}
+                                    for nm, blk, al in misaligned]}))
+    out_dt, acc_dt = _pallas_dtypes(in_dtype)
+    est = vmem_bytes_estimate(bm, bn, bk, in_dtype, out_dt, acc_dt)
+    if est > VMEM_LIMIT_CAP:
+        findings.append(Finding(
+            "PALLAS-003", where,
+            f"VMEM footprint estimate {est / 2**20:.1f} MiB exceeds the "
+            f"{VMEM_LIMIT_CAP / 2**20:.0f} MiB budget cap",
+            details={"estimate_bytes": est, "cap_bytes": VMEM_LIMIT_CAP}))
+    return findings
+
+
+_PALLAS_AUDIT_SIZES = (256, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+_PALLAS_AUDIT_KINDS = ("TPU v5e", "cpu")
+
+
+def audit_pallas_static() -> list[Finding]:
+    """Static checks over the shipped tuning surface: for every audit size
+    × dtype × device kind, the blocks the kernel would actually run
+    (tuned + clamped) must divide, align, and fit VMEM; the raw tuned rows
+    must align and fit VMEM at their own blocking."""
+    from tpu_matmul_bench.ops.pallas_matmul import (
+        _RECT_BLOCKS,
+        _TUNED_BLOCKS,
+        effective_blocks,
+        tuned_blocks,
+    )
+
+    findings: list[Finding] = []
+    for kind in _PALLAS_AUDIT_KINDS:
+        for dtype_name in ("bfloat16", "float32", "int8"):
+            dt = jnp.dtype(dtype_name)
+            for s in _PALLAS_AUDIT_SIZES:
+                bm, bn, bk = tuned_blocks(s, s, s, kind, dt)
+                eff = effective_blocks(s, s, s, bm, bn, bk)
+                findings.extend(check_pallas_blocks(
+                    f"pallas:{kind}/{dtype_name}@{s}", s, s, s, *eff,
+                    in_dtype=dt))
+    # raw tuned rows: alignment + VMEM at the row's own blocking (the
+    # clamp can shrink blocks at small dims, never grow them, so a row
+    # that fails here fails everywhere it claims to have been measured)
+    for kind, by_dtype in _TUNED_BLOCKS.items():
+        for dtype_name, rows in by_dtype.items():
+            dt = jnp.dtype(dtype_name)
+            for min_dim, (bm, bn, bk) in rows:
+                dims = (max(min_dim, bm), max(min_dim, bn), max(min_dim, bk))
+                findings.extend(check_pallas_blocks(
+                    f"pallas:tuned[{kind}/{dtype_name}>={min_dim}]",
+                    *dims, bm, bn, bk, in_dtype=dt))
+    for kind, by_dtype in _RECT_BLOCKS.items():
+        for dtype_name, rows in by_dtype.items():
+            dt = jnp.dtype(dtype_name)
+            for axis, min_ratio, min_other, (bm, bn, bk) in rows:
+                # smallest problem the row claims: dominant axis at
+                # min_ratio × min_other, the others at min_other
+                dom = min_ratio * min_other
+                m, n = (dom, min_other) if axis == "m" else (min_other, dom)
+                findings.extend(check_pallas_blocks(
+                    f"pallas:rect[{kind}/{dtype_name}/{axis}]",
+                    m, n, max(min_other, bk), bm, bn, bk, in_dtype=dt))
+    return findings
+
+
+# provenance substrings that count as a committed measurement artifact
+_ARTIFACT_TOKENS = ("measurements/", "RESULTS_TPU.md")
+
+_REGISTRY_SIZES = (256, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+_REGISTRY_RECTS = ((8192, 28672, 4096), (28672, 8192, 4096))
+_REGISTRY_DTYPES = ("bfloat16", "float16", "float32", "int8")
+
+
+def audit_registry() -> list[Finding]:
+    """REG-001/REG-002 over the whole routing surface of impl_select:
+    every tier that routes to the hand-written kernel must cite a
+    committed measurement artifact; tie-policy extrapolations are
+    surfaced (info) so the open head-to-heads stay visible."""
+    from tpu_matmul_bench.ops.impl_select import select_impl
+
+    findings = []
+    seen: set[tuple[str, str]] = set()
+    shapes = [(s, s, s) for s in _REGISTRY_SIZES] + list(_REGISTRY_RECTS)
+    for dtype_name in _REGISTRY_DTYPES:
+        dt = jnp.dtype(dtype_name)
+        for m, n, k in shapes:
+            choice = select_impl(m, n, k, "TPU v5e", dt)
+            key = (choice.impl, choice.provenance)
+            if key in seen:
+                continue
+            seen.add(key)
+            where = f"registry:{dtype_name}@{m}x{n}x{k}"
+            if choice.impl == "pallas" and not any(
+                    tok in choice.provenance for tok in _ARTIFACT_TOKENS):
+                findings.append(Finding(
+                    "REG-001", where,
+                    f"tier routes to {choice.impl!r} citing no measurement "
+                    f"artifact: {choice.provenance!r}",
+                    details={"impl": choice.impl,
+                             "provenance": choice.provenance}))
+            if "tie" in choice.provenance.lower():
+                findings.append(Finding(
+                    "REG-002", where,
+                    "tie-policy tier (no head-to-head at these shapes): "
+                    f"{choice.provenance!r}",
+                    details={"impl": choice.impl,
+                             "provenance": choice.provenance}))
+    return findings
+
+
+def audit_specs(spec_paths: Iterable[str]) -> list[Finding]:
+    from tpu_matmul_bench.analysis.spec_lint import lint_specs
+
+    return lint_specs(list(spec_paths))
+
+
+AUDITS: dict[str, Callable[[], list[Finding]]] = {
+    "modes": audit_modes,
+    "impls": audit_impls,
+    "donation": audit_donation,
+    "pallas": audit_pallas_static,
+    "registry": audit_registry,
+}
+
+
+def run_all(spec_paths: Iterable[str] = (),
+            skip: Iterable[str] = ()) -> list[Finding]:
+    skip_set = set(skip)
+    findings: list[Finding] = []
+    for name, audit in AUDITS.items():
+        if name in skip_set:
+            continue
+        findings.extend(audit())
+    if "specs" not in skip_set:
+        findings.extend(audit_specs(spec_paths))
+    return findings

@@ -1,0 +1,103 @@
+"""`python -m tpu_matmul_bench lint` — run the static contract audits.
+
+CPU-only and cheap: programs are traced, never executed, so the whole
+audit runs in seconds on a laptop. Exit code 1 when any finding at or
+above --fail-on severity fires; the findings ledger (--json-out) is
+schema-v2 JSONL like every other program's.
+
+The CLI forces the CPU backend with 8 virtual host devices BEFORE jax
+initializes — the mode audits need a multi-device mesh, and lint must
+never occupy (or require) a TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+_AUDIT_DEVICE_COUNT = 8
+
+
+def _force_cpu_backend() -> None:
+    """Best-effort CPU + virtual-device setup; must run before the first
+    backend query. In-process callers that already initialized a backend
+    (tests under conftest's 8-device CPU mesh) pass through untouched."""
+    flag = f"--xla_force_host_platform_device_count={_AUDIT_DEVICE_COUNT}"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = f"{xla_flags} {flag}".strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized; trust the caller's setup
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="lint",
+        description="Static contract auditor: jaxpr/HLO checks for every "
+                    "impl x mode, plus offline spec validation.")
+    parser.add_argument("--fail-on", choices=("warn", "error"),
+                        default="error",
+                        help="lowest severity that fails the run "
+                             "(default: error)")
+    parser.add_argument("--json-out", default=None,
+                        help="write the findings ledger (schema-v2 JSONL)")
+    parser.add_argument("--specs", nargs="*", default=None,
+                        help="spec files to lint (default: specs/*.toml "
+                             "under the repo root)")
+    parser.add_argument("--skip", nargs="*", default=(),
+                        choices=("modes", "impls", "donation", "pallas",
+                                 "registry", "specs"),
+                        help="audit groups to skip")
+    parser.add_argument("--quiet", action="store_true",
+                        help="suppress per-finding lines; print the "
+                             "summary only")
+    return parser
+
+
+def _default_specs() -> list[str]:
+    spec_dir = Path(__file__).resolve().parents[2] / "specs"
+    return sorted(str(p) for p in spec_dir.glob("*.toml"))
+
+
+def main(argv: list[str] | None = None):
+    args = build_parser().parse_args(argv)
+    _force_cpu_backend()
+
+    from tpu_matmul_bench.analysis.auditor import run_all
+    from tpu_matmul_bench.analysis.findings import (
+        should_fail,
+        summarize,
+        write_ledger,
+    )
+
+    spec_paths = args.specs if args.specs is not None else _default_specs()
+    findings = run_all(spec_paths=spec_paths, skip=args.skip)
+
+    if not args.quiet:
+        for f in findings:
+            print(f"[{f.severity:5s}] {f.rule} {f.where}: {f.message}")
+    counts = summarize(findings)
+    print(f"lint: {counts['error']} error(s), {counts['warn']} warning(s), "
+          f"{counts['info']} info")
+
+    if args.json_out:
+        write_ledger(args.json_out, findings,
+                     argv=list(sys.argv),
+                     extra={"fail_on": args.fail_on,
+                            "specs": [str(p) for p in spec_paths],
+                            "skipped": list(args.skip)})
+        print(f"findings ledger written to {args.json_out}")
+
+    if should_fail(findings, args.fail_on):
+        raise SystemExit(1)
+    return [f.to_record() for f in findings]
+
+
+if __name__ == "__main__":
+    main()

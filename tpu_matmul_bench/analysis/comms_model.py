@@ -1,0 +1,84 @@
+"""Analytic comms model: the collectives each parallelism mode MUST emit.
+
+Derived from the mode definitions in `parallel/modes.py`, not from tracing
+— that independence is the point: the auditor traces the real programs and
+diffs the observed inventory against this model, so a refactor that
+accidentally adds, drops, or swaps a collective is caught even when the
+numerics still validate (e.g. an all_gather of already-reduced copies is
+numerically identical to a psum but moves d× the bytes).
+
+Payload bytes are per-shard operand bytes of the collective — the same
+quantity `jaxpr_tools.collective_inventory` measures — for a square
+[size, size] problem in `dtype`:
+
+- independent: every device runs its own matmul; no collectives.
+- batch_parallel: per-device partial sum over the local batch, then one
+  all_reduce of the [local_batch-summed] output — operand [lb, n, n]
+  after the local stack (the reference keeps the batch dim, lb = B/d).
+- data_parallel: same gradient-sync shape with one replica per device —
+  all_reduce of [1, n, n].
+- matrix_parallel: column-sharded weights; one all_gather of each
+  device's [n, n/d] output columns. Degenerates to independent at d=1
+  (modes.py falls back before building the program).
+- model_parallel: row×col contraction shards; one all_reduce of the
+  full [n, n] partial product.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# wire-traffic factor per payload byte for a ring algorithm, by kind —
+# informational (reported in findings details), not part of the pass/fail
+# comparison, which is on exact payload bytes.
+RING_WIRE_FACTOR = {
+    "all_reduce": lambda d: 2.0 * (d - 1) / d,
+    "all_gather": lambda d: float(d - 1),
+    "reduce_scatter": lambda d: (d - 1) / d,
+    "ppermute": lambda d: 1.0,
+    "all_to_all": lambda d: (d - 1) / d,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpectedCollective:
+    kind: str
+    payload_bytes: int
+
+
+def _itemsize(dtype) -> int:
+    return np.dtype(dtype).itemsize
+
+
+def matmul_out_itemsize(dtype) -> int:
+    """Output itemsize of the suite's matmul for operand dtype: integer
+    operands accumulate to int32 (ops/matmul.py preferred_element_type);
+    float operands keep their dtype at the program boundary."""
+    dt = np.dtype(dtype)
+    if np.issubdtype(dt, np.integer):
+        return np.dtype(np.int32).itemsize
+    return dt.itemsize
+
+
+def expected_collectives(mode: str, world: int, size: int, dtype,
+                         batch: int = 4) -> list[ExpectedCollective]:
+    """Expected collective inventory for one mode's FULL (compute+comm)
+    program. Compute-only programs expect [] for every mode."""
+    item = matmul_out_itemsize(dtype)
+    n = size
+    if mode == "independent":
+        return []
+    if mode == "batch_parallel":
+        lb = max(batch // world, 1)
+        return [ExpectedCollective("all_reduce", lb * n * n * item)]
+    if mode == "data_parallel":
+        return [ExpectedCollective("all_reduce", 1 * n * n * item)]
+    if mode == "matrix_parallel":
+        if world == 1:
+            return []  # modes.py falls back to independent
+        return [ExpectedCollective("all_gather", n * (n // world) * item)]
+    if mode == "model_parallel":
+        return [ExpectedCollective("all_reduce", n * n * item)]
+    raise ValueError(f"no comms model for mode {mode!r}")

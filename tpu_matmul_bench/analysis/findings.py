@@ -1,0 +1,127 @@
+"""Findings model for the bench linter: stable rule IDs, severities, ledger.
+
+Rule IDs are part of the tool's contract — tests and CI grep for them, so
+they never change meaning or get reused. New rules append new IDs.
+
+The findings ledger reuses the schema-v2 JSONL convention from
+`utils/telemetry` / `utils/reporting`: first line a manifest record
+(`record_type: "manifest"`), then one `record_type: "lint_finding"` line
+per finding, then a `record_type: "lint_summary"` trailer with counts —
+so existing ledger tooling (digest_jsonl, campaign stores) can ingest it
+without a second parser.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+Severity = str  # "info" | "warn" | "error"
+
+SEVERITIES = ("info", "warn", "error")
+
+#: rule id -> (default severity, one-line description)
+RULES: dict[str, tuple[Severity, str]] = {
+    "DTYPE-001": ("error", "more than one float downcast in a matmul program "
+                           "(stray round-trip breaks single-downcast "
+                           "accumulation discipline)"),
+    "DTYPE-002": ("error", "downcast/upcast round-trip: a value is narrowed "
+                           "then widened again, losing precision for free"),
+    "COLL-001": ("error", "collective inventory mismatch: traced collectives "
+                          "differ in kind or count from the analytic comms "
+                          "model for the mode"),
+    "COLL-002": ("error", "collective byte-volume mismatch vs the analytic "
+                          "comms model"),
+    "COLL-003": ("error", "collective primitive inside a compute-only "
+                          "program (compute legs must be comm-free or the "
+                          "compute/comm split is meaningless)"),
+    "PURE-001": ("error", "host callback / debug print inside a timed "
+                          "program (host round-trips corrupt timing)"),
+    "DONATE-001": ("error", "buffer declared reusable does not lower with a "
+                            "donation alias (tf.aliasing_output / "
+                            "jax.buffer_donor absent)"),
+    "PALLAS-001": ("error", "Pallas block shape does not divide the padded "
+                            "problem dims it is tuned for"),
+    "PALLAS-002": ("error", "Pallas tile misaligned: block dims must align "
+                            "to the (8, 128) fp32 tile / 128-wide MXU"),
+    "PALLAS-003": ("error", "Pallas VMEM footprint estimate exceeds the "
+                            "compiler budget cap"),
+    "SPEC-001": ("error", "spec failed to parse/validate"),
+    "SPEC-002": ("error", "unknown key in a spec table (silently ignored at "
+                          "run time — almost always a typo)"),
+    "SPEC-003": ("warn", "sharded size not divisible by the device count "
+                         "it will run under"),
+    "SPEC-004": ("error", "job fingerprint collision: two distinct jobs "
+                          "would share a resume/ledger identity"),
+    "REG-001": ("warn", "impl-registry tier routes to a kernel citing no "
+                        "measurement artifact"),
+    "REG-002": ("info", "impl-registry tier extrapolated by tie policy "
+                        "(no head-to-head measurement at these shapes)"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding: a stable rule ID, where it fired, and evidence."""
+
+    rule: str
+    where: str
+    message: str
+    severity: Severity = ""  # defaults to the rule's severity
+    details: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.rule not in RULES:
+            raise ValueError(f"unknown rule id {self.rule!r}")
+        sev = self.severity or RULES[self.rule][0]
+        if sev not in SEVERITIES:
+            raise ValueError(f"unknown severity {sev!r}")
+        object.__setattr__(self, "severity", sev)
+
+    def to_record(self) -> dict[str, Any]:
+        return {
+            "record_type": "lint_finding",
+            "rule": self.rule,
+            "severity": self.severity,
+            "where": self.where,
+            "message": self.message,
+            "rule_doc": RULES[self.rule][1],
+            "details": self.details,
+        }
+
+
+def summarize(findings: list[Finding]) -> dict[str, int]:
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        counts[f.severity] += 1
+    return counts
+
+
+def worst_severity(findings: list[Finding]) -> Severity | None:
+    for sev in ("error", "warn", "info"):
+        if any(f.severity == sev for f in findings):
+            return sev
+    return None
+
+
+def should_fail(findings: list[Finding], fail_on: Severity) -> bool:
+    """Exit-code policy: --fail-on warn trips on warn+error, --fail-on
+    error trips on error only."""
+    threshold = SEVERITIES.index(fail_on)
+    return any(SEVERITIES.index(f.severity) >= threshold for f in findings)
+
+
+def write_ledger(path: str, findings: list[Finding], *,
+                 argv: list[str] | None = None,
+                 extra: dict[str, Any] | None = None) -> None:
+    """Write the findings ledger: manifest + findings + summary trailer."""
+    from tpu_matmul_bench.utils.telemetry import build_manifest
+
+    manifest = build_manifest(argv=argv, extra={"lint": extra or {}})
+    with open(path, "w") as fh:
+        fh.write(json.dumps(manifest) + "\n")
+        for f in findings:
+            fh.write(json.dumps(f.to_record()) + "\n")
+        fh.write(json.dumps({"record_type": "lint_summary",
+                             **summarize(findings)}) + "\n")

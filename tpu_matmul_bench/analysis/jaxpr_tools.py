@@ -1,0 +1,196 @@
+"""jaxpr / StableHLO inspection primitives for the lint rules.
+
+Everything here is trace-time only: programs are `jax.make_jaxpr`-traced or
+`jax.jit(...).lower()`-ed at representative shapes, never executed. The
+walker descends every nested jaxpr a primitive carries in its params
+(pjit's `jaxpr`, shard_map's `jaxpr`, pallas_call's `jaxpr`, scan/while
+closed jaxprs), so rules see the whole program, kernels included.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator
+
+import jax
+import numpy as np
+
+# primitive name -> canonical collective kind. jax 0.4.x spells the
+# varying-output psum "psum2" and newer versions use "psum_invariant" for
+# the invariant form; all are all_reduce on the wire.
+COLLECTIVE_KINDS: dict[str, str] = {
+    "psum": "all_reduce",
+    "psum2": "all_reduce",
+    "psum_invariant": "all_reduce",
+    "pmean": "all_reduce",
+    "all_gather": "all_gather",
+    "psum_scatter": "reduce_scatter",
+    "reduce_scatter": "reduce_scatter",
+    "ppermute": "ppermute",
+    "all_to_all": "all_to_all",
+}
+
+# host round-trip primitives that must never appear in a timed region
+CALLBACK_PRIMS = frozenset({
+    "debug_callback", "pure_callback", "io_callback", "host_callback",
+    "infeed", "outfeed", "debug_print",
+})
+
+
+def _sub_jaxprs(value: Any) -> Iterator[Any]:
+    """Yield every jaxpr-like object reachable from one params value."""
+    if value is None:
+        return
+    if hasattr(value, "eqns"):
+        yield value
+    elif hasattr(value, "jaxpr") and hasattr(value.jaxpr, "eqns"):
+        yield value.jaxpr
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _sub_jaxprs(v)
+
+
+def iter_eqns(jaxpr: Any) -> Iterator[Any]:
+    """Depth-first over every equation in a jaxpr, including all nested
+    sub-jaxprs (pjit / shard_map / pallas_call / scan / cond bodies)."""
+    if hasattr(jaxpr, "jaxpr"):  # ClosedJaxpr
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def trace(fn: Callable[..., Any], *avals: jax.ShapeDtypeStruct) -> Any:
+    """make_jaxpr at the given shapes — the auditor's one tracing door."""
+    return jax.make_jaxpr(fn)(*avals)
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveUse:
+    """One traced collective: canonical kind + per-shard payload bytes."""
+
+    kind: str
+    prim: str
+    payload_bytes: int
+    operand_shapes: tuple[tuple[int, ...], ...]
+    operand_dtypes: tuple[str, ...]
+
+
+def _aval_bytes(var: Any) -> int:
+    aval = var.aval
+    return int(np.prod(aval.shape, dtype=np.int64)) * np.dtype(aval.dtype).itemsize
+
+
+def collective_inventory(jaxpr: Any) -> list[CollectiveUse]:
+    """Every collective in the program, in program order. Payload bytes are
+    the per-shard operand sizes (inside shard_map avals are per-shard)."""
+    uses = []
+    for eqn in iter_eqns(jaxpr):
+        kind = COLLECTIVE_KINDS.get(eqn.primitive.name)
+        if kind is None:
+            continue
+        uses.append(CollectiveUse(
+            kind=kind,
+            prim=eqn.primitive.name,
+            payload_bytes=sum(_aval_bytes(v) for v in eqn.invars),
+            operand_shapes=tuple(tuple(v.aval.shape) for v in eqn.invars),
+            operand_dtypes=tuple(str(v.aval.dtype) for v in eqn.invars),
+        ))
+    return uses
+
+
+def _is_float(dt: Any) -> bool:
+    # jax's lattice, not numpy's: ml_dtypes extension floats (bfloat16,
+    # float8_*) are kind 'V' to numpy and invisible to np.issubdtype
+    return jax.numpy.issubdtype(np.dtype(dt), jax.numpy.floating)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvertUse:
+    """One convert_element_type between float dtypes."""
+
+    src: str
+    dst: str
+    direction: str  # "down" | "up" | "same"
+
+
+def float_converts(jaxpr: Any) -> list[ConvertUse]:
+    """All float->float convert_element_type eqns, classified by width.
+    Non-float converts (e.g. the bool->int32 masks pl.when emits) are not
+    dtype-discipline events and are skipped."""
+    out = []
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = np.dtype(eqn.invars[0].aval.dtype)
+        dst = np.dtype(eqn.params.get("new_dtype", eqn.outvars[0].aval.dtype))
+        if not (_is_float(src) and _is_float(dst)):
+            continue
+        if dst.itemsize < src.itemsize:
+            direction = "down"
+        elif dst.itemsize > src.itemsize:
+            direction = "up"
+        else:
+            direction = "same"
+        out.append(ConvertUse(str(src), str(dst), direction))
+    return out
+
+
+def downcast_count(jaxpr: Any) -> int:
+    return sum(1 for c in float_converts(jaxpr) if c.direction == "down")
+
+
+def roundtrip_converts(jaxpr: Any) -> list[tuple[str, str]]:
+    """(narrow, wide) pairs where a value produced by a float downcast is
+    fed straight back into an upcast — precision thrown away for free.
+    Detected per-scope via a producer map (downcasts inside a Pallas kernel
+    and upcasts outside it are separate scopes and legitimately disjoint)."""
+    found: list[tuple[str, str]] = []
+
+    def scan_scope(jaxpr_like: Any) -> None:
+        if hasattr(jaxpr_like, "jaxpr"):
+            jaxpr_like = jaxpr_like.jaxpr
+        producers: dict[int, Any] = {}
+        for eqn in jaxpr_like.eqns:
+            if eqn.primitive.name == "convert_element_type":
+                src = np.dtype(eqn.invars[0].aval.dtype)
+                dst = np.dtype(eqn.outvars[0].aval.dtype)
+                if _is_float(src) and _is_float(dst):
+                    if dst.itemsize > src.itemsize:
+                        prod = producers.get(id(eqn.invars[0]))
+                        if prod is not None:
+                            p_src = np.dtype(prod.invars[0].aval.dtype)
+                            found.append((str(src), str(p_src)))
+                    elif dst.itemsize < src.itemsize:
+                        producers[id(eqn.outvars[0])] = eqn
+            for v in eqn.params.values():
+                for sub in _sub_jaxprs(v):
+                    scan_scope(sub)
+
+    scan_scope(jaxpr)
+    return found
+
+
+def callback_prims(jaxpr: Any) -> list[str]:
+    """Names of host-callback primitives found anywhere in the program."""
+    return [eqn.primitive.name for eqn in iter_eqns(jaxpr)
+            if eqn.primitive.name in CALLBACK_PRIMS
+            or "callback" in eqn.primitive.name]
+
+
+def donation_alias_count(fn: Callable[..., Any], avals: tuple, *,
+                         donate_argnums: tuple[int, ...]) -> int:
+    """Lower `fn` with the given donations and count donation-alias markers
+    in the StableHLO text. jax 0.4.x emits `tf.aliasing_output` on args the
+    compiler actually aliased; jax >= 0.6 adds `jax.buffer_donor` for
+    donated-but-unaliased args. Zero means the donation contract is dead."""
+    import warnings
+
+    with warnings.catch_warnings():
+        # the "Some donated buffers were not usable" warning IS the signal
+        # we count; don't let it leak to the console during an audit
+        warnings.simplefilter("ignore")
+        text = jax.jit(fn, donate_argnums=donate_argnums).lower(*avals).as_text()
+    return text.count("tf.aliasing_output") + text.count("jax.buffer_donor")

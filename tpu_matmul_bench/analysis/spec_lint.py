@@ -1,0 +1,143 @@
+"""Offline campaign/serve spec validation — lint before you burn TPU hours.
+
+`campaign/spec.py` validates what it must to build a job plan (top-level
+keys, programs, duplicate ids); everything else is deliberately permissive
+at run time. That permissiveness is where typos hide: an unknown job-level
+key (`timout_s`) is silently ignored, a size that doesn't divide the mesh
+fails an hour into the sweep, and two jobs that expand to the same argv
+silently share one resume slot. This module checks all of it statically,
+without touching a backend.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+from tpu_matmul_bench.analysis.findings import Finding
+
+# key vocabulary per spec table, mirroring what campaign/spec.py actually
+# reads — anything else is dead weight the executor will never see
+_CAMPAIGN_KEYS = {"name"}
+_DEFAULTS_KEYS = {"flags", "timeout_s", "retries", "backoff_s"}
+_JOB_KEYS = {"id", "program", "flags", "timeout_s", "retries", "backoff_s"}
+_SWEEP_KEYS = {"id_prefix", "program", "flags", "timeout_s", "retries",
+               "backoff_s", "sizes", "modes", "dtypes", "num_devices"}
+
+# modes whose program shards the [size, size] problem over the device
+# axis and therefore needs size % num_devices == 0
+_DIVISIBILITY_MODES = {"matrix_parallel", "model_parallel"}
+
+
+def _flag_values(argv: list[str], flag: str) -> list[str]:
+    """Values following `flag` up to the next option, commas split."""
+    out: list[str] = []
+    try:
+        i = argv.index(flag)
+    except ValueError:
+        return out
+    for tok in argv[i + 1:]:
+        if tok.startswith("--"):
+            break
+        out.extend(t for t in tok.split(",") if t)
+    return out
+
+
+def _unknown_key_findings(data: dict[str, Any], where: str) -> list[Finding]:
+    findings = []
+
+    def check(table: Any, known: set, label: str) -> None:
+        if not isinstance(table, dict):
+            return
+        for key in sorted(set(table) - known):
+            findings.append(Finding(
+                "SPEC-002", f"{where}:{label}",
+                f"unknown key {key!r} (silently ignored at run time)",
+                details={"key": key, "known": sorted(known)}))
+
+    check(data.get("campaign", {}), _CAMPAIGN_KEYS, "campaign")
+    check(data.get("defaults", {}), _DEFAULTS_KEYS, "defaults")
+    for i, entry in enumerate(data.get("job", []) or []):
+        check(entry, _JOB_KEYS, f"job[{i}]")
+    for i, entry in enumerate(data.get("sweep", []) or []):
+        check(entry, _SWEEP_KEYS, f"sweep[{i}]")
+    return findings
+
+
+def lint_spec_file(path: str | Path) -> list[Finding]:
+    """All spec findings for one file: parse, vocabulary, divisibility,
+    fingerprint identity."""
+    from tpu_matmul_bench.campaign.spec import (
+        CampaignSpecError,
+        _parse_toml,
+        spec_from_dict,
+    )
+
+    p = Path(path)
+    where = str(p)
+    try:
+        text = p.read_text()
+    except OSError as e:
+        return [Finding("SPEC-001", where, f"cannot read spec: {e}")]
+
+    try:
+        if p.suffix == ".toml":
+            data = _parse_toml(text)
+        else:
+            data = json.loads(text)
+    except (CampaignSpecError, ValueError) as e:
+        return [Finding("SPEC-001", where, f"spec does not parse: {e}")]
+    if not isinstance(data, dict):
+        return [Finding("SPEC-001", where,
+                        f"spec root must be a table, got {type(data).__name__}")]
+
+    findings = _unknown_key_findings(data, where)
+
+    try:
+        spec = spec_from_dict(data)
+    except CampaignSpecError as e:
+        findings.append(Finding("SPEC-001", where, str(e)))
+        return findings
+
+    # fingerprint identity: the resume journal keys on fingerprints, so two
+    # jobs sharing one means the second silently reuses the first's result
+    by_fp: dict[str, str] = {}
+    for job in spec.jobs:
+        prior = by_fp.setdefault(job.fingerprint, job.job_id)
+        if prior != job.job_id:
+            findings.append(Finding(
+                "SPEC-004", f"{where}:{job.job_id}",
+                f"fingerprint {job.fingerprint} collides with job "
+                f"{prior!r} — identical program+argv, one resume slot",
+                details={"fingerprint": job.fingerprint,
+                         "jobs": [prior, job.job_id]}))
+
+    # mesh divisibility: sharding modes need size % num_devices == 0
+    for job in spec.jobs:
+        argv = list(job.argv)
+        modes = _flag_values(argv, "--mode") or []
+        if not (_DIVISIBILITY_MODES & set(modes)):
+            continue
+        devs = _flag_values(argv, "--num-devices")
+        sizes = _flag_values(argv, "--sizes")
+        for d_str in devs:
+            for s_str in sizes:
+                try:
+                    d, s = int(d_str), int(s_str)
+                except ValueError:
+                    continue
+                if d > 1 and s % d:
+                    findings.append(Finding(
+                        "SPEC-003", f"{where}:{job.job_id}",
+                        f"size {s} not divisible by num_devices {d} for "
+                        f"sharding mode(s) {sorted(_DIVISIBILITY_MODES & set(modes))}",
+                        details={"size": s, "num_devices": d}))
+    return findings
+
+
+def lint_specs(paths: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in paths:
+        findings.extend(lint_spec_file(path))
+    return findings

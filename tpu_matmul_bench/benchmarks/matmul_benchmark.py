@@ -33,7 +33,7 @@ from tpu_matmul_bench.parallel.modes import (
     expected_corner,
 )
 from tpu_matmul_bench.utils import telemetry
-from tpu_matmul_bench.utils.config import BenchConfig, parse_config
+from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.device import (
     collect_device_info,
     device_banner,

@@ -46,6 +46,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--trace-out", default=None,
                      help="campaign-level Chrome-trace span timeline "
                           "('-' for stdout)")
+    run.add_argument("--lint", action="store_true",
+                     help="run the static contract auditor over the spec "
+                          "and the code before executing; abort on any "
+                          "error-severity finding (CPU subprocess — the "
+                          "campaign parent stays backend-free)")
 
     res = sub.add_parser("resume", help="finish an interrupted campaign")
     res.add_argument("campaign_dir")
@@ -77,7 +82,28 @@ def _load_spec_or_exit(path: str):
         raise SystemExit(f"campaign: bad spec: {e}")
 
 
+def _pre_campaign_lint(spec_path: str) -> None:
+    """The --lint gate: audit the spec + code in a CPU child process
+    before any job burns device time. A subprocess keeps the campaign
+    parent backend-free (the executor's children must be able to claim
+    the TPU)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_matmul_bench", "lint",
+         "--fail-on", "error", "--specs", spec_path],
+        env=env)
+    if proc.returncode:
+        raise SystemExit("campaign: lint gate failed (run `python -m "
+                         "tpu_matmul_bench lint` for details)")
+
+
 def _cmd_run(args: argparse.Namespace) -> int:
+    if getattr(args, "lint", False):
+        _pre_campaign_lint(args.spec)
     spec = _load_spec_or_exit(args.spec)
     if args.dry_run:
         for job in spec.jobs:

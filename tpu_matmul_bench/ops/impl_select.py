@@ -5,12 +5,18 @@ head-to-head under the fused protocol across dtypes and shapes, and the
 winner is size- and shape-qualified (VERDICT r4 weak #1): XLA leads int8
 below 16k and the tall-M rectangle; Pallas leads bf16 at every swept
 size, int8 at 16k, fp32, and the wide-N MLP rectangle. `auto` routes
-each (dtype, shape) to its measured winner so "matching-or-beating"
-holds unconditionally at the user-facing surface instead of requiring
-the user to know the qualifications.
+each (dtype, shape) to its measured winner, so "matching-or-beating"
+holds at the user-facing surface wherever a head-to-head exists — with
+one documented qualification: the bf16 1k–4k band (the sharded
+ring-chunk class) has NO XLA head-to-head at those shapes; its Pallas
+row is tuned against the Pallas fallback only (187.7 vs 148.1, RESULTS
+r2) and routes to Pallas by tie policy, an extrapolation ADVICE r5
+leaves open. `python -m tpu_matmul_bench lint` surfaces that tier as
+REG-002 until the head-to-head lands.
 
 Every row cites the committed measurement artifact that justifies it
-(the artifact-hygiene bar: no routing decision without a file). Ties and
+(the artifact-hygiene bar: no routing decision without a file; the lint
+rule REG-001 flags any Pallas tier that stops citing one). Ties and
 unmeasured configurations on a tuned chip fall to Pallas — our kernel's
 tuned table generalizes (the 16k int8 winner came from the 8k sweep's
 shape); configurations on UNKNOWN chips (CPU, GPU, untuned TPU gens)

@@ -15,7 +15,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-from tpu_matmul_bench.utils.metrics import is_integer_dtype, matmul_out_dtype
+from tpu_matmul_bench.utils.metrics import is_integer_dtype
 
 
 def _dot(a: jax.Array, b: jax.Array) -> jax.Array:

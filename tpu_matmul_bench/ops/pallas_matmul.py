@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_matmul_bench.utils.compat import pallas_compiler_params
+
 from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
 
 
@@ -327,7 +329,7 @@ def pallas_matmul(
         ],
         out_specs=pl.BlockSpec((bm, bn), o_map),
         scratch_shapes=[pltpu.VMEM((bm, bn), acc_dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=_vmem_limit(
                 vmem_bytes_estimate(bm, bn, bk, a.dtype, out_dtype, acc_dtype)

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_matmul_bench.utils.compat import pallas_compiler_params
+
 from tpu_matmul_bench.parallel.mesh import smap
 from tpu_matmul_bench.utils.metrics import matmul_acc_dtype, matmul_out_dtype
 from jax.sharding import Mesh, PartitionSpec as P
@@ -160,7 +162,7 @@ def ring_allgather_matmul(mesh: Mesh, axis: str = "x",
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.REGULAR((2,)),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pallas_compiler_params(
                 has_side_effects=True,
                 collective_id=0,
                 vmem_limit_bytes=_vmem_limit(footprint),

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_matmul_bench.utils.compat import pallas_compiler_params
+
 from tpu_matmul_bench.ops.pallas_matmul import (
     _vmem_limit,
     effective_blocks,
@@ -251,7 +253,7 @@ def ring_reduce_scatter_matmul_bidir_hbm(
                 pltpu.VMEM((blocks_b[0], blocks_b[1]), acc_dtype),
             ] + ([pltpu.VMEM((klocal, n), x_local.dtype),
                   pltpu.SemaphoreType.DMA(())] if use_wres else []),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pallas_compiler_params(
                 has_side_effects=True,
                 collective_id=4,  # distinct from the other rings' barriers
                 vmem_limit_bytes=_vmem_limit(

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_matmul_bench.utils.compat import pallas_compiler_params
+
 from tpu_matmul_bench.ops.pallas_matmul import (
     _matmul_kernel,
     _vmem_limit,
@@ -356,7 +358,7 @@ def ring_allgather_matmul_hbm(
                 pltpu.VMEM((blocks[0], blocks[1]), acc_dtype),
             ] + ([pltpu.VMEM((k, nshard), x_local.dtype),
                   pltpu.SemaphoreType.DMA(())] if use_wres else []),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pallas_compiler_params(
                 has_side_effects=True,
                 collective_id=1,  # distinct from pallas_ring's barrier
                 # the nested pipeline's tile set (operands/comm ring stay in

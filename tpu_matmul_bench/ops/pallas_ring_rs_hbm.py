@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_matmul_bench.utils.compat import pallas_compiler_params
+
 from tpu_matmul_bench.ops.pallas_matmul import (
     _matmul_kernel,
     _vmem_limit,
@@ -325,7 +327,7 @@ def ring_reduce_scatter_matmul_hbm(
                 pltpu.VMEM((blocks[0], blocks[1]), acc_dtype),
             ] + ([pltpu.VMEM((klocal, n), x_local.dtype),
                   pltpu.SemaphoreType.DMA(())] if use_wres else []),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pallas_compiler_params(
                 has_side_effects=True,
                 collective_id=2,  # distinct from the AG rings' barriers
                 # nested-pipeline tile set + the double-buffered accin tile

@@ -28,6 +28,7 @@ from tpu_matmul_bench.parallel.mesh import (
     smap,
     world_size,
 )
+from tpu_matmul_bench.utils.compat import pcast_varying
 from tpu_matmul_bench.parallel.modes import corner_validation
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
@@ -80,8 +81,7 @@ def _ppermute_bidir_body(d: int):
 COLLECTIVES: dict[str, CollectiveSpec] = {
     "psum": CollectiveSpec(
         "psum",
-        lambda d: lambda x: jax.lax.pcast(
-            jax.lax.psum(x, "x"), "x", to="varying"),
+        lambda d: lambda x: pcast_varying(jax.lax.psum(x, "x"), "x"),
         lambda d, s: s,
         lambda d: 2.0 * (d - 1) / d,
         lambda d: 3.0,

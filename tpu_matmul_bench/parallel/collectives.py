@@ -17,6 +17,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_matmul_bench.parallel.mesh import ring_perm, smap as _smap
+from tpu_matmul_bench.utils.compat import pcast_varying
 
 
 def psum_over(mesh: Mesh, axis: str = "x"):
@@ -29,7 +30,7 @@ def psum_over(mesh: Mesh, axis: str = "x"):
     """
 
     def body(x):
-        return jax.lax.pcast(jax.lax.psum(x, axis), axis, to="varying")
+        return pcast_varying(jax.lax.psum(x, axis), axis)
 
     return _smap(body, mesh, in_specs=P(axis), out_specs=P(axis))
 
@@ -39,7 +40,7 @@ def pmean_over(mesh: Mesh, axis: str = "x"):
     (reference `matmul_scaling_benchmark.py:301`)."""
 
     def body(x):
-        return jax.lax.pcast(jax.lax.pmean(x, axis), axis, to="varying")
+        return pcast_varying(jax.lax.pmean(x, axis), axis)
 
     return _smap(body, mesh, in_specs=P(axis), out_specs=P(axis))
 

@@ -35,6 +35,7 @@ from tpu_matmul_bench.parallel.quantized import (
     psum_impl,
     uses_quantized_comm,
 )
+from tpu_matmul_bench.utils.compat import pcast_varying
 from tpu_matmul_bench.utils.config import BenchConfig
 from tpu_matmul_bench.utils.metrics import calculate_tflops
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord
@@ -72,7 +73,7 @@ def hybrid_programs(mesh: Mesh, impl: str = "xla",
         # (psum_impl's varying_out covers the 'dp' axis; the quantized
         # ring's output is varying already, exact psum gets a pcast)
         g = psum(jnp.sum(y, axis=0), "dp")
-        return jax.lax.pcast(g, "tp", to="varying")
+        return pcast_varying(g, "tp")
 
     compute = smap(compute_body, mesh,
                    in_specs=(P("dp"), P(None, "tp")),

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpu_matmul_bench.utils.compat import axis_size
+
 _QMAX = 127.0
 
 
@@ -55,7 +57,7 @@ def quantized_psum(x: jax.Array, axis_name: str) -> jax.Array:
     """
     if jnp.issubdtype(x.dtype, jnp.integer):
         return lax.psum(x, axis_name)
-    d = lax.axis_size(axis_name)
+    d = axis_size(axis_name)
     if d == 1:
         return x
     orig_shape = x.shape
@@ -103,7 +105,7 @@ def quantized_all_gather(x: jax.Array, axis_name: str,
     """
     if jnp.issubdtype(x.dtype, jnp.integer):
         return lax.all_gather(x, axis_name, axis=axis, tiled=True)
-    if lax.axis_size(axis_name) == 1:
+    if axis_size(axis_name) == 1:
         # the gather is a no-op; skip the avoidable int8 rounding error
         # (mirrors quantized_psum's d==1 short-circuit)
         return x
@@ -155,7 +157,9 @@ def uses_quantized_comm(config) -> bool:
 def _psum_varying(x: jax.Array, axis_name: str) -> jax.Array:
     """Exact lax.psum cast to varying-over-axis, for shard_map bodies whose
     out_specs shard the axis (lax.psum output is axis-invariant)."""
-    return lax.pcast(lax.psum(x, axis_name), axis_name, to="varying")
+    from tpu_matmul_bench.utils.compat import pcast_varying
+
+    return pcast_varying(lax.psum(x, axis_name), axis_name)
 
 
 def psum_impl(comm_quant: str | None, varying_out: bool = False):

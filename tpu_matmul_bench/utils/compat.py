@@ -1,0 +1,77 @@
+"""jax version compatibility shims (single home; see also mesh.shard_map_compat).
+
+The package targets the jax ≥ 0.6 spellings; the container floor is jax
+0.4.37. The API gaps are bridged here so every module picks up the same
+resolution instead of copy-pasting getattr dances:
+
+- ``axis_size``: ``lax.axis_size`` is the ≥ 0.6 spelling; 0.4.x uses the
+  trace-time-folded ``lax.psum(1, axis)`` idiom.
+
+- ``pcast_varying``: jax ≥ 0.6's varying-manual-axes model requires
+  ``lax.pcast(..., to="varying")`` after an axis-invariant collective
+  (psum/pmean) whose consumer out_spec shards the axis. jax 0.4.x has no
+  vma tracking — check_rep accepts the invariant value directly — so the
+  cast is the identity there.
+
+- ``pallas_compiler_params``: ``pltpu.CompilerParams`` is the ≥ 0.6 name
+  of 0.4.x's ``pltpu.TPUCompilerParams`` (same fields we use:
+  dimension_semantics, vmem_limit_bytes).
+
+- ``distributed_is_initialized``: ``jax.distributed.is_initialized`` is
+  ≥ 0.5; 0.4.x exposes the same fact via the client handle on
+  distributed global state.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+if hasattr(jax.lax, "axis_size"):
+    def axis_size(axis_name: str) -> int:
+        return jax.lax.axis_size(axis_name)
+else:
+    def axis_size(axis_name: str) -> int:
+        # 0.4.x idiom: psum of the constant 1 folds to the axis size at
+        # trace time (no collective in the compiled program)
+        return jax.lax.psum(1, axis_name)
+
+
+if hasattr(jax.lax, "pcast"):
+    def pcast_varying(x: jax.Array, axis_name: str) -> jax.Array:
+        return jax.lax.pcast(x, axis_name, to="varying")
+else:
+    def pcast_varying(x: jax.Array, axis_name: str) -> jax.Array:
+        return x
+
+def distributed_is_initialized() -> bool:
+    """Whether this process already joined a jax.distributed cluster."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    try:  # 0.4.x: the client handle IS the initialized bit
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:
+        return False
+
+
+_COMPILER_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
+
+def pallas_compiler_params(**kwargs: Any):
+    """pltpu compiler params under either API name.
+
+    Fields the resolved class doesn't know (e.g. 0.4.x's TPUCompilerParams
+    predates ``has_side_effects``) are dropped rather than fatal: they are
+    compiler hints, and on the old API the kernels only run in interpreter
+    mode anyway, where compiler params are inert.
+    """
+    import dataclasses
+
+    known = {f.name for f in dataclasses.fields(_COMPILER_PARAMS_CLS)}
+    return _COMPILER_PARAMS_CLS(
+        **{k: v for k, v in kwargs.items() if k in known})

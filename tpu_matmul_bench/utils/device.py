@@ -186,7 +186,9 @@ def maybe_init_multihost() -> None:
                   ("COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS"))
     if explicit is None and not managed:
         return
-    if jax.distributed.is_initialized():
+    from tpu_matmul_bench.utils.compat import distributed_is_initialized
+
+    if distributed_is_initialized():
         # idempotent: drivers that re-enter run() per sub-config (the
         # scaling `curve`) call this once per sub-run; re-initializing an
         # already-joined cluster raised and printed a spurious warning

@@ -102,10 +102,14 @@ def _row(r) -> str:
             f"{extra_bits}")
 
 
-def _digest_lint(recs: list[dict]) -> None:
+def _digest_lint(recs: list[dict],
+                 manifests: list[dict] | None = None) -> None:
     """Lint findings ledger: rule-ID x severity table + per-rule example,
     ranked most-severe first (the digest counterpart of `python -m
-    tpu_matmul_bench lint --json-out`)."""
+    tpu_matmul_bench lint --json-out`). Covers every rule family the
+    linter emits — SPEC/COLL/… and the HLO passes' SCHED/MEM/DRIFT —
+    plus the manifest's per-mode peak-memory column when the memory
+    audit ran."""
     findings = [r for r in recs if r.get("record_type") == "lint_finding"]
     sev_rank = {"error": 0, "warn": 1, "info": 2}
     by_rule: dict[str, list[dict]] = {}
@@ -124,6 +128,16 @@ def _digest_lint(recs: list[dict]) -> None:
               f"{ex.get('where')}: {ex.get('message')}")
     print(f"  total: {totals.get('error', 0)} error(s), "
           f"{totals.get('warn', 0)} warning(s), {totals.get('info', 0)} info")
+    # per-mode peak-memory column from the manifest (present when the
+    # memory audit ran; keys are "mode@d{world}" → estimated peak bytes)
+    peaks = {}
+    for m in manifests or []:
+        peaks.update((m.get("lint") or {}).get("peak_memory") or {})
+    if peaks:
+        print(f"  {'peak memory (est.)':<24} {'MiB':>10}")
+        for key, peak in sorted(peaks.items(),
+                                key=lambda kv: (-kv[1], kv[0])):
+            print(f"  {key:<24} {peak / 2**20:>10.2f}")
 
 
 def _is_campaign_dir(p: Path) -> bool:
@@ -237,7 +251,7 @@ def main(paths: list[str]) -> None:
                   f"argv={' '.join(m.get('argv') or [])}")
         if any(r.get("record_type") in ("lint_finding", "lint_summary")
                for r in recs):
-            _digest_lint(recs)
+            _digest_lint(recs, manifests)
             continue
         recs.sort(key=_rank_key)
         for r in recs:

@@ -9,7 +9,13 @@
 #   2. python -m tpu_matmul_bench lint — traces every impl x mode on a
 #      CPU mesh and audits dtype discipline, collective inventory vs the
 #      comms model, timed-region purity, donation contracts, Pallas grids,
-#      and the shipped campaign specs. Fails on error-severity findings.
+#      and the shipped campaign specs — PLUS the HLO pass family (on by
+#      default, ~20-30 s extra): schedule preconditions (SCHED-*), the
+#      static peak-memory gate (MEM-*), and the program-fingerprint drift
+#      gate (DRIFT-*) against tests/golden/program_fingerprints.json.
+#      Fails on error-severity findings. Pass --no-hlo for a quick
+#      trace-only run; any other lint flag also forwards (e.g.
+#      --mem-budget-gib 8).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Regenerate the golden program-fingerprint baseline.
+
+    python scripts/regen_golden.py [--check] [--out PATH]
+
+Deterministic by construction: the fingerprint canonicalization digests
+jaxpr structure (opcode multiset, collective inventory, sharding specs,
+input avals) — no timestamps, no instruction names, no host state — and
+the mesh shapes are pinned to the audit worlds (4 and 8 virtual CPU
+devices, forced below before jax initializes). Running this twice in any
+environment with this jax version produces byte-identical output (keys
+sorted, newline-terminated), so the diff a regen produces in review is
+exactly the set of programs whose compiled structure moved.
+
+Workflow when DRIFT-001 fires:
+
+1. If the structural change is intentional (you meant to alter what a
+   program compiles to), rerun this script and commit the updated
+   baseline IN THE SAME PR — the baseline diff documents which programs
+   moved and the reviewer sees it next to the code that moved them.
+2. If it is not intentional, the gate just caught a silent refactor —
+   fix the code, not the baseline.
+
+`--check` regenerates in memory and exits 1 on any difference from the
+committed file (CI-friendly dry run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_DEVICES = 8
+
+
+def _force_cpu() -> None:
+    flag = f"--xla_force_host_platform_device_count={_DEVICES}"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = f"{xla_flags} {flag}".strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 if the committed baseline differs "
+                             "from a fresh regen (writes nothing)")
+    parser.add_argument("--out", default=None,
+                        help="write the baseline here instead of the "
+                             "default tests/golden/ location")
+    args = parser.parse_args(argv)
+
+    _force_cpu()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tpu_matmul_bench.analysis import fingerprint as fp
+
+    doc = {
+        "schema": fp.GOLDEN_SCHEMA,
+        "worlds": list(fp.FINGERPRINT_WORLDS),
+        "fingerprints": dict(sorted(
+            fp.current_fingerprints().items())),
+    }
+    blob = json.dumps(doc, sort_keys=True, indent=2) + "\n"
+    path = args.out or fp.golden_path()
+
+    if args.check:
+        try:
+            with open(path) as fh:
+                committed = fh.read()
+        except FileNotFoundError:
+            committed = None
+        if committed != blob:
+            print(f"golden baseline at {path} is stale — rerun "
+                  "scripts/regen_golden.py", file=sys.stderr)
+            return 1
+        print(f"golden baseline up to date "
+              f"({len(doc['fingerprints'])} programs)")
+        return 0
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write(blob)
+    print(f"wrote {len(doc['fingerprints'])} fingerprints to {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

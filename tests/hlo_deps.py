@@ -1,164 +1,21 @@
-"""Tiny optimized-HLO text parser + def-use reachability, for scheduling
-tests (VERDICT r1 #3: machine-checkable evidence that the overlap programs
-are *overlappable* and the baselines are *serialized*).
+"""Thin re-export shim: the HLO parser/reachability helpers now live in
+`tpu_matmul_bench.analysis.hlo_tools` (single source of truth for the
+scheduling tests AND the lint passes). Kept so historical test imports
+(`from hlo_deps import ...`) stay stable."""
 
-XLA:CPU lowers collectives synchronously (no `all-reduce-start`/`-done`
-pairs), so on the CPU mesh the checkable property is the dependency
-structure of the optimized HLO: a collective and a matmul can only be
-scheduled concurrently (by the TPU latency-hiding scheduler) if neither
-reaches the other through def-use edges. That is exactly the property a
-refactor would break by serializing the overlap path, and it is asserted
-here backend-independently.
-"""
-
-from __future__ import annotations
-
-import re
-from dataclasses import dataclass, field
-
-_QUOTED = re.compile(r'"[^"]*"')
-_COMMENT = re.compile(r"/\*.*?\*/")
-_LHS = re.compile(r"^\s*(?:ROOT\s+)?%([\w.-]+)\s*=\s*(.*)$")
-_REF = re.compile(r"%([\w.-]+)")
-_HEADER = re.compile(r"^(?:ENTRY\s+)?%?([\w.-]+)\s*(?:\(.*)?\{\s*$")
-
-MATMUL_OPS = ("dot", "dot_general", "convolution")
-
-
-@dataclass
-class Instruction:
-    name: str
-    opcode: str
-    operands: list[str]          # %refs into the same computation
-    called: list[str]            # calls=/to_apply=/body=/condition= comps
-    line: str
-
-    def is_opcode(self, *ops: str) -> bool:
-        return self.opcode in ops
-
-
-@dataclass
-class Computation:
-    name: str
-    instructions: dict[str, Instruction] = field(default_factory=dict)
-
-
-def _opcode_of(rhs: str) -> str:
-    """Opcode from an instruction's right-hand side: skip the (possibly
-    tuple) result type, take the identifier before the operand parens."""
-    rhs = rhs.strip()
-    if rhs.startswith("("):  # tuple type — skip the balanced group
-        depth = 0
-        for i, ch in enumerate(rhs):
-            depth += ch == "("
-            depth -= ch == ")"
-            if depth == 0:
-                rhs = rhs[i + 1:].strip()
-                break
-    m = re.match(r"\S+\s+([\w-]+)\(", rhs)
-    return m.group(1) if m else ""
-
-
-def parse_hlo(text: str) -> dict[str, Computation]:
-    """Parse optimized-HLO module text into computations with def-use info.
-
-    Good enough for scheduling assertions: instruction names, opcodes,
-    operand references, and called-computation references per line. String
-    literals (metadata) are stripped so quoted parens can't confuse the
-    opcode/operand scan.
-    """
-    comps: dict[str, Computation] = {}
-    cur: Computation | None = None
-    for raw in text.splitlines():
-        line = _COMMENT.sub("", _QUOTED.sub('""', raw))
-        if cur is None:
-            h = _HEADER.match(line.strip())
-            # a computation header ends in `{` and is not an instruction
-            # (`%name = ...`) — tuple-typed params may contain `(...)`
-            if h and not _LHS.match(line):
-                cur = Computation(h.group(1))
-            continue
-        if line.strip() == "}":
-            comps[cur.name] = cur
-            cur = None
-            continue
-        m = _LHS.match(line)
-        if not m:
-            continue
-        name, rhs = m.group(1), m.group(2)
-        called = re.findall(
-            r"(?:calls|to_apply|body|condition)=%([\w.-]+)", rhs)
-        # operand refs = %ids inside the first balanced paren group after
-        # the opcode; approximated as all %ids minus the called comps
-        refs = [r for r in _REF.findall(rhs) if r not in called]
-        cur.instructions[name] = Instruction(
-            name, _opcode_of(rhs), refs, called, raw.strip())
-    return comps
-
-
-def find_computations_with(comps: dict[str, Computation],
-                           opcode: str) -> list[Computation]:
-    return [c for c in comps.values()
-            if any(i.opcode == opcode for i in c.instructions.values())]
-
-
-def instructions_of(comp: Computation, *opcodes: str) -> list[Instruction]:
-    return [i for i in comp.instructions.values() if i.opcode in opcodes]
-
-
-def backward_reach(comp: Computation, start: Instruction) -> set[str]:
-    """All instruction names in `comp` reachable backwards (through operand
-    edges) from `start`, excluding `start` itself."""
-    seen: set[str] = set()
-    frontier = list(start.operands)
-    while frontier:
-        n = frontier.pop()
-        if n in seen or n not in comp.instructions:
-            continue
-        seen.add(n)
-        frontier.extend(comp.instructions[n].operands)
-    return seen
-
-
-def _fusion_contains(comps: dict[str, Computation], instr: Instruction,
-                     opcodes: tuple[str, ...]) -> bool:
-    return any(
-        any(i.opcode in opcodes for i in comps[c].instructions.values())
-        for c in instr.called if c in comps
-    )
-
-
-def reaches_opcode(comps: dict[str, Computation], comp: Computation,
-                   start: Instruction, opcodes: tuple[str, ...]) -> bool:
-    """Does `start` transitively depend (backwards) on an instruction with
-    one of `opcodes` — either directly or hidden inside a fusion it
-    consumes?"""
-    for name in backward_reach(comp, start):
-        instr = comp.instructions[name]
-        if instr.opcode in opcodes:
-            return True
-        if instr.opcode == "fusion" and _fusion_contains(comps, instr,
-                                                         opcodes):
-            return True
-    return False
-
-
-def compiled_text(fn, *operands) -> str:
-    """Optimized (post-XLA-passes) HLO of a jitted fn on these operands."""
-    return fn.lower(*operands).compile().as_text()
-
-
-_RESULT_SHAPE = re.compile(r"=\s*\(?[a-z]\w*\[([\d,]*)\]")
-
-
-def result_elems(line: str) -> int:
-    """Element count of an instruction's (first) result shape; 0 if the
-    line carries no parseable array shape. `f32[]` (scalar) counts as 1."""
-    m = _RESULT_SHAPE.search(line)
-    if not m:
-        return 0
-    n = 1
-    for d in m.group(1).split(","):
-        if d:
-            n *= int(d)
-    return n
+from tpu_matmul_bench.analysis.hlo_tools import (  # noqa: F401
+    MATMUL_OPS,
+    Computation,
+    Instruction,
+    backward_reach,
+    compiled_text,
+    entry_computation,
+    entry_name,
+    find_computations_with,
+    instructions_of,
+    parse_hlo,
+    reaches_opcode,
+    result_bytes,
+    result_elems,
+    type_str_bytes,
+)

@@ -140,15 +140,16 @@ def test_cli_lint_full_audit_exits_zero(tmp_path):
 
 
 def test_cli_spec_lint_over_shipped_specs():
-    """The spec-only path (everything else skipped) validates every
-    shipped specs/*.toml and stays fast — this is what `campaign run
-    --lint` leans on before burning device time."""
+    """The spec-only path (everything else skipped, --no-hlo covering the
+    compile-heavy pass family) validates every shipped specs/*.toml and
+    stays fast — this is what a quick pre-flight leans on before burning
+    device time."""
     specs = sorted(str(p) for p in (REPO / "specs").glob("*.toml"))
     assert specs, "shipped specs/*.toml missing"
     out = subprocess.run(
         [sys.executable, "-m", "tpu_matmul_bench", "lint",
          "--fail-on", "warn", "--skip", "modes", "impls", "donation",
-         "pallas", "registry", "--specs", *specs],
+         "pallas", "registry", "--no-hlo", "--specs", *specs],
         env=scrubbed_env(platforms="cpu", device_count=8),
         capture_output=True, text=True, timeout=300, cwd=str(REPO),
     )

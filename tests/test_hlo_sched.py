@@ -1,0 +1,259 @@
+"""Seeded-regression fixtures for the HLO pass family (SCHED/MEM/DRIFT).
+
+Each test proves a detector actually detects: feed it a program (or
+baseline) with the exact defect the rule exists for and pin the finding
+to its rule ID and severity. The clean-tree direction (`audit_hlo_sched`
+/ `audit_memory` / `audit_fingerprints` all silent on the shipped code)
+is covered by `test_lint.py::test_shipped_tree_audits_clean` and the CLI
+smoke test; this file is the other half of the contract.
+
+The compiled texts come from the same per-process caches the audits use
+(`hlo_sched.scan_variant_text` / `ring_text`), so under one pytest run
+these fixtures compile nothing the audit hasn't already paid for.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_matmul_bench.analysis import fingerprint as fp
+from tpu_matmul_bench.analysis import hlo_sched as hs
+from tpu_matmul_bench.analysis import memory_model as mm
+from tpu_matmul_bench.analysis.findings import RULES, should_fail
+
+pytestmark = pytest.mark.usefixtures("devices")
+
+
+def _rules(findings):
+    return sorted({(f.rule, f.severity) for f in findings})
+
+
+# ------------------------------------------------------------- SCHED-001
+
+def test_serialized_overlap_body_flags_sched001():
+    """THE seeded regression: a scan body whose collective consumes the
+    same step's matmul product, presented as an overlap path. The
+    no_overlap baseline's compiled text IS that defect by construction —
+    label it 'overlap' and the gate must call it fatal."""
+    text = hs.scan_variant_text("no_overlap", 4)
+    findings = hs.check_scan_variant(text, "overlap", "seeded:overlap@d4")
+    assert ("SCHED-001", "error") in _rules(findings), _rules(findings)
+    # and the defect is a hard exit under --fail-on error
+    assert should_fail(findings, "error")
+
+
+def test_deserialized_baseline_flags_sched001():
+    """The required direction: a no_overlap baseline that is NOT
+    serialized measures nothing — the overlap leg's compiled text labeled
+    'no_overlap' must trip the same rule."""
+    text = hs.scan_variant_text("overlap", 4)
+    findings = hs.check_scan_variant(text, "no_overlap",
+                                     "seeded:no_overlap@d4")
+    assert ("SCHED-001", "error") in _rules(findings)
+
+
+def test_clean_overlap_body_is_silent():
+    for variant in hs.SCAN_VARIANTS:
+        text = hs.scan_variant_text(variant, 4)
+        assert hs.check_scan_variant(text, variant, "x") == []
+
+
+# ------------------------------------------------------------- SCHED-003
+
+def test_product_carrying_hops_flag_sched003():
+    """An all-gather ring whose hops carry matmul products serializes
+    every hop behind the MXU. The reduce-scatter ring's compiled text has
+    exactly that dependency (its accumulator hops are SUPPOSED to) — feed
+    it through the AG-ring checker and SCHED-003 must fire."""
+    findings = hs.check_ag_ring(hs.ring_text("rs", 4), "seeded:ag@d4", 4)
+    assert ("SCHED-003", "error") in _rules(findings)
+
+
+def test_missing_ring_flags_sched003():
+    """The serialized gather baseline has no ppermute ring at all — the
+    ring checker must say so rather than pass vacuously."""
+    findings = hs.check_ag_ring(hs.ring_text("ag_base", 4), "seeded", 4)
+    assert ("SCHED-003", "error") in _rules(findings)
+
+
+def test_wrong_hop_count_flags_sched003():
+    """A d=8 ring audited against the d=4 contract has the wrong hop and
+    matmul counts — the ring-shape check catches a world-size mismatch."""
+    findings = hs.check_ag_ring(hs.ring_text("ag", 8), "seeded", 4)
+    assert ("SCHED-003", "error") in _rules(findings)
+
+
+def test_clean_rings_are_silent():
+    assert hs.check_ag_ring(hs.ring_text("ag", 4), "x", 4) == []
+    assert hs.check_rs_ring(hs.ring_text("rs", 4), "x", 4) == []
+    assert hs.check_serialized_baseline(
+        hs.ring_text("ag_base", 4), "x", "all-gather") == []
+    assert hs.check_serialized_baseline(
+        hs.ring_text("rs_base", 4), "x", "reduce-scatter") == []
+
+
+# ------------------------------------------------------------- SCHED-004
+
+_TORN_ASYNC = """\
+HloModule torn
+
+ENTRY %main (p0: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8] parameter(0)
+  %ar-start = f32[8,8] all-reduce-start(%p0)
+  ROOT %d = f32[8,8] dot(%p0, %p0)
+}
+"""
+
+_EMPTY_ASYNC = """\
+HloModule empty
+
+ENTRY %main (p0: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8] parameter(0)
+  %ar-start = f32[8,8] all-reduce-start(%p0)
+  %ar-done = f32[8,8] all-reduce-done(%ar-start)
+  ROOT %d = f32[8,8] dot(%ar-done, %p0)
+}
+"""
+
+
+def test_unmatched_start_flags_sched004():
+    findings = hs.check_async_pairs(_TORN_ASYNC, "seeded:torn")
+    assert _rules(findings) == [("SCHED-004", "error")]
+
+
+def test_empty_async_bracket_flags_sched004():
+    """start/done pair with no matmul between them hides nothing — the
+    overlap-body form of the check must flag it."""
+    findings = hs.check_async_pairs(_EMPTY_ASYNC, "seeded:empty",
+                                    require_bracketed_matmul=True)
+    assert _rules(findings) == [("SCHED-004", "error")]
+
+
+# ---------------------------------------------------------------- MEM-*
+
+_INFLATED = """\
+HloModule inflated
+
+ENTRY %main (p0: f32[4,4]) -> f32[4,4] {
+  %p0 = f32[4,4] parameter(0)
+  %big = f32[1024,1024] broadcast(%p0)
+  %s = f32[4,4] slice(%big)
+  ROOT %r = f32[4,4] add(%s, %p0)
+}
+"""
+
+
+def test_liveness_walk_peaks_at_inflated_buffer():
+    peak = mm.estimate_peak_bytes(_INFLATED)
+    # big (4 MiB) + p0 + s live together at the slice
+    assert peak == 1024 * 1024 * 4 + 2 * 4 * 4 * 4
+
+
+def test_inflated_buffer_flags_mem001():
+    """Seeded MEM-001: the inflated program against a 1 MiB budget."""
+    peak = mm.estimate_peak_bytes(_INFLATED)
+    findings = mm.check_budget({"inflated@d4": peak},
+                               budget_gib=1 / 1024)
+    assert _rules(findings) == [("MEM-001", "error")]
+    assert should_fail(findings, "error")
+
+
+def test_dead_buffer_does_not_inflate_peak():
+    """A value whose last use precedes a later allocation must not be
+    counted live there — i.e. the walk tracks intervals, not totals."""
+    text = _INFLATED.replace("%s = f32[4,4] slice(%big)",
+                             "%s = f32[4,4] slice(%p0)")
+    # big is now dead immediately after its def (only ROOT's operands
+    # survive): peak is big + p0 at its def point
+    assert mm.estimate_peak_bytes(text) == 1024 * 1024 * 4 + 4 * 4 * 4
+
+
+def test_underestimated_peak_flags_mem002():
+    """Seeded MEM-002: a peak estimate below the collective payload the
+    comms model requires live is self-evidently broken."""
+    import jax.numpy as jnp
+
+    findings = mm.check_comms_consistency(
+        "model_parallel", 4, 256, peak=16, dtype=jnp.bfloat16)
+    assert _rules(findings) == [("MEM-002", "warn")]
+
+
+def test_shipped_modes_fit_default_budget():
+    assert mm.check_budget(mm.peak_report(worlds=(4,)),
+                           mm.DEFAULT_BUDGET_GIB) == []
+
+
+# --------------------------------------------------------------- DRIFT-*
+
+def test_perturbed_golden_flags_drift001():
+    """Seeded DRIFT-001: flip one digest in the baseline and the gate
+    must name exactly that program, at error severity."""
+    current = {"mode:independent@d4": "aaaa", "impl:xla/bfloat16": "bbbb"}
+    golden = dict(current, **{"impl:xla/bfloat16": "ffff"})
+    findings = fp.check_drift(current, golden)
+    assert _rules(findings) == [("DRIFT-001", "error")]
+    assert findings[0].where == "fingerprint:impl:xla/bfloat16"
+    assert should_fail(findings, "error")
+
+
+def test_incomplete_and_stale_baseline_flag_drift002():
+    current = {"a": "1", "b": "2"}
+    findings = fp.check_drift(current, {"a": "1", "gone": "9"})
+    assert _rules(findings) == [("DRIFT-002", "warn")]
+    wheres = sorted(f.where for f in findings)
+    assert wheres == ["fingerprint:b", "fingerprint:gone"]
+
+
+def test_missing_baseline_flags_drift002():
+    findings = fp.check_drift({"a": "1"}, None)
+    assert _rules(findings) == [("DRIFT-002", "warn")]
+
+
+def test_matching_baseline_is_silent():
+    cur = {"a": "1", "b": "2"}
+    assert fp.check_drift(cur, dict(cur)) == []
+
+
+def test_golden_baseline_matches_tree_at_both_meshes():
+    """The committed baseline is live: regenerate fingerprints in-process
+    and require an exact match, with both audit mesh shapes represented
+    (a digest that held at d4 but drifted at d8 must not pass)."""
+    golden = fp.load_golden()
+    assert golden, "tests/golden/program_fingerprints.json missing"
+    current = fp.current_fingerprints()
+    assert any(k.endswith("@d4") for k in golden)
+    assert any(k.endswith("@d8") for k in golden)
+    assert fp.check_drift(current, golden) == []
+
+
+def test_canonical_record_is_shape_and_sharding_sensitive():
+    """The digest must move when program structure moves — multiset of
+    opcodes, payload bytes, or sharding; and must NOT depend on dict
+    ordering."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(a, b):
+        return jnp.matmul(a, b)
+
+    def g(a, b):
+        return jnp.matmul(a, b) + a
+
+    aval = jax.ShapeDtypeStruct((64, 64), jnp.bfloat16)
+    big = jax.ShapeDtypeStruct((128, 128), jnp.bfloat16)
+    d_f = fp.digest(fp.canonical_record(jax.make_jaxpr(f)(aval, aval)))
+    d_f2 = fp.digest(fp.canonical_record(jax.make_jaxpr(f)(aval, aval)))
+    d_g = fp.digest(fp.canonical_record(jax.make_jaxpr(g)(aval, aval)))
+    d_big = fp.digest(fp.canonical_record(jax.make_jaxpr(f)(big, big)))
+    assert d_f == d_f2
+    assert len({d_f, d_g, d_big}) == 3
+
+
+# ------------------------------------------------------------- catalog
+
+def test_new_rules_registered():
+    for rule, sev in (("SCHED-001", "error"), ("SCHED-002", "error"),
+                      ("SCHED-003", "error"), ("SCHED-004", "error"),
+                      ("MEM-001", "error"), ("MEM-002", "warn"),
+                      ("DRIFT-001", "error"), ("DRIFT-002", "warn")):
+        assert RULES[rule][0] == sev, rule

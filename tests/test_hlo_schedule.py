@@ -20,7 +20,7 @@ psum consume this step's product) fails these tests without any TPU.
 
 import pytest
 
-from hlo_deps import (
+from tpu_matmul_bench.analysis.hlo_tools import (
     MATMUL_OPS,
     compiled_text,
     find_computations_with,

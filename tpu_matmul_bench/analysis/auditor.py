@@ -403,23 +403,55 @@ def audit_specs(spec_paths: Iterable[str]) -> list[Finding]:
     return lint_specs(list(spec_paths))
 
 
+def _audit_sched() -> list[Finding]:
+    from tpu_matmul_bench.analysis.hlo_sched import audit_hlo_sched
+
+    return audit_hlo_sched()
+
+
+def _audit_memory(budget_gib: float | None = None) -> list[Finding]:
+    from tpu_matmul_bench.analysis.memory_model import (
+        DEFAULT_BUDGET_GIB,
+        audit_memory,
+    )
+
+    return audit_memory(budget_gib=budget_gib or DEFAULT_BUDGET_GIB)
+
+
+def _audit_fingerprint() -> list[Finding]:
+    from tpu_matmul_bench.analysis.fingerprint import audit_fingerprints
+
+    return audit_fingerprints()
+
+
 AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "modes": audit_modes,
     "impls": audit_impls,
     "donation": audit_donation,
     "pallas": audit_pallas_static,
     "registry": audit_registry,
+    "sched": _audit_sched,
+    "memory": _audit_memory,
+    "fingerprint": _audit_fingerprint,
 }
+
+#: groups that compile optimized HLO (slower than trace-only audits);
+#: `lint --no-hlo` maps to skipping exactly these
+HLO_AUDITS = ("sched", "memory", "fingerprint")
 
 
 def run_all(spec_paths: Iterable[str] = (),
-            skip: Iterable[str] = ()) -> list[Finding]:
+            skip: Iterable[str] = (),
+            mem_budget_gib: float | None = None) -> list[Finding]:
     skip_set = set(skip)
     findings: list[Finding] = []
     for name, audit in AUDITS.items():
         if name in skip_set:
             continue
-        findings.extend(audit())
+        if name == "memory":
+            findings.extend(_audit_memory(mem_budget_gib))
+        else:
+            findings.extend(audit())
     if "specs" not in skip_set:
         findings.extend(audit_specs(spec_paths))
     return findings

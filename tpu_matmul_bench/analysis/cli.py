@@ -40,7 +40,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="lint",
         description="Static contract auditor: jaxpr/HLO checks for every "
-                    "impl x mode, plus offline spec validation.")
+                    "impl x mode, plus offline spec validation.",
+        epilog="exit codes: 0 = no finding at or above --fail-on severity; "
+               "1 = at least one such finding (lint completed — read the "
+               "findings); >1 = the linter itself crashed. The HLO pass "
+               "family (sched/memory/fingerprint) compiles small programs "
+               "on the CPU mesh and adds ~20-30 s; --no-hlo skips it for "
+               "quick trace-only runs.")
     parser.add_argument("--fail-on", choices=("warn", "error"),
                         default="error",
                         help="lowest severity that fails the run "
@@ -52,8 +58,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "under the repo root)")
     parser.add_argument("--skip", nargs="*", default=(),
                         choices=("modes", "impls", "donation", "pallas",
-                                 "registry", "specs"),
+                                 "registry", "specs", "sched", "memory",
+                                 "fingerprint"),
                         help="audit groups to skip")
+    parser.add_argument("--no-hlo", action="store_true",
+                        help="skip the HLO pass family (sched + memory + "
+                             "fingerprint) — the compile-heavy groups")
+    parser.add_argument("--mem-budget-gib", type=float, default=None,
+                        help="per-device budget for the MEM-001 peak-"
+                             "memory gate (default: 16 GiB, one v5e HBM)")
     parser.add_argument("--quiet", action="store_true",
                         help="suppress per-finding lines; print the "
                              "summary only")
@@ -69,15 +82,20 @@ def main(argv: list[str] | None = None):
     args = build_parser().parse_args(argv)
     _force_cpu_backend()
 
-    from tpu_matmul_bench.analysis.auditor import run_all
+    from tpu_matmul_bench.analysis.auditor import HLO_AUDITS, run_all
     from tpu_matmul_bench.analysis.findings import (
         should_fail,
         summarize,
         write_ledger,
     )
 
+    skip = list(args.skip)
+    if args.no_hlo:
+        skip.extend(g for g in HLO_AUDITS if g not in skip)
+
     spec_paths = args.specs if args.specs is not None else _default_specs()
-    findings = run_all(spec_paths=spec_paths, skip=args.skip)
+    findings = run_all(spec_paths=spec_paths, skip=skip,
+                       mem_budget_gib=args.mem_budget_gib)
 
     if not args.quiet:
         for f in findings:
@@ -87,11 +105,18 @@ def main(argv: list[str] | None = None):
           f"{counts['info']} info")
 
     if args.json_out:
+        extra = {"fail_on": args.fail_on,
+                 "specs": [str(p) for p in spec_paths],
+                 "skipped": skip}
+        if "memory" not in skip:
+            # per-mode peak-memory column (cached — the audit already
+            # compiled these programs)
+            from tpu_matmul_bench.analysis.memory_model import peak_report
+
+            extra["peak_memory"] = peak_report()
         write_ledger(args.json_out, findings,
                      argv=list(sys.argv),
-                     extra={"fail_on": args.fail_on,
-                            "specs": [str(p) for p in spec_paths],
-                            "skipped": list(args.skip)})
+                     extra=extra)
         print(f"findings ledger written to {args.json_out}")
 
     if should_fail(findings, args.fail_on):

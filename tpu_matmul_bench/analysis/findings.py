@@ -58,6 +58,30 @@ RULES: dict[str, tuple[Severity, str]] = {
                         "measurement artifact"),
     "REG-002": ("info", "impl-registry tier extrapolated by tie policy "
                         "(no head-to-head measurement at these shapes)"),
+    "SCHED-001": ("error", "forced serialization: a collective transitively "
+                           "consumes the same step's matmul product "
+                           "(required on no_overlap baselines, fatal on "
+                           "overlap paths — no scheduler may hide it)"),
+    "SCHED-002": ("error", "matmul/collective mutual independence broken in "
+                           "an overlap body — the precondition for XLA's "
+                           "latency-hiding scheduler is gone"),
+    "SCHED-003": ("error", "ppermute-ring schedule broken: hop count or hop "
+                           "independence no longer matches the ring "
+                           "contract"),
+    "SCHED-004": ("error", "async collective start/done pairing broken in "
+                           "the optimized HLO (start without done, or no "
+                           "work scheduled between them)"),
+    "MEM-001": ("error", "estimated peak live bytes exceed the per-device "
+                         "memory budget"),
+    "MEM-002": ("warn", "peak-memory estimate inconsistent with the comms "
+                        "model's per-shard payloads (estimator or program "
+                        "shape self-check failed)"),
+    "DRIFT-001": ("error", "program fingerprint drifted from the golden "
+                           "baseline — compiled structure changed without a "
+                           "baseline regen (scripts/regen_golden.py)"),
+    "DRIFT-002": ("warn", "fingerprint baseline incomplete or stale for a "
+                          "traced program (regen "
+                          "tests/golden/program_fingerprints.json)"),
 }
 
 

@@ -1,0 +1,228 @@
+"""Program fingerprints + golden-baseline drift gate (DRIFT-*).
+
+Every traced program in the audit surface is canonicalized into a stable
+record — opcode multiset, collective inventory (kind + per-shard payload
+bytes), operand sharding signature, input shapes/dtypes — and digested to
+a short sha256. The canonical form is built from the *jaxpr*, not the
+optimized HLO text: jaxpr primitive names, aval shapes, and sharding
+specs are deterministic across processes, while HLO text carries unstable
+instruction names and metadata that would make every compile a "drift".
+
+The golden baseline (`tests/golden/program_fingerprints.json`, regenerated
+by `scripts/regen_golden.py`) pins the digest of every program at both
+audit mesh shapes. The drift gate then has three outcomes per program:
+
+- digest matches → silent;
+- digest differs → DRIFT-001 (error): the compiled structure changed —
+  either an accidental refactor (fix it) or an intentional one (regen the
+  baseline in the same PR so the reviewer sees exactly which programs
+  moved);
+- program missing from the baseline, or baseline naming a program that no
+  longer exists → DRIFT-002 (warn): the baseline is incomplete or stale.
+
+The fingerprint inventory covers: every parallelism mode × world, every
+overlap scan variant × world, every collective-matmul ring form × world,
+every matmul impl × dtype (unsharded avals), and the declared donation
+contracts (alias counts — a dead donation changes the digest).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import os
+from typing import Any
+
+import jax
+
+from tpu_matmul_bench.analysis import jaxpr_tools as jt
+from tpu_matmul_bench.analysis.findings import Finding
+
+#: repo-relative golden baseline path
+GOLDEN_RELPATH = os.path.join("tests", "golden",
+                              "program_fingerprints.json")
+
+FINGERPRINT_WORLDS = (4, 8)
+
+GOLDEN_SCHEMA = 1
+
+
+def golden_path(root: str | None = None) -> str:
+    """Absolute baseline path; `root` defaults to the repo root inferred
+    from this package's location."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    return os.path.join(root, GOLDEN_RELPATH)
+
+
+# ------------------------------------------------------- canonicalization
+
+def canonical_record(jaxpr: Any, operands: tuple = ()) -> dict[str, Any]:
+    """Stable, JSON-serializable structure summary of one traced program."""
+    ops: dict[str, int] = {}
+    for eqn in jt.iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        ops[name] = ops.get(name, 0) + 1
+    colls = [{"kind": u.kind, "payload_bytes": u.payload_bytes}
+             for u in jt.collective_inventory(jaxpr)]
+    colls.sort(key=lambda c: (c["kind"], c["payload_bytes"]))
+    shardings = []
+    for op in operands:
+        spec = getattr(getattr(op, "sharding", None), "spec", None)
+        shardings.append(str(spec) if spec is not None else "unsharded")
+    invars = jaxpr.jaxpr.invars if hasattr(jaxpr, "jaxpr") else jaxpr.invars
+    return {
+        "ops": dict(sorted(ops.items())),
+        "collectives": colls,
+        "shardings": shardings,
+        "input_shapes": [list(v.aval.shape) for v in invars],
+        "input_dtypes": [str(v.aval.dtype) for v in invars],
+    }
+
+
+def digest(record: dict[str, Any]) -> str:
+    """Short stable digest of a canonical record."""
+    blob = json.dumps(record, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _record_of(fn: Any, operands: tuple) -> dict[str, Any]:
+    return canonical_record(jax.make_jaxpr(fn)(*operands), operands)
+
+
+# ------------------------------------------------------------- inventory
+
+def program_inventory(worlds=FINGERPRINT_WORLDS) -> dict[str, dict]:
+    """Canonical records for every program in the audit surface that the
+    active backend can trace. Keys are stable program identities."""
+    import jax.numpy as jnp
+
+    from tpu_matmul_bench.analysis import hlo_sched
+    from tpu_matmul_bench.analysis.auditor import (
+        _IMPL_MATRIX,
+        AUDIT_SIZE,
+        _all_modes,
+        _audit_config,
+        _impl_fn,
+        donation_contracts,
+    )
+    from tpu_matmul_bench.parallel.mesh import make_mesh
+    from tpu_matmul_bench.parallel.overlap import overlap_mode
+
+    records: dict[str, dict] = {}
+    avail = len(jax.devices())
+    config = _audit_config("bfloat16", "xla")
+
+    for world in worlds:
+        if world > avail:
+            continue
+        mesh = make_mesh(jax.devices()[:world])
+        for mode, builder in sorted(_all_modes().items()):
+            setup = builder(config, mesh, AUDIT_SIZE)
+            fn = setup.full if setup.full is not None else setup.compute
+            records[f"mode:{mode}@d{world}"] = _record_of(fn, setup.operands)
+        for variant in hlo_sched.SCAN_VARIANTS:
+            setup = overlap_mode(config, mesh, hlo_sched.SCHED_SIZE, variant)
+            records[f"overlap:{variant}@d{world}"] = _record_of(
+                setup.full, setup.operands)
+        for kind in ("ag", "ag_bidir", "ag_base", "rs", "rs_bidir",
+                     "rs_base"):
+            rs = kind.startswith("rs")
+            _, x, w = hlo_sched._ring_operands(world, hlo_sched.SCHED_SIZE,
+                                               rs)
+            fn = _ring_builder(mesh, kind)
+            records[f"ring:{kind}@d{world}"] = _record_of(fn, (x, w))
+
+    for impl, dtype_name in list(_IMPL_MATRIX) + [
+            ("pallas_ksplit", "bfloat16"), ("pallas_ksplit", "float32")]:
+        aval = jax.ShapeDtypeStruct((64, 64), jnp.dtype(dtype_name))
+        records[f"impl:{impl}/{dtype_name}"] = _record_of(
+            _impl_fn(impl), (aval, aval))
+
+    for name, fn, avals, donate in donation_contracts():
+        records[f"donation:{name}"] = {
+            "donation_aliases": jt.donation_alias_count(
+                fn, avals, donate_argnums=donate),
+            "donate_argnums": list(donate),
+        }
+    return records
+
+
+def _ring_builder(mesh, kind: str):
+    from tpu_matmul_bench.parallel.overlap import (
+        collective_matmul_bidir_program,
+        collective_matmul_bidir_rs_program,
+        collective_matmul_program,
+        collective_matmul_rs_program,
+    )
+
+    return {
+        "ag": lambda: collective_matmul_program(mesh, overlap=True),
+        "ag_bidir": lambda: collective_matmul_bidir_program(mesh),
+        "ag_base": lambda: collective_matmul_program(mesh, overlap=False),
+        "rs": lambda: collective_matmul_rs_program(mesh, overlap=True),
+        "rs_bidir": lambda: collective_matmul_bidir_rs_program(mesh),
+        "rs_base": lambda: collective_matmul_rs_program(mesh,
+                                                        overlap=False),
+    }[kind]()
+
+
+@functools.lru_cache(maxsize=None)
+def current_fingerprints(worlds=FINGERPRINT_WORLDS) -> dict[str, str]:
+    """Digest map for the whole inventory (cached per process — the audit
+    and the tests trace the same ~40 programs; callers must not mutate)."""
+    return {key: digest(rec)
+            for key, rec in program_inventory(worlds).items()}
+
+
+# ------------------------------------------------------------ drift gate
+
+def load_golden(path: str | None = None) -> dict[str, str] | None:
+    """The baseline's fingerprint map, or None when no baseline exists."""
+    path = path or golden_path()
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        doc = json.load(fh)
+    return doc.get("fingerprints", {})
+
+
+def check_drift(current: dict[str, str],
+                golden: dict[str, str] | None) -> list[Finding]:
+    """Diff current fingerprints against the golden map (pure — seeded
+    tests feed perturbed baselines)."""
+    if golden is None:
+        return [Finding(
+            "DRIFT-002", "fingerprint:baseline",
+            f"no golden baseline at {GOLDEN_RELPATH} — run "
+            "scripts/regen_golden.py and commit the result",
+            details={"programs_traced": len(current)})]
+    findings: list[Finding] = []
+    for key in sorted(current):
+        if key not in golden:
+            findings.append(Finding(
+                "DRIFT-002", f"fingerprint:{key}",
+                "program missing from the golden baseline (regen "
+                "tests/golden/program_fingerprints.json)",
+                details={"digest": current[key]}))
+        elif golden[key] != current[key]:
+            findings.append(Finding(
+                "DRIFT-001", f"fingerprint:{key}",
+                f"fingerprint {current[key]} != golden {golden[key]} — "
+                "compiled structure changed without a baseline regen "
+                "(scripts/regen_golden.py)",
+                details={"current": current[key], "golden": golden[key]}))
+    for key in sorted(set(golden) - set(current)):
+        findings.append(Finding(
+            "DRIFT-002", f"fingerprint:{key}",
+            "baseline names a program that no longer traces (stale entry "
+            "— regen the baseline)",
+            details={"golden": golden[key]}))
+    return findings
+
+
+def audit_fingerprints(worlds=FINGERPRINT_WORLDS,
+                       path: str | None = None) -> list[Finding]:
+    return check_drift(current_fingerprints(worlds), load_golden(path))

@@ -1,0 +1,382 @@
+"""HLO-level schedule audits: certify the overlap suite's scheduling
+preconditions on the optimized HLO, no TPU required (SCHED-*).
+
+The PR-4 auditor stops at the jaxpr — it sees which collectives a program
+*contains*, never whether the XLA scheduler is *allowed* to hide them.
+This pass compiles every overlap-capable mode at a small size on the CPU
+mesh and checks the property the paper's overlap win rests on: a
+collective and a matmul can be scheduled concurrently only if neither
+reaches the other through def-use edges of the optimized HLO
+(`tests/test_hlo_schedule.py` asserts the same structurally; this pass
+makes it a lint rule with a stable ID so `lint --fail-on error` and the
+campaign pre-gate catch a serializing refactor before device time burns).
+
+Four rules:
+
+- SCHED-001 — forced serialization: the scan body's collective transitively
+  consumes the same step's matmul product. REQUIRED on the `no_overlap`
+  baseline (that dependency is what makes it a baseline); an ERROR on
+  overlap paths (no scheduler may hide a collective that waits on the
+  product it follows).
+- SCHED-002 — mutual independence: in `overlap`/`pipeline` bodies the
+  matmul must not depend on the step's collective either (and must not
+  have been hoisted out of the body) — the precondition for XLA's
+  latency-hiding scheduler to run them concurrently.
+- SCHED-003 — ppermute-ring contract: hop count per ring step, hop
+  independence from matmul products on all-gather rings (hops stream raw
+  chunks), matmul independence from hops on reduce-scatter rings (the MXU
+  never stalls on ICI), and the serialized gather/scatter baselines
+  keeping their collective on the matmul's dependency path.
+- SCHED-004 — async start/done pairing where the backend emits it (the
+  TPU latency-hiding scheduler's `-start`/`-done` split): every start
+  needs its done, and the overlap body must schedule a matmul between
+  them. XLA:CPU lowers collectives synchronously, so this rule is
+  typically silent on the lint mesh — it exists for TPU-side HLO dumps
+  fed through the same checkers.
+
+The Pallas ring modes (`pallas_ring*`) are deliberately NOT audited here:
+their schedule is hand-written inside one kernel (RDMA double-buffering),
+so XLA's scheduler preconditions do not apply, and their CPU lowering is
+an interpreter artifact with no scheduling structure to inspect.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from tpu_matmul_bench.analysis import hlo_tools as ht
+from tpu_matmul_bench.analysis.findings import Finding
+
+# same small problem as tests/test_hlo_schedule.py: the dependency
+# structure is size-invariant, so compile the cheapest size that shards
+SCHED_SIZE = 64
+# two worlds so hop/matmul counts (which scale with d) are checked at two
+# ring lengths, same cross-check discipline as the collective inventory
+SCHED_WORLDS = (4, 8)
+
+
+def _cfg():
+    from tpu_matmul_bench.analysis.auditor import _audit_config
+
+    return _audit_config("bfloat16", "xla")
+
+
+def _mesh(world: int):
+    from tpu_matmul_bench.parallel.mesh import make_mesh
+
+    return make_mesh(jax.devices()[:world])
+
+
+@functools.lru_cache(maxsize=None)
+def scan_variant_text(variant: str, world: int,
+                      size: int = SCHED_SIZE) -> str:
+    """Optimized HLO of one overlap-suite scan variant (compiled once per
+    process; the tests and every pass share this cache)."""
+    from tpu_matmul_bench.parallel.overlap import overlap_mode
+
+    setup = overlap_mode(_cfg(), _mesh(world), size, variant)
+    return ht.compiled_text(setup.full, *setup.operands)
+
+
+def _ring_operands(world: int, size: int, rs: bool):
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_matmul_bench.parallel.mesh import sharded_normal
+
+    cfg = _cfg()
+    mesh = _mesh(world)
+    x_spec, w_spec = (P(None, "x"), P("x", None)) if rs \
+        else (P("x", None), P(None, "x"))
+    (x,) = sharded_normal(cfg.seed, (size, size), cfg.dtype, mesh, x_spec,
+                          count=1)
+    (w,) = sharded_normal(cfg.seed + 1, (size, size), cfg.dtype, mesh,
+                          w_spec, count=1)
+    return mesh, x, w
+
+
+@functools.lru_cache(maxsize=None)
+def ring_text(kind: str, world: int, size: int = SCHED_SIZE) -> str:
+    """Optimized HLO of one collective-matmul ring program. `kind` is one
+    of ag / ag_bidir / ag_base / rs / rs_bidir / rs_base."""
+    from tpu_matmul_bench.parallel.overlap import (
+        collective_matmul_bidir_program,
+        collective_matmul_bidir_rs_program,
+        collective_matmul_program,
+        collective_matmul_rs_program,
+    )
+
+    rs = kind.startswith("rs")
+    mesh, x, w = _ring_operands(world, size, rs)
+    builders = {
+        "ag": lambda: collective_matmul_program(mesh, overlap=True),
+        "ag_bidir": lambda: collective_matmul_bidir_program(mesh),
+        "ag_base": lambda: collective_matmul_program(mesh, overlap=False),
+        "rs": lambda: collective_matmul_rs_program(mesh, overlap=True),
+        "rs_bidir": lambda: collective_matmul_bidir_rs_program(mesh),
+        "rs_base": lambda: collective_matmul_rs_program(mesh, overlap=False),
+    }
+    return ht.compiled_text(builders[kind](), x, w)
+
+
+# --------------------------------------------------------------- checkers
+# Pure functions over HLO text → findings, so seeded-regression fixtures
+# (tests/test_hlo_sched.py) can feed mutated programs straight in.
+
+def check_scan_variant(text: str, variant: str, where: str) -> list[Finding]:
+    """SCHED-001/-002/-004 for one {no_overlap, overlap, pipeline} scan
+    program's optimized HLO."""
+    comps = ht.parse_hlo(text)
+    bodies = ht.find_computations_with(comps, "all-reduce")
+    if len(bodies) != 1:
+        return [Finding(
+            "SCHED-002", where,
+            f"expected exactly one scan body holding the all-reduce, found "
+            f"{len(bodies)} — the step structure the overlap claim rests on "
+            "is gone",
+            details={"bodies": sorted(b.name for b in bodies)})]
+    body = bodies[0]
+    findings: list[Finding] = []
+    ars = ht.instructions_of(body, "all-reduce")
+    serialized = any(ht.reaches_opcode(comps, body, ar, ht.MATMUL_OPS)
+                     for ar in ars)
+    if variant == "no_overlap":
+        if not serialized:
+            findings.append(Finding(
+                "SCHED-001", where,
+                "baseline no longer serialized: the all-reduce does not "
+                "consume the step's matmul product, so the scheduler may "
+                "overlap them and the no_overlap leg measures nothing",
+                details={"variant": variant}))
+        return findings
+    if serialized:
+        findings.append(Finding(
+            "SCHED-001", where,
+            "overlap path serialized: the collective transitively consumes "
+            "the same step's matmul product — no scheduler may hide it",
+            details={"variant": variant}))
+    dots = ht.instructions_of(body, *ht.MATMUL_OPS)
+    if not dots:
+        findings.append(Finding(
+            "SCHED-002", where,
+            "matmul missing from the scan body (hoisted?) — nothing left "
+            "to hide the collective behind",
+            details={"variant": variant}))
+    elif any(ht.reaches_opcode(comps, body, dot, ("all-reduce",))
+             for dot in dots):
+        findings.append(Finding(
+            "SCHED-002", where,
+            "the matmul depends on the step's all-reduce — mutual "
+            "independence (the latency-hiding precondition) is broken",
+            details={"variant": variant}))
+    findings.extend(check_async_pairs(text, where,
+                                      require_bracketed_matmul=True))
+    return findings
+
+
+def _ring_comp(text: str, where: str):
+    comps = ht.parse_hlo(text)
+    cands = ht.find_computations_with(comps, "collective-permute")
+    if len(cands) != 1:
+        return comps, None, [Finding(
+            "SCHED-003", where,
+            f"expected exactly one computation holding the ppermute ring, "
+            f"found {len(cands)}",
+            details={"candidates": sorted(c.name for c in cands)})]
+    return comps, cands[0], []
+
+
+def check_ag_ring(text: str, where: str, world: int,
+                  bidir: bool = False) -> list[Finding]:
+    """SCHED-003 for an all-gather ring: hops stream raw operand chunks
+    (never products) and at least one matmul (the resident chunk's) waits
+    on no hop at all."""
+    comps, comp, findings = _ring_comp(text, where)
+    if comp is None:
+        return findings
+    perms = ht.instructions_of(comp, "collective-permute")
+    dots = ht.instructions_of(comp, *ht.MATMUL_OPS)
+    exp_perms = (2 if bidir else 1) * (world - 1)
+    exp_dots = 2 * world - 1 if bidir else world
+    if len(perms) != exp_perms or len(dots) != exp_dots:
+        findings.append(Finding(
+            "SCHED-003", where,
+            f"ring shape mismatch: {len(perms)} hops / {len(dots)} matmuls "
+            f"(expected {exp_perms} / {exp_dots} at d={world})",
+            details={"hops": len(perms), "matmuls": len(dots),
+                     "expected_hops": exp_perms,
+                     "expected_matmuls": exp_dots}))
+    for p in perms:
+        if ht.reaches_opcode(comps, comp, p, ht.MATMUL_OPS):
+            findings.append(Finding(
+                "SCHED-003", where,
+                "an all-gather ring hop depends on a matmul product — the "
+                "ring no longer streams raw chunks, so every hop waits on "
+                "the MXU",
+                details={"hop": p.name}))
+    if dots and not any(
+            not ht.reaches_opcode(comps, comp, dt, ("collective-permute",))
+            for dt in dots):
+        findings.append(Finding(
+            "SCHED-003", where,
+            "every matmul waits on a hop — the resident-chunk overlap "
+            "(the t=0 matmul that needs no transfer) is gone",
+            details={"matmuls": len(dots)}))
+    findings.extend(check_async_pairs(text, where))
+    return findings
+
+
+def check_rs_ring(text: str, where: str, world: int,
+                  bidir: bool = False) -> list[Finding]:
+    """SCHED-003 for a reduce-scatter ring: the accumulator hops DO carry
+    products, but no matmul may ever wait on a hop (each step's product
+    comes from the local shard, so the MXU never stalls on ICI)."""
+    comps, comp, findings = _ring_comp(text, where)
+    if comp is None:
+        return findings
+    perms = ht.instructions_of(comp, "collective-permute")
+    dots = ht.instructions_of(comp, *ht.MATMUL_OPS)
+    exp_perms = (2 if bidir else 1) * (world - 1)
+    exp_dots = 2 * world if bidir else world
+    if len(perms) != exp_perms or len(dots) != exp_dots:
+        findings.append(Finding(
+            "SCHED-003", where,
+            f"ring shape mismatch: {len(perms)} hops / {len(dots)} matmuls "
+            f"(expected {exp_perms} / {exp_dots} at d={world})",
+            details={"hops": len(perms), "matmuls": len(dots),
+                     "expected_hops": exp_perms,
+                     "expected_matmuls": exp_dots}))
+    for dt in dots:
+        if ht.reaches_opcode(comps, comp, dt, ("collective-permute",)):
+            findings.append(Finding(
+                "SCHED-003", where,
+                "a matmul depends on a ring hop — the reduce-scatter "
+                "overlap has been serialized (the MXU stalls on ICI)",
+                details={"matmul": dt.name}))
+    findings.extend(check_async_pairs(text, where))
+    return findings
+
+
+def check_serialized_baseline(text: str, where: str,
+                              collective_op: str) -> list[Finding]:
+    """SCHED-001 (required direction) for the gather/scatter baselines:
+    the collective must sit on the matmul's dependency path (all-gather
+    feeding the matmul) or consume its product (reduce-scatter)."""
+    comps = ht.parse_hlo(text)
+    cands = ht.find_computations_with(comps, collective_op)
+    if len(cands) != 1:
+        return [Finding(
+            "SCHED-001", where,
+            f"expected exactly one computation holding the baseline "
+            f"{collective_op}, found {len(cands)}",
+            details={"collective": collective_op,
+                     "candidates": sorted(c.name for c in cands)})]
+    comp = cands[0]
+    findings: list[Finding] = []
+    if collective_op == "all-gather":
+        dots = ht.instructions_of(comp, *ht.MATMUL_OPS)
+        if not dots or not all(
+                ht.reaches_opcode(comps, comp, dt, (collective_op,))
+                for dt in dots):
+            findings.append(Finding(
+                "SCHED-001", where,
+                "baseline matmul no longer consumes the gathered operand — "
+                "the serialized gather-then-matmul baseline is broken",
+                details={"collective": collective_op}))
+    else:
+        for coll in ht.instructions_of(comp, collective_op):
+            if not ht.reaches_opcode(comps, comp, coll, ht.MATMUL_OPS):
+                findings.append(Finding(
+                    "SCHED-001", where,
+                    f"baseline {collective_op} no longer consumes the "
+                    "partial product — the serialized baseline is broken",
+                    details={"collective": collective_op,
+                             "instr": coll.name}))
+    return findings
+
+
+def check_async_pairs(text: str, where: str,
+                      require_bracketed_matmul: bool = False
+                      ) -> list[Finding]:
+    """SCHED-004 where the backend emits async collective pairs: every
+    `<op>-start` needs a matching `<op>-done`, and (on overlap bodies)
+    a matmul must be scheduled between the first pair."""
+    findings: list[Finding] = []
+    clean = ht._QUOTED.sub('""', text)
+    any_starts = False
+    for stem in ht.ASYNC_COLLECTIVE_STEMS:
+        starts = clean.count(f"{stem}-start(")
+        dones = clean.count(f"{stem}-done(")
+        if starts or dones:
+            any_starts = any_starts or starts
+            if starts != dones:
+                findings.append(Finding(
+                    "SCHED-004", where,
+                    f"{starts} {stem}-start vs {dones} {stem}-done — the "
+                    "async pair the latency-hiding scheduler created is "
+                    "torn",
+                    details={"op": stem, "starts": starts, "dones": dones}))
+    if require_bracketed_matmul and any_starts and not findings:
+        lines = clean.splitlines()
+        start = next(i for i, ln in enumerate(lines)
+                     if "all-reduce-start(" in ln or "-start(" in ln)
+        done = next((i for i, ln in enumerate(lines[start + 1:], start + 1)
+                     if "-done(" in ln), len(lines))
+        if not any(any(f" {op}(" in ln for op in ht.MATMUL_OPS)
+                   for ln in lines[start + 1:done]):
+            findings.append(Finding(
+                "SCHED-004", where,
+                "no matmul scheduled between the collective's start and "
+                "done — the async pair hides nothing",
+                details={"start_line": start, "done_line": done}))
+    return findings
+
+
+# ------------------------------------------------------------------ audit
+
+SCAN_VARIANTS = ("no_overlap", "overlap", "pipeline")
+
+_RING_CHECKS = (
+    # (kind, checker, kwargs)
+    ("ag", check_ag_ring, {}),
+    ("ag_bidir", check_ag_ring, {"bidir": True}),
+    ("rs", check_rs_ring, {}),
+    ("rs_bidir", check_rs_ring, {"bidir": True}),
+)
+
+_BASELINE_CHECKS = (
+    ("ag_base", "all-gather"),
+    ("rs_base", "reduce-scatter"),
+)
+
+
+def audit_hlo_sched(worlds=SCHED_WORLDS,
+                    size: int = SCHED_SIZE) -> list[Finding]:
+    """Compile and audit every overlap-capable mode at every world size:
+    scan variants, AG/RS rings (uni + bidir), and the serialized
+    baselines. Pure structure — nothing is executed beyond the one-time
+    ring prologue fill."""
+    findings: list[Finding] = []
+    avail = len(jax.devices())
+    for world in worlds:
+        if world > avail:
+            findings.append(Finding(
+                "SCHED-002", f"mesh:d{world}",
+                f"cannot audit world={world}: only {avail} devices (run "
+                "under XLA_FLAGS=--xla_force_host_platform_device_count)",
+                severity="warn", details={"available": avail}))
+            continue
+        for variant in SCAN_VARIANTS:
+            findings.extend(check_scan_variant(
+                scan_variant_text(variant, world, size), variant,
+                f"sched:{variant}@d{world}"))
+        for kind, checker, kw in _RING_CHECKS:
+            findings.extend(checker(
+                ring_text(kind, world, size),
+                f"sched:{kind}@d{world}", world, **kw))
+        for kind, coll in _BASELINE_CHECKS:
+            findings.extend(check_serialized_baseline(
+                ring_text(kind, world, size),
+                f"sched:{kind}@d{world}", coll))
+    return findings

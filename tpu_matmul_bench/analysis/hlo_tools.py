@@ -1,0 +1,248 @@
+"""Optimized-HLO text parser + def-use reachability + shape accounting.
+
+Promoted from `tests/hlo_deps.py` (which now re-exports from here) so the
+lint passes (`analysis/hlo_sched.py`, `analysis/memory_model.py`) and the
+scheduling tests share ONE parser. XLA:CPU lowers collectives
+synchronously (no `all-reduce-start`/`-done` pairs), so on the CPU mesh
+the checkable property is the dependency structure of the optimized HLO:
+a collective and a matmul can only be scheduled concurrently (by the TPU
+latency-hiding scheduler) if neither reaches the other through def-use
+edges. That is exactly the property a refactor would break by serializing
+the overlap path, and it is checkable backend-independently.
+
+The parser is deliberately small: instruction names, opcodes, operand
+references, called computations, and result types per line — enough for
+reachability walks and byte accounting, nothing more.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+_QUOTED = re.compile(r'"[^"]*"')
+_COMMENT = re.compile(r"/\*.*?\*/")
+_LHS = re.compile(r"^\s*(?:ROOT\s+)?%([\w.-]+)\s*=\s*(.*)$")
+_REF = re.compile(r"%([\w.-]+)")
+_HEADER = re.compile(r"^(?:ENTRY\s+)?%?([\w.-]+)\s*(?:\(.*)?\{\s*$")
+_ENTRY_HEADER = re.compile(r"^ENTRY\s+%?([\w.-]+)", re.MULTILINE)
+
+MATMUL_OPS = ("dot", "dot_general", "convolution")
+
+#: async collective opcode stems the TPU latency-hiding scheduler splits
+#: into `<stem>-start` / `<stem>-done` pairs
+ASYNC_COLLECTIVE_STEMS = ("all-reduce", "all-gather", "reduce-scatter",
+                          "collective-permute", "all-to-all")
+
+
+@dataclass
+class Instruction:
+    name: str
+    opcode: str
+    operands: list[str]          # %refs into the same computation
+    called: list[str]            # calls=/to_apply=/body=/condition= comps
+    line: str
+
+    def is_opcode(self, *ops: str) -> bool:
+        return self.opcode in ops
+
+
+@dataclass
+class Computation:
+    name: str
+    instructions: dict[str, Instruction] = field(default_factory=dict)
+
+
+def _opcode_of(rhs: str) -> str:
+    """Opcode from an instruction's right-hand side: skip the (possibly
+    tuple) result type, take the identifier before the operand parens."""
+    rhs = rhs.strip()
+    if rhs.startswith("("):  # tuple type — skip the balanced group
+        depth = 0
+        for i, ch in enumerate(rhs):
+            depth += ch == "("
+            depth -= ch == ")"
+            if depth == 0:
+                rhs = rhs[i + 1:].strip()
+                break
+    m = re.match(r"\S+\s+([\w-]+)\(", rhs)
+    return m.group(1) if m else ""
+
+
+def parse_hlo(text: str) -> dict[str, Computation]:
+    """Parse optimized-HLO module text into computations with def-use info.
+
+    Good enough for scheduling assertions: instruction names, opcodes,
+    operand references, and called-computation references per line. String
+    literals (metadata) are stripped so quoted parens can't confuse the
+    opcode/operand scan. Instruction dicts preserve program order (the
+    liveness walk in `memory_model` depends on it).
+    """
+    comps: dict[str, Computation] = {}
+    cur: Computation | None = None
+    for raw in text.splitlines():
+        line = _COMMENT.sub("", _QUOTED.sub('""', raw))
+        if cur is None:
+            h = _HEADER.match(line.strip())
+            # a computation header ends in `{` and is not an instruction
+            # (`%name = ...`) — tuple-typed params may contain `(...)`
+            if h and not _LHS.match(line):
+                cur = Computation(h.group(1))
+            continue
+        if line.strip() == "}":
+            comps[cur.name] = cur
+            cur = None
+            continue
+        m = _LHS.match(line)
+        if not m:
+            continue
+        name, rhs = m.group(1), m.group(2)
+        called = re.findall(
+            r"(?:calls|to_apply|body|condition)=%([\w.-]+)", rhs)
+        # operand refs = %ids inside the first balanced paren group after
+        # the opcode; approximated as all %ids minus the called comps
+        refs = [r for r in _REF.findall(rhs) if r not in called]
+        cur.instructions[name] = Instruction(
+            name, _opcode_of(rhs), refs, called, raw.strip())
+    return comps
+
+
+def entry_name(text: str) -> str | None:
+    """Name of the module's ENTRY computation, or None if absent."""
+    m = _ENTRY_HEADER.search(text)
+    return m.group(1) if m else None
+
+
+def entry_computation(text: str,
+                      comps: dict[str, Computation] | None = None
+                      ) -> Computation | None:
+    comps = comps if comps is not None else parse_hlo(text)
+    name = entry_name(text)
+    return comps.get(name) if name else None
+
+
+def find_computations_with(comps: dict[str, Computation],
+                           opcode: str) -> list[Computation]:
+    return [c for c in comps.values()
+            if any(i.opcode == opcode for i in c.instructions.values())]
+
+
+def instructions_of(comp: Computation, *opcodes: str) -> list[Instruction]:
+    return [i for i in comp.instructions.values() if i.opcode in opcodes]
+
+
+def backward_reach(comp: Computation, start: Instruction) -> set[str]:
+    """All instruction names in `comp` reachable backwards (through operand
+    edges) from `start`, excluding `start` itself."""
+    seen: set[str] = set()
+    frontier = list(start.operands)
+    while frontier:
+        n = frontier.pop()
+        if n in seen or n not in comp.instructions:
+            continue
+        seen.add(n)
+        frontier.extend(comp.instructions[n].operands)
+    return seen
+
+
+def _fusion_contains(comps: dict[str, Computation], instr: Instruction,
+                     opcodes: tuple[str, ...]) -> bool:
+    return any(
+        any(i.opcode in opcodes for i in comps[c].instructions.values())
+        for c in instr.called if c in comps
+    )
+
+
+def reaches_opcode(comps: dict[str, Computation], comp: Computation,
+                   start: Instruction, opcodes: tuple[str, ...]) -> bool:
+    """Does `start` transitively depend (backwards) on an instruction with
+    one of `opcodes` — either directly or hidden inside a fusion it
+    consumes?"""
+    for name in backward_reach(comp, start):
+        instr = comp.instructions[name]
+        if instr.opcode in opcodes:
+            return True
+        if instr.opcode == "fusion" and _fusion_contains(comps, instr,
+                                                         opcodes):
+            return True
+    return False
+
+
+def compiled_text(fn, *operands) -> str:
+    """Optimized (post-XLA-passes) HLO of a jitted fn on these operands."""
+    return fn.lower(*operands).compile().as_text()
+
+
+_RESULT_SHAPE = re.compile(r"=\s*\(?[a-z]\w*\[([\d,]*)\]")
+
+
+def result_elems(line: str) -> int:
+    """Element count of an instruction's (first) result shape; 0 if the
+    line carries no parseable array shape. `f32[]` (scalar) counts as 1."""
+    m = _RESULT_SHAPE.search(line)
+    if not m:
+        return 0
+    n = 1
+    for d in m.group(1).split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+# ------------------------------------------------------------- byte sizes
+
+_TYPE_TOKEN = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _dtype_bytes(token: str) -> float:
+    """Bytes per element for an HLO dtype token. The bit width is the
+    trailing digit run (`f32`→4, `bf16`→2, `s8`→1); `pred` is 1 byte,
+    `f8e4m3fn`-style tokens parse via their leading 8. Sub-byte ints
+    (s4/u4) count a conservative full byte."""
+    if token == "pred":
+        return 1.0
+    m = re.match(r"[a-z]+?(\d+)", token)
+    if not m:
+        return 1.0
+    bits = int(m.group(1))
+    return max(bits, 8) / 8.0
+
+
+def type_str_bytes(type_str: str) -> int:
+    """Total bytes of every array shape in an HLO type string — a single
+    `f32[256,256]{1,0}` or a tuple `(bf16[64,64], s32[])`. Layout braces
+    after the shape are ignored; token-only types (`token[]` never parses)
+    count 0."""
+    total = 0.0
+    for dtype_tok, dims in _TYPE_TOKEN.findall(type_str):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _dtype_bytes(dtype_tok)
+    return int(total)
+
+
+def result_type_region(rhs: str) -> str:
+    """The result-type region of an instruction's right-hand side: the
+    leading balanced paren group for tuple types, else the first token."""
+    rhs = rhs.strip()
+    if rhs.startswith("("):
+        depth = 0
+        for i, ch in enumerate(rhs):
+            depth += ch == "("
+            depth -= ch == ")"
+            if depth == 0:
+                return rhs[:i + 1]
+        return rhs
+    parts = rhs.split(None, 1)
+    return parts[0] if parts else ""
+
+
+def result_bytes(instr: Instruction) -> int:
+    """Bytes of an instruction's full result (tuples summed) parsed from
+    its source line; 0 when the line carries no array type."""
+    m = _LHS.match(_QUOTED.sub('""', instr.line))
+    if not m:
+        return 0
+    return type_str_bytes(result_type_region(m.group(2)))

@@ -1,0 +1,169 @@
+"""Static peak-memory estimator over optimized HLO (MEM-*).
+
+Estimates peak live bytes per impl × mode from the compiled (per-shard —
+SPMD lowering already splits shapes across the mesh) HLO: walk the entry
+computation in program order, give every definition a def→last-use live
+interval, and take the max running sum of result bytes. Parameters are
+live from their declaration; the ROOT value stays live to the end; a
+definition with no user (post-DCE this is rare) is live only at its def
+point. Fusion-internal temporaries are invisible to this model — they are
+register/scratch-sized by construction, which is exactly why XLA fused
+them — so the estimate tracks the buffers that actually occupy HBM.
+
+Two rules:
+
+- MEM-001 (error) — the estimated peak for some mode exceeds the
+  per-device budget (``--mem-budget-gib``, default 16 GiB, one v5e HBM).
+  At the lint problem size nothing real comes close; the rule exists so a
+  refactor that accidentally materializes an unsharded operand (d× the
+  bytes) or doubles a carry trips the gate, and so campaigns can set the
+  budget to the target device.
+- MEM-002 (warn) — self-check against the analytic comms model: every
+  collective's per-shard payload must be ≤ the peak estimate (the payload
+  buffer is live while the collective runs). A violation means the
+  estimator or the program shape is wrong — either way the MEM-001 verdict
+  is untrustworthy and says so out loud.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from tpu_matmul_bench.analysis import hlo_tools as ht
+from tpu_matmul_bench.analysis.comms_model import expected_collectives
+from tpu_matmul_bench.analysis.findings import Finding
+
+#: default per-device budget: one TPU v5e HBM
+DEFAULT_BUDGET_GIB = 16.0
+
+#: modes audited — the xla-impl mode matrix; pallas_ring* modes lower
+#: through the interpreter on CPU and their HLO buffers are artifacts
+MEM_WORLDS = (4, 8)
+
+
+def estimate_peak_bytes(text: str) -> int:
+    """Peak live bytes of the module's entry computation under an analytic
+    def→last-use liveness walk in program order."""
+    comps = ht.parse_hlo(text)
+    entry = ht.entry_computation(text, comps)
+    if entry is None:
+        return 0
+    order = list(entry.instructions.values())  # parse preserves order
+    index = {i.name: n for n, i in enumerate(order)}
+    last_use = {i.name: n for n, i in enumerate(order)}  # def point itself
+    for n, instr in enumerate(order):
+        for ref in instr.operands:
+            if ref in last_use:
+                last_use[ref] = max(last_use[ref], n)
+    if order:
+        last_use[order[-1].name] = len(order) - 1  # ROOT lives to the end
+    # sweep: +bytes at def, -bytes after last use
+    delta = [0] * (len(order) + 1)
+    for instr in order:
+        b = ht.result_bytes(instr)
+        if not b:
+            continue
+        delta[index[instr.name]] += b
+        delta[last_use[instr.name] + 1] -= b
+    peak = live = 0
+    for d in delta:
+        live += d
+        peak = max(peak, live)
+    return peak
+
+
+def _audit_setup(mode: str, world: int, size: int):
+    from tpu_matmul_bench.analysis.auditor import _all_modes, _audit_config
+    from tpu_matmul_bench.parallel.mesh import make_mesh
+
+    config = _audit_config("bfloat16", "xla")
+    mesh = make_mesh(jax.devices()[:world])
+    return config, _all_modes()[mode](config, mesh, size)
+
+
+@functools.lru_cache(maxsize=None)
+def mode_peak_bytes(mode: str, world: int, size: int) -> int:
+    """Compile one mode's full program and estimate its per-shard peak
+    (cached per process; the CLI reuses this for the ledger manifest)."""
+    _, setup = _audit_setup(mode, world, size)
+    fn = setup.full if setup.full is not None else setup.compute
+    return estimate_peak_bytes(ht.compiled_text(fn, *setup.operands))
+
+
+def peak_report(worlds=MEM_WORLDS, size: int | None = None
+                ) -> dict[str, int]:
+    """``{"mode@d{world}": peak_bytes}`` for every auditable mode/world —
+    the per-mode peak-memory column the findings-ledger manifest carries."""
+    from tpu_matmul_bench.analysis.auditor import AUDIT_SIZE, _all_modes
+
+    size = size or AUDIT_SIZE
+    avail = len(jax.devices())
+    return {
+        f"{mode}@d{world}": mode_peak_bytes(mode, world, size)
+        for world in worlds if world <= avail
+        for mode in sorted(_all_modes())
+    }
+
+
+def check_budget(peaks: dict[str, int], budget_gib: float,
+                 ) -> list[Finding]:
+    """MEM-001 over a peak report (pure — seeded tests feed fake peaks)."""
+    budget = int(budget_gib * 2**30)
+    return [
+        Finding(
+            "MEM-001", f"mem:{key}",
+            f"estimated peak {peak / 2**30:.3f} GiB exceeds the "
+            f"{budget_gib:g} GiB per-device budget",
+            details={"peak_bytes": peak, "budget_bytes": budget})
+        for key, peak in sorted(peaks.items()) if peak > budget
+    ]
+
+
+def check_comms_consistency(mode: str, world: int, size: int,
+                            peak: int, dtype) -> list[Finding]:
+    """MEM-002: every expected collective payload must fit under the peak
+    estimate (the payload buffer is live while the collective runs)."""
+    findings = []
+    for exp in expected_collectives(mode, world, size, dtype):
+        if exp.payload_bytes > peak:
+            findings.append(Finding(
+                "MEM-002", f"mem:{mode}@d{world}",
+                f"peak estimate {peak} B is below the {exp.kind} payload "
+                f"{exp.payload_bytes} B the comms model requires live — "
+                "the estimator or the program shape is wrong",
+                details={"peak_bytes": peak, "kind": exp.kind,
+                         "payload_bytes": exp.payload_bytes}))
+    return findings
+
+
+def audit_memory(worlds=MEM_WORLDS, size: int | None = None,
+                 budget_gib: float = DEFAULT_BUDGET_GIB) -> list[Finding]:
+    """Estimate every mode × world peak, gate against the budget, and
+    self-check against the comms model."""
+    from tpu_matmul_bench.analysis.auditor import (
+        AUDIT_SIZE,
+        _all_modes,
+        _audit_config,
+    )
+
+    size = size or AUDIT_SIZE
+    config = _audit_config("bfloat16", "xla")
+    findings: list[Finding] = []
+    avail = len(jax.devices())
+    for world in worlds:
+        if world > avail:
+            findings.append(Finding(
+                "MEM-002", f"mesh:d{world}",
+                f"cannot audit world={world}: only {avail} devices (run "
+                "under XLA_FLAGS=--xla_force_host_platform_device_count)",
+                details={"available": avail}))
+            continue
+        for mode in sorted(_all_modes()):
+            peak = mode_peak_bytes(mode, world, size)
+            findings.extend(check_budget(
+                {f"{mode}@d{world}": peak}, budget_gib))
+            findings.extend(check_comms_consistency(
+                mode, world, size, peak, config.dtype))
+    return findings

@@ -29,6 +29,21 @@ _SWEEP_KEYS = {"id_prefix", "program", "flags", "timeout_s", "retries",
 # axis and therefore needs size % num_devices == 0
 _DIVISIBILITY_MODES = {"matrix_parallel", "model_parallel"}
 
+# serve-CLI flag vocabulary, mirroring serve/cli.py — an unknown flag
+# crashes the job at spawn time, possibly hours into the campaign
+_SERVE_SUBCOMMANDS = ("bench", "selftest")
+_SERVE_COMMON_FLAGS = {
+    "--mix", "--dtype", "--grid", "--window-ms", "--max-depth",
+    "--max-batch", "--cache-capacity", "--matmul-impl", "--seed",
+    "--device", "--num-devices", "--json-out", "--append", "--trace-out",
+}
+_SERVE_BENCH_FLAGS = {"--qps", "--duration", "--concurrency", "--prewarm"}
+_SERVE_BOOL_FLAGS = {"--prewarm", "--append"}
+# flags whose value must be a strictly positive number
+_SERVE_POSITIVE_FLAGS = {"--qps", "--duration", "--concurrency",
+                         "--window-ms", "--max-depth", "--max-batch",
+                         "--cache-capacity"}
+
 
 def _flag_values(argv: list[str], flag: str) -> list[str]:
     """Values following `flag` up to the next option, commas split."""
@@ -42,6 +57,117 @@ def _flag_values(argv: list[str], flag: str) -> list[str]:
             break
         out.extend(t for t in tok.split(",") if t)
     return out
+
+
+def _serve_flag_items(argv: list[str]) -> tuple[list[tuple[str, str | None]],
+                                                list[str]]:
+    """(flag, value) pairs + stray positional tokens from a serve job's
+    argv tail (after the subcommand). Handles --flag=value and the
+    store_true flags; an unknown flag is assumed to take a value."""
+    items: list[tuple[str, str | None]] = []
+    strays: list[str] = []
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if not tok.startswith("--"):
+            strays.append(tok)
+            i += 1
+            continue
+        flag, eq, inline = tok.partition("=")
+        if eq:
+            items.append((flag, inline))
+            i += 1
+        elif flag in _SERVE_BOOL_FLAGS:
+            items.append((flag, None))
+            i += 1
+        else:
+            val = argv[i + 1] if i + 1 < len(argv) \
+                and not argv[i + 1].startswith("--") else None
+            items.append((flag, val))
+            i += 2 if val is not None else 1
+    return items, strays
+
+
+def _lint_serve_job(job: Any, where: str) -> list[Finding]:
+    """The serve analog of the round.toml job checks: subcommand + flag
+    vocabulary (SPEC-002), mix/grid/load validity (SPEC-001), and a
+    padding-grid coverage warning (SPEC-003)."""
+    from tpu_matmul_bench.serve.loadgen import parse_mix
+    from tpu_matmul_bench.serve.queue import DEFAULT_GRID
+
+    argv = list(job.argv)
+    if not argv or argv[0] not in _SERVE_SUBCOMMANDS:
+        return [Finding(
+            "SPEC-001", where,
+            f"serve job must start with a subcommand "
+            f"{_SERVE_SUBCOMMANDS}, got {argv[:1] or '[]'}",
+            details={"argv": argv})]
+    sub = argv[0]
+    known = _SERVE_COMMON_FLAGS | (_SERVE_BENCH_FLAGS if sub == "bench"
+                                   else set())
+    findings: list[Finding] = []
+    items, strays = _serve_flag_items(argv[1:])
+    for tok in strays:
+        findings.append(Finding(
+            "SPEC-001", where,
+            f"stray positional token {tok!r} in serve {sub} flags",
+            details={"token": tok}))
+    values: dict[str, str | None] = {}
+    for flag, val in items:
+        if flag not in known:
+            findings.append(Finding(
+                "SPEC-002", where,
+                f"unknown serve {sub} flag {flag!r} (the job would crash "
+                "at spawn time)",
+                details={"flag": flag, "known": sorted(known)}))
+            continue
+        values[flag] = val
+
+    mix = values.get("--mix")
+    mix_entries = ()
+    if mix is not None:
+        try:
+            mix_entries = parse_mix(mix)
+        except ValueError as e:
+            findings.append(Finding(
+                "SPEC-001", where, f"bad --mix: {e}",
+                details={"mix": mix}))
+    grid = tuple(DEFAULT_GRID)
+    if values.get("--grid") is not None:
+        try:
+            grid = tuple(int(g) for g in values["--grid"].split(",") if g)
+            if not grid or any(g < 1 for g in grid):
+                raise ValueError(f"grid needs positive points, got {grid!r}")
+        except ValueError as e:
+            findings.append(Finding(
+                "SPEC-001", where, f"bad --grid: {e}",
+                details={"grid": values["--grid"]}))
+            grid = tuple(DEFAULT_GRID)
+    for flag in sorted(_SERVE_POSITIVE_FLAGS & set(values)):
+        try:
+            num = float(values[flag])
+        except (TypeError, ValueError):
+            num = -1.0
+        if num <= 0:
+            findings.append(Finding(
+                "SPEC-001", where,
+                f"{flag} must be a positive number, got {values[flag]!r}",
+                details={"flag": flag, "value": values[flag]}))
+    # coverage analog of the mesh-divisibility warn: a mix dim above the
+    # grid top compiles an off-grid executable per shape (cache churn and
+    # padding waste the grid was supposed to bound)
+    top = max(grid)
+    for entry in mix_entries:
+        dims = (entry.m, entry.k, entry.n)
+        over = [d for d in dims if d > top]
+        if over:
+            findings.append(Finding(
+                "SPEC-003", where,
+                f"mix shape {'x'.join(str(d) for d in dims)} exceeds the "
+                f"padding-grid top {top} — each such shape compiles its "
+                "own off-grid executable",
+                details={"dims": list(dims), "grid_top": top}))
+    return findings
 
 
 def _unknown_key_findings(data: dict[str, Any], where: str) -> list[Finding]:
@@ -112,6 +238,11 @@ def lint_spec_file(path: str | Path) -> list[Finding]:
                 f"{prior!r} — identical program+argv, one resume slot",
                 details={"fingerprint": job.fingerprint,
                          "jobs": [prior, job.job_id]}))
+
+    # serve jobs: subcommand + flag vocabulary + mix/grid/load validation
+    for job in spec.jobs:
+        if job.program == "serve":
+            findings.extend(_lint_serve_job(job, f"{where}:{job.job_id}"))
 
     # mesh divisibility: sharding modes need size % num_devices == 0
     for job in spec.jobs:

@@ -50,7 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="run the static contract auditor over the spec "
                           "and the code before executing; abort on any "
                           "error-severity finding (CPU subprocess — the "
-                          "campaign parent stays backend-free)")
+                          "campaign parent stays backend-free; exit 1 on "
+                          "a failed gate, before any job runs)")
+    run.add_argument("--no-hlo", action="store_true",
+                     help="with --lint: skip the compile-heavy HLO pass "
+                          "family (schedule/memory/fingerprint audits) "
+                          "in the pre-campaign gate")
 
     res = sub.add_parser("resume", help="finish an interrupted campaign")
     res.add_argument("campaign_dir")
@@ -82,20 +87,23 @@ def _load_spec_or_exit(path: str):
         raise SystemExit(f"campaign: bad spec: {e}")
 
 
-def _pre_campaign_lint(spec_path: str) -> None:
+def _pre_campaign_lint(spec_path: str, no_hlo: bool = False) -> None:
     """The --lint gate: audit the spec + code in a CPU child process
     before any job burns device time. A subprocess keeps the campaign
     parent backend-free (the executor's children must be able to claim
-    the TPU)."""
+    the TPU). HLO passes (schedule/memory/fingerprint) run by default —
+    a campaign is exactly when catching a serialized overlap path or a
+    fingerprint drift is cheapest — with --no-hlo as the escape hatch."""
     import os
     import subprocess
     import sys
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    proc = subprocess.run(
-        [sys.executable, "-m", "tpu_matmul_bench", "lint",
-         "--fail-on", "error", "--specs", spec_path],
-        env=env)
+    cmd = [sys.executable, "-m", "tpu_matmul_bench", "lint",
+           "--fail-on", "error", "--specs", spec_path]
+    if no_hlo:
+        cmd.append("--no-hlo")
+    proc = subprocess.run(cmd, env=env)
     if proc.returncode:
         raise SystemExit("campaign: lint gate failed (run `python -m "
                          "tpu_matmul_bench lint` for details)")
@@ -103,7 +111,7 @@ def _pre_campaign_lint(spec_path: str) -> None:
 
 def _cmd_run(args: argparse.Namespace) -> int:
     if getattr(args, "lint", False):
-        _pre_campaign_lint(args.spec)
+        _pre_campaign_lint(args.spec, no_hlo=getattr(args, "no_hlo", False))
     spec = _load_spec_or_exit(args.spec)
     if args.dry_run:
         for job in spec.jobs:

@@ -140,6 +140,54 @@ def _digest_lint(recs: list[dict],
             print(f"  {key:<24} {peak / 2**20:>10.2f}")
 
 
+def _digest_tune(recs: list[dict]) -> None:
+    """Tuning-DB digest (measurements/tune_db.jsonl): one line per cell
+    — fingerprint, problem, routed impl, winner tiling, provenance kind
+    + artifact — with last-wins dedupe matching tune/db.py's load. The
+    staleness column is best-effort standalone: a cell written under a
+    different jax than the one importable here is flagged jax-stale;
+    program-digest drift needs a trace, so that half of the staleness
+    story stays with `tune selftest` / lint's TUNE-002."""
+    try:
+        import jax
+        jax_now = jax.__version__
+    except Exception:
+        jax_now = None
+    cells: dict[tuple, dict] = {}
+    for r in recs:
+        if r.get("record_type") != "tune_cell":
+            continue
+        prob = r.get("problem") or {}
+        key = (r.get("device_kind"), prob.get("dtype"), prob.get("m"),
+               prob.get("k"), prob.get("n"))
+        cells[key] = r  # append-only file: the last record per key wins
+    by_kind: dict[str, int] = {}
+    stale = 0
+    print(f"  {'fingerprint':<16} {'problem':>22} {'impl':>6} "
+          f"{'blocks':>14} {'prov':>8}  artifact")
+    for key, r in sorted(cells.items(),
+                         key=lambda kv: (str(kv[0][1]), kv[0][2] or 0)):
+        prob = r.get("problem") or {}
+        prov = r.get("provenance") or {}
+        by_kind[str(prov.get("kind"))] = by_kind.get(str(prov.get("kind")), 0) + 1
+        blocks = r.get("blocks")
+        blk = "x".join(str(b) for b in blocks) if blocks else "-"
+        shape = f"{prob.get('m')}x{prob.get('k')}x{prob.get('n')}"
+        tf = f" {r.get('tflops'):.1f}" if r.get("tflops") else ""
+        flag = ""
+        if jax_now and r.get("jax_version") and r["jax_version"] != jax_now:
+            flag = f" [jax-stale: {r['jax_version']} → {jax_now}]"
+            stale += 1
+        print(f"  {str(r.get('fingerprint')):<16} "
+              f"{shape + '/' + str(prob.get('dtype')):>22} "
+              f"{str(r.get('impl')):>6} {blk:>14} "
+              f"{str(prov.get('kind')):>8}  {prov.get('artifact')}{tf}{flag}")
+    bits = ", ".join(f"{n} {k}" for k, n in sorted(by_kind.items()))
+    print(f"  total: {len(cells)} cells ({bits})"
+          + (f", {stale} jax-stale" if stale else "")
+          + ("" if jax_now else " [no jax importable: staleness unchecked]"))
+
+
 def _is_campaign_dir(p: Path) -> bool:
     return (p / _JOURNAL).exists() or (p / _JOBS_SUBDIR).is_dir()
 
@@ -252,6 +300,9 @@ def main(paths: list[str]) -> None:
         if any(r.get("record_type") in ("lint_finding", "lint_summary")
                for r in recs):
             _digest_lint(recs, manifests)
+            continue
+        if any(r.get("record_type") == "tune_cell" for r in recs):
+            _digest_tune(recs)
             continue
         recs.sort(key=_rank_key)
         for r in recs:

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Two layers, cheapest first:
+# Three layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -16,6 +16,11 @@
 #      Fails on error-severity findings. Pass --no-hlo for a quick
 #      trace-only run; any other lint flag also forwards (e.g.
 #      --mem-budget-gib 8).
+#   3. python -m tpu_matmul_bench tune selftest — validates the committed
+#      tuning DB (measurements/tune_db.jsonl): cell schema + provenance
+#      (every cell cites a live artifact), plus a program-digest drift
+#      recompute under the CI jax. Fails when the DB is torn, cites dead
+#      artifacts, or went stale (fix: scripts/regen_tune_db.py).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -28,3 +33,6 @@ fi
 
 echo "== bench lint (static contract audit) =="
 JAX_PLATFORMS=cpu python -m tpu_matmul_bench lint --fail-on error "$@"
+
+echo "== tune selftest (tuning-DB schema + provenance + drift) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench tune selftest

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Regenerate the committed tuning DB from the baked fallback table.
+
+    python scripts/regen_tune_db.py [--check] [--out PATH]
+
+Seeds `measurements/tune_db.jsonl` with one cell per audited registry
+point (auditor._REGISTRY_* squares + rects × bfloat16/int8/float32 on
+the v5e token; float16 shares the bfloat16 cells via canonical_dtype):
+the r4-measured table tiers become ``measured`` cells keeping their
+ledger citations, and the formerly artifact-less tiers — the REG-002
+bf16 [1k,4k) band and the small-shape XLA defaults — become explicit
+``analytic`` cells naming their prior. Program digests are recomputed
+at write time under the current jax, so a regen after a jax upgrade is
+exactly how the DRIFT-style staleness (TUNE-002) gets cleared.
+
+Cell payloads are deterministic for a given jax version; `created_at`
+timestamps are not, so `--check` compares everything EXCEPT timestamps
+and exits 1 on any semantic difference from the committed file.
+
+Workflow when TUNE-002 fires on seeded cells (jax upgrade, kernel
+refactor): if the change is intentional, rerun this script and commit
+the DB diff in the same PR; measured re-promotions from real sweeps
+(`tune promote`) always supersede these seeds — the DB is append-only
+and the last record per key wins.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _force_cpu() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _semantic(rec: dict) -> dict:
+    rec = dict(rec)
+    rec.pop("created_at", None)
+    return rec
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", action="store_true",
+                        help="compare against the committed DB (ignoring "
+                             "timestamps) and exit 1 on any difference")
+    parser.add_argument("--out", default=None,
+                        help="write somewhere other than the committed "
+                             "measurements/tune_db.jsonl")
+    args = parser.parse_args(argv)
+    _force_cpu()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from tpu_matmul_bench.tune.db import TuningDB, default_path
+    from tpu_matmul_bench.tune.promote import seed_cells_from_table
+
+    path = args.out or default_path()
+    cells = seed_cells_from_table()
+
+    if args.check:
+        committed = TuningDB.load(path)
+        fresh = TuningDB(path=path)
+        want = {}
+        for cell in cells:
+            cell = fresh._complete(cell)
+            want[cell.key] = _semantic(cell.to_record())
+        got = {c.key: _semantic(c.to_record()) for c in committed.cells()}
+        diffs = []
+        for key in sorted(set(want) | set(got)):
+            if want.get(key) != got.get(key):
+                diffs.append(key)
+        if committed.parse_errors:
+            diffs.extend(("parse", e) for e in committed.parse_errors)
+        if diffs:
+            print(f"tune DB out of date ({len(diffs)} cell(s) differ): "
+                  "rerun scripts/regen_tune_db.py and commit the diff")
+            for d in diffs:
+                print(f"  {d}")
+            return 1
+        print(f"tune DB up to date: {len(got)} cells in {path}")
+        return 0
+
+    tmp = path + ".regen"
+    if os.path.exists(tmp):
+        os.unlink(tmp)
+    db = TuningDB(path=tmp)
+    for cell in cells:
+        db.put(cell)
+    os.replace(tmp, path)
+    print(f"wrote {len(cells)} cells to {path}")
+    for cell in db.cells():
+        blocks = "x".join(str(b) for b in cell.blocks) if cell.blocks else "-"
+        print(f"  {cell.fingerprint}  {cell.dtype:>8} "
+              f"{cell.m}x{cell.k}x{cell.n} → {cell.impl} "
+              f"[{cell.provenance_kind}] blocks={blocks}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,6 +114,10 @@ def test_cli_serve_selftest_validates_its_own_ledger():
     s = records[0]["extras"]["serve"]
     assert s["requests"] > 0 and s["p50_ms"] <= s["p99_ms"]
     assert s["cache"]["misses"] == 1  # one mix entry → one executable
+    # the single miss is the warm-start preload, so no served request
+    # paid a cold compile (the AOT warm-start guarantee)
+    assert s["cache"]["preload"]["count"] == 1
+    assert s["cold_requests"] == 0
 
 
 def test_cli_lint_full_audit_exits_zero(tmp_path):

@@ -90,6 +90,23 @@ def test_cache_compiles_once_then_hits():
     assert out.shape == (8, 8) and float(out[0, 0]) == 8.0
 
 
+def test_cache_warm_start_preloads_missing_only():
+    cache = ExecutableCache(_build, capacity=4)
+    k1 = ExecKey(8, 8, 8, "float32", "xla")
+    k2 = ExecKey(16, 16, 16, "float32", "xla")
+    # duplicates collapse; each compile is a counted miss, never a hit
+    assert cache.warm_start([k1, k2, k1]) == 2
+    assert (cache.hits, cache.misses) == (0, 2)
+    # already-resident keys are skipped without touching the counters
+    assert cache.warm_start([k1, k2]) == 0
+    assert (cache.hits, cache.misses) == (0, 2)
+    st = cache.stats()
+    assert st["preload"]["count"] == 2
+    assert st["preload"]["total_ms"] >= 0
+    # a post-preload request is a pure warm hit
+    assert cache.get(k1).hits == 1 and cache.hits == 1
+
+
 def test_cache_lru_evicts_oldest_not_recently_used():
     cache = ExecutableCache(_build, capacity=2)
     k1, k2, k3 = (ExecKey(8, 8, 8, "float32", f"i{i}") for i in range(3))

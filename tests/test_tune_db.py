@@ -1,0 +1,394 @@
+"""Autotuning-DB tests: cell persistence round-trips (persist → reload →
+identical routing through `impl_select`), cost-model prune monotonicity
+(the kept set always contains the measured table winner on winner-
+augmented candidate pools), DRIFT-style staleness (a bumped program
+digest stales exactly the matching cell), promotion's bake_rows parity
+(tie gate, structural exclusion), and in-process CLI smokes for all five
+`tune` subcommands.
+"""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from tpu_matmul_bench.ops.impl_select import select_impl, table_select
+from tpu_matmul_bench.ops.pallas_matmul import (
+    _RECT_V5E_ROWS,
+    _V5E_ROWS,
+    effective_blocks,
+)
+from tpu_matmul_bench.tune import cli as tune_cli
+from tpu_matmul_bench.tune import promote as promote_mod
+from tpu_matmul_bench.tune.db import (
+    Cell,
+    TuningDB,
+    canonical_dtype,
+    kind_token,
+    problem_fingerprint,
+)
+from tpu_matmul_bench.tune.prune import DEFAULT_TOP_K, prune
+
+V5E = "TPU v5e"
+
+
+def _cell(m=512, k=1024, n=2048, dtype="bfloat16", impl="pallas",
+          blocks=(256, 256, 256), kind="measured",
+          artifact="measurements/r4/tune_int8_16k_b.jsonl", **kw):
+    return Cell(m=m, k=k, n=n, dtype=dtype, device_kind=kind_token(V5E),
+                impl=impl, provenance_kind=kind, artifact=artifact,
+                blocks=blocks, **kw)
+
+
+# ------------------------------------------------------------ round-trip
+
+def test_db_roundtrip_reloads_identical_routing(tmp_path):
+    """persist → reload → the same non-cube problem routes to the same
+    cell through select_impl (pins the (m, n, k) ↔ (m, k, n) seam)."""
+    path = str(tmp_path / "db.jsonl")
+    db = TuningDB(path=path)
+    put = db.put(_cell())
+    assert put.jax_version and put.program_digest and put.created_at
+
+    reloaded = TuningDB.load(path)
+    assert len(reloaded) == 1 and not reloaded.parse_errors
+    got = reloaded.lookup(512, 1024, 2048, "bfloat16", V5E)
+    assert got == put  # frozen dataclass equality: every field survives
+
+    # routing speaks (m, n, k): A[512,1024]·B[1024,2048] → C[512,2048]
+    before = select_impl(512, 2048, 1024, V5E, jnp.bfloat16, db=db)
+    after = select_impl(512, 2048, 1024, V5E, jnp.bfloat16, db=reloaded)
+    assert before == after
+    assert after.source == "db" and after.impl == "pallas"
+    assert after.blocks == (256, 256, 256)
+    assert put.fingerprint in after.provenance
+    # the transposed question is a different fingerprint → table fallback
+    assert select_impl(1024, 2048, 512, V5E, jnp.bfloat16,
+                       db=reloaded).source == "table"
+
+
+def test_db_append_is_last_wins_and_torn_line_tolerant(tmp_path):
+    path = str(tmp_path / "db.jsonl")
+    db = TuningDB(path=path)
+    db.put(_cell(blocks=(256, 256, 256)))
+    db.put(_cell(blocks=(512, 512, 512)))  # supersedes, never rewrites
+    with open(path, "a") as fh:
+        fh.write('{"record_type": "tune_cell", "torn...')
+    reloaded = TuningDB.load(path)
+    assert reloaded.records_read == 2
+    assert len(reloaded) == 1
+    assert reloaded.lookup(512, 1024, 2048, "bfloat16",
+                           "TPU v5 lite").blocks == (512, 512, 512)
+    assert reloaded.parse_errors == ["line 3: unparseable"]
+
+
+def test_db_rejects_fingerprint_mismatch(tmp_path):
+    path = str(tmp_path / "db.jsonl")
+    db = TuningDB(path=path)
+    db.put(_cell())
+    rec = json.loads(open(path).read().splitlines()[0])
+    rec["fingerprint"] = "0" * 16  # tampered identity
+    with open(path, "w") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    reloaded = TuningDB.load(path)
+    assert len(reloaded) == 0
+    assert any("fingerprint" in e for e in reloaded.parse_errors)
+
+
+def test_cell_provenance_is_mandatory():
+    with pytest.raises(ValueError, match="artifact is mandatory"):
+        _cell(artifact="")
+    with pytest.raises(ValueError, match="provenance kind"):
+        _cell(kind="vibes")
+
+
+def test_validate_flags_dead_artifacts_and_missing_blocks(tmp_path):
+    db = TuningDB(path=str(tmp_path / "db.jsonl"))
+    db.put(_cell(artifact="measurements/r999/never_measured.jsonl"))
+    db.put(_cell(dtype="float32", blocks=None))  # pallas without blocks
+    problems = db.validate()
+    assert any("does not exist" in p for p in problems)
+    assert any("without blocks" in p for p in problems)
+    # the committed store must be clean (the tune selftest CI bar)
+    assert TuningDB.load().validate() == []
+
+
+# ------------------------------------------------- prune: winner safety
+
+def _winner_fixtures():
+    """(m, k, n, dtype, winner_blocks) for every measured v5e table row —
+    squares from the min-dim table, rects from the aspect-aware rows."""
+    fixtures = []
+    for dtype, rows in _V5E_ROWS.items():
+        for size, blocks in rows:
+            fixtures.append((size, size, size, dtype, blocks))
+    for dtype, rows in _RECT_V5E_ROWS.items():
+        for axis, ratio, min_other, blocks in rows:
+            other = 2048
+            if axis == "n":
+                m, k, n = other * 2, other, ratio * other  # wide-N
+            else:
+                m, k, n = ratio * other, other, other * 2  # tall-M
+            fixtures.append((m, k, n, dtype, blocks))
+    return fixtures
+
+
+@pytest.mark.parametrize("m,k,n,dtype,winner", _winner_fixtures())
+def test_prune_never_drops_the_measured_winner(m, k, n, dtype, winner):
+    """Monotonicity bar: on a pool containing the measured winner, the
+    top-K kept set must contain it — a prune that could drop a real
+    winner would be a negative-value model. (The int8 deep-K winners and
+    the tall-M rect winner are NOT in DEFAULT_CANDIDATES — they came
+    from --block-k extension sweeps — so the pool is winner-augmented,
+    exactly how specs/tune.toml builds its candidate lists.)"""
+    from tpu_matmul_bench.benchmarks.pallas_tune import DEFAULT_CANDIDATES
+
+    pool = list(DEFAULT_CANDIDATES) + [winner]
+    report = prune(m, k, n, dtype, pool, top_k=DEFAULT_TOP_K)
+    eff_winner = effective_blocks(m, n, k, *winner)
+    assert eff_winner in report.kept, (
+        f"pruned the measured winner {winner} (effective {eff_winner}) "
+        f"for {m}x{k}x{n}/{dtype}; kept {report.kept}")
+    assert report.trials_after <= report.trials_before
+    assert report.trials_after <= DEFAULT_TOP_K
+
+
+def test_prune_shrinks_the_default_grid_and_logs_it():
+    report = prune(8192, 8192, 8192, "bfloat16")
+    assert report.trials_before == 16  # the full default grid
+    assert report.trials_after == DEFAULT_TOP_K
+    assert report.reduction_pct == 50.0
+    lines = report.log_lines()
+    assert "16 candidates → 8 measured trials (-50.0%)" in lines[0]
+    assert len(report.dropped_ranked) == 8
+
+
+def test_prune_infeasible_candidates_sink_with_vmem_reason():
+    # an uncampable 8k³ tile set blows the VMEM cap and must be dropped
+    report = prune(16384, 16384, 16384, "float32",
+                   [(8192, 8192, 8192), (512, 512, 512)])
+    assert report.kept == [(512, 512, 512)]
+    assert len(report.dropped_infeasible) == 1
+    assert "VMEM" in report.dropped_infeasible[0].reason
+
+
+def test_prune_ring_ranks_the_chunk_problem():
+    report = prune(16384, 16384, 16384, "bfloat16",
+                   ring="pallas_ring_bidir_hbm", world=8)
+    # bidir AG ring at d=8: chunk is (16384/8/2) x 16384 x (16384/8)
+    assert (report.m, report.k, report.n) == (1024, 16384, 2048)
+    assert report.wire["collective"] == "all_gather"
+    assert report.wire["wire_bytes"] > 0
+    assert any("ring" in line for line in report.log_lines())
+
+
+# ------------------------------------------------- staleness (DRIFT-ish)
+
+def test_bumped_digest_stales_exactly_the_matching_cell(tmp_path):
+    db = TuningDB(path=str(tmp_path / "db.jsonl"))
+    a = db.put(_cell(m=512, k=1024, n=2048))
+    b = db.put(_cell(m=2048, k=1024, n=512, dtype="float32",
+                     impl="xla", blocks=None))
+    digests = {a.key: a.program_digest, b.key: b.program_digest}
+    assert db.stale_cells(digests=digests) == []
+
+    digests[a.key] = "f" * 16  # the routed program's structure "changed"
+    stale = db.stale_cells(digests=digests)
+    assert [c.key for c, _ in stale] == [a.key]
+    assert "DRIFT-style" in stale[0][1][0]
+
+    # the jax-version axis is independent of the digest axis
+    reasons = db.stale_reasons(b, jax_version="999.0", digests=digests)
+    assert len(reasons) == 1 and "999.0" in reasons[0]
+
+
+def test_committed_db_matches_regen_and_is_fresh():
+    """The shipped measurements/tune_db.jsonl must regen-check clean
+    (scripts/regen_tune_db.py --check) and carry current digests —
+    otherwise lint TUNE-002 fires on every run."""
+    db = TuningDB.load()
+    assert len(db) > 0, "committed tuning DB is empty"
+    cells = db.cells()
+    # every audited registry point resolves to a cell (REG-002 retired)
+    assert {(c.dtype, c.m, c.k, c.n) for c in cells} >= {
+        ("bfloat16", 1024, 1024, 1024),  # the ex-tie band
+        ("bfloat16", 2048, 2048, 2048),
+        ("int8", 16384, 16384, 16384),
+    }
+    for cell in cells:
+        assert cell.provenance_kind in ("measured", "analytic")
+        assert "tie" not in cell.provenance_str.lower()
+    # analytic cells name their prior; measured cells cite ledgers
+    for cell in cells:
+        if cell.provenance_kind == "analytic":
+            assert "prior" in cell.detail
+        else:
+            assert "measurements/" in cell.artifact
+
+
+# ------------------------------------------------------------ promotion
+
+def _tune_rec(tflops, bm, bn, bk, size=4096, dtype="bfloat16", **extras):
+    return {"benchmark": "tune", "mode": "tune_none", "size": size,
+            "dtype": dtype, "tflops_total": tflops,
+            "extras": {"block_m": bm, "block_n": bn, "block_k": bk,
+                       **extras}}
+
+
+def _write_ledger(path, recs):
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return str(path)
+
+
+def test_promote_writes_winner_cell_with_ledger_citation(tmp_path):
+    ledger = _write_ledger(tmp_path / "sweep.jsonl", [
+        _tune_rec(100.0, 1024, 2048, 512),
+        _tune_rec(90.0, 512, 512, 512),
+    ])
+    db = TuningDB(path=str(tmp_path / "db.jsonl"))
+    result = promote_mod.promote([ledger], db, device_kind=V5E)
+    assert result["skipped"] == []
+    (cell,) = result["promoted"]
+    assert (cell.m, cell.k, cell.n) == (4096, 4096, 4096)
+    assert cell.impl == "pallas" and cell.blocks == (1024, 2048, 512)
+    assert cell.provenance_kind == "measured" and cell.artifact == ledger
+    assert cell.tflops == 100.0
+    # the promoted cell routes immediately through the reloaded store
+    got = TuningDB.load(db.path).lookup(4096, 4096, 4096, "bfloat16", V5E)
+    assert got.blocks == (1024, 2048, 512)
+
+
+def test_promote_applies_bake_rows_discipline(tmp_path):
+    tie = _write_ledger(tmp_path / "tie.jsonl", [
+        _tune_rec(100.0, 1024, 2048, 512),
+        _tune_rec(99.5, 512, 512, 512),  # 0.5% < the 1% tie gate
+    ])
+    structural = _write_ledger(tmp_path / "structural.jsonl", [
+        _tune_rec(100.0, 1024, 2048, 512, size=8192, grid_order="nmk"),
+        _tune_rec(80.0, 512, 512, 512, size=8192),
+    ])
+    confirm = _write_ledger(tmp_path / "confirm.jsonl", [
+        # raw sweep says candidate A, the interleaved confirm says B —
+        # confirm records are authoritative
+        _tune_rec(120.0, 1024, 2048, 512, size=16384),
+        _tune_rec(100.0, 2048, 2048, 512, size=16384, confirm_pass=True),
+        _tune_rec(90.0, 1024, 2048, 512, size=16384, confirm_pass=True),
+    ])
+    db = TuningDB(path=str(tmp_path / "db.jsonl"))
+    result = promote_mod.promote([tie, structural, confirm], db,
+                                 device_kind=V5E)
+    assert len(result["skipped"]) == 2
+    assert any("tie" in s or "margin" in s for s in result["skipped"])
+    assert any("structural" in s for s in result["skipped"])
+    (cell,) = result["promoted"]
+    assert cell.m == 16384 and cell.blocks == (2048, 2048, 512)
+
+
+def test_seed_cells_cover_the_registry_and_cite_evidence():
+    cells = promote_mod.seed_cells_from_table()
+    # squares x 3 dtypes + rects x 3 dtypes (float16 shares bf16 cells)
+    assert len(cells) == (len(promote_mod.SEED_SIZES)
+                          + len(promote_mod.SEED_RECTS)) * 3
+    for cell in cells:
+        choice = table_select(cell.m, cell.n, cell.k, V5E,
+                              jnp.dtype(cell.dtype))
+        assert cell.impl == choice.impl  # seeding never rewrites routing
+
+
+# ------------------------------------------------------------ CLI smokes
+
+def test_cli_show_and_prune_smoke(capsys):
+    assert tune_cli.main(["show"]) == 0
+    out = capsys.readouterr().out
+    assert "live cells" in out and "stale under jax" in out
+
+    assert tune_cli.main(["prune", "--size", "8192", "--dtype", "int8",
+                          "--emit-flags"]) == 0
+    out = capsys.readouterr().out
+    assert "16 candidates → 8 measured trials" in out
+    assert "--block-m" in out
+
+
+def test_cli_selftest_smoke(capsys):
+    assert tune_cli.main(["selftest", "--no-drift"]) == 0
+    assert "tune selftest ok" in capsys.readouterr().out
+
+
+def test_cli_selftest_fails_on_dead_artifact(tmp_path, capsys):
+    db = TuningDB(path=str(tmp_path / "db.jsonl"))
+    db.put(_cell(artifact="measurements/r999/never_measured.jsonl"))
+    with pytest.raises(SystemExit):
+        tune_cli.main(["selftest", "--db", db.path, "--no-drift"])
+    assert "FAILED" in capsys.readouterr().out
+
+
+def test_cli_promote_smoke(tmp_path, capsys):
+    ledger = _write_ledger(tmp_path / "sweep.jsonl", [
+        _tune_rec(100.0, 1024, 2048, 512),
+        _tune_rec(90.0, 512, 512, 512),
+    ])
+    dbp = str(tmp_path / "db.jsonl")
+    assert tune_cli.main(["promote", ledger, "--db", dbp]) == 0
+    out = capsys.readouterr().out
+    assert "1 promoted" in out
+    # nothing promotable (all ties) → exit 1
+    tie = _write_ledger(tmp_path / "tie.jsonl", [
+        _tune_rec(100.0, 1024, 2048, 512, size=8192),
+        _tune_rec(99.9, 512, 512, 512, size=8192),
+    ])
+    with pytest.raises(SystemExit):
+        tune_cli.main(["promote", tie, "--db", dbp])
+
+
+def test_cli_fill_dry_run_plans_without_measuring(tmp_path, capsys):
+    assert tune_cli.main(["fill", "--dir", str(tmp_path / "fill"),
+                          "--dry-run"]) == 0
+    out = capsys.readouterr().out
+    assert "bf16_16k" in out  # the spec's job plan was printed
+    assert not (tmp_path / "fill" / "jobs").exists()  # nothing measured
+
+
+def test_cli_flag_style_falls_through_to_the_tuner():
+    # argv[0] not a subcommand → benchmarks/pallas_tune (--help proves
+    # the fall-through without spending a sweep)
+    with pytest.raises(SystemExit) as exc:
+        tune_cli.main(["--help"])
+    assert exc.value.code == 0
+
+
+# ----------------------------------------------------- lint integration
+
+def test_audit_tune_clean_on_committed_db_and_reg002_retired():
+    from tpu_matmul_bench.analysis import auditor
+
+    assert auditor.audit_tune() == []
+    rules = [f.rule for f in auditor.audit_registry()]
+    assert "REG-002" not in rules  # the tie band now has a cell
+
+
+def test_audit_tune_seeded_findings(tmp_path):
+    from tpu_matmul_bench.analysis import auditor
+
+    # a DB whose one cell went stale → TUNE-002 (warn) on its route
+    db = TuningDB(path=str(tmp_path / "db.jsonl"))
+    db.put(_cell(m=4096, k=4096, n=4096, blocks=(1024, 2048, 512),
+                 jax_version="0.0.1"))
+    findings = auditor.audit_tune(db)
+    tune2 = [f for f in findings if f.rule == "TUNE-002"]
+    assert len(tune2) == 1 and tune2[0].severity == "warn"
+    assert "0.0.1" in tune2[0].message
+    # with NO cells, the artifact-less xla fallback tiers (sub-1024
+    # dispatch-bound, fp32-below-4096) are the only TUNE-001 hits — the
+    # committed DB's analytic cells are precisely what retires them
+    empty = TuningDB(path=str(tmp_path / "empty.jsonl"))
+    tune1 = auditor.audit_tune(empty)
+    assert [f.rule for f in tune1] == ["TUNE-001", "TUNE-001"]
+    joined = " ".join(f.message for f in tune1)
+    assert "sub-1024" in joined and "fp32" in joined
+
+
+def test_problem_fingerprint_canonicalizes_dtype():
+    assert problem_fingerprint(64, 64, 64, "float16") == \
+        problem_fingerprint(64, 64, 64, "bfloat16")
+    assert canonical_dtype(jnp.float16) == "bfloat16"
+    assert kind_token("TPU v5 lite") == kind_token("TPU v5e") == "v5e"

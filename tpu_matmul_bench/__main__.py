@@ -16,7 +16,10 @@ _PROGRAMS = {
     "distributed": "tpu_matmul_bench.benchmarks.matmul_distributed_benchmark",
     "overlap": "tpu_matmul_bench.benchmarks.matmul_overlap_benchmark",
     "collectives": "tpu_matmul_bench.benchmarks.collective_benchmark",
-    "tune": "tpu_matmul_bench.benchmarks.pallas_tune",
+    # the autotuning front end: DB subcommands (show/prune/fill/promote/
+    # selftest, tune/cli.py); flag-style invocations fall through to the
+    # measurement sweep in benchmarks/pallas_tune.py unchanged
+    "tune": "tpu_matmul_bench.tune.cli",
     "curve": "tpu_matmul_bench.benchmarks.scaling_curve",
     "membw": "tpu_matmul_bench.benchmarks.membw_benchmark",
     "hybrid": "tpu_matmul_bench.benchmarks.matmul_hybrid_benchmark",

@@ -390,10 +390,65 @@ def audit_registry() -> list[Finding]:
             if "tie" in choice.provenance.lower():
                 findings.append(Finding(
                     "REG-002", where,
-                    "tie-policy tier (no head-to-head at these shapes): "
+                    "tie-policy tier with no tuning-DB cell behind it — "
+                    "promote a cell whose provenance cites a measured "
+                    "artifact or an explicit analytic prior "
+                    f"(tune promote): {choice.provenance!r}",
+                    details={"impl": choice.impl,
+                             "provenance": choice.provenance}))
+    return findings
+
+
+def audit_tune(db: Any = None) -> list[Finding]:
+    """TUNE-001/TUNE-002 over the same routing surface as audit_registry,
+    but against the tuning DB: every route must resolve to a live DB cell
+    (whose provenance is checked by `tune selftest`) or to a table tier
+    that declares its fallback by citing a committed artifact; resolved
+    cells must be fresh (jax version + recomputed program digest).
+
+    `db` is injectable for seeded tests; default is the committed store."""
+    from tpu_matmul_bench.ops.impl_select import resolve_route
+    from tpu_matmul_bench.tune.db import default_db, recomputed_digests
+
+    if db is None:
+        db = default_db()
+    shapes = [(s, s, s) for s in _REGISTRY_SIZES] + list(_REGISTRY_RECTS)
+    rows: list[tuple[str, Any, Any]] = []
+    seen: set[tuple[str, str]] = set()
+    for dtype_name in _REGISTRY_DTYPES:
+        dt = jnp.dtype(dtype_name)
+        for m, n, k in shapes:
+            choice, cell = resolve_route(m, n, k, "TPU v5e", dt, db=db)
+            key = (choice.impl, choice.provenance)
+            if key in seen:
+                continue
+            seen.add(key)
+            rows.append((f"tune:{dtype_name}@{m}x{n}x{k}", choice, cell))
+    # one trace per distinct live cell, not one per routing probe
+    digests = recomputed_digests(
+        {cell.key: cell for _, _, cell in rows if cell is not None}.values())
+    findings: list[Finding] = []
+    for where, choice, cell in rows:
+        if cell is None:
+            if not any(tok in choice.provenance
+                       for tok in _ARTIFACT_TOKENS):
+                findings.append(Finding(
+                    "TUNE-001", where,
+                    f"route resolves to no DB cell and the {choice.impl!r} "
+                    "table tier declares no fallback artifact: "
                     f"{choice.provenance!r}",
                     details={"impl": choice.impl,
                              "provenance": choice.provenance}))
+            continue
+        reasons = db.stale_reasons(cell, digests=digests)
+        if reasons:
+            findings.append(Finding(
+                "TUNE-002", where,
+                f"DB cell {cell.fingerprint} is stale: "
+                + "; ".join(reasons),
+                details={"fingerprint": cell.fingerprint,
+                         "impl": cell.impl,
+                         "reasons": reasons}))
     return findings
 
 
@@ -430,6 +485,7 @@ AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "donation": audit_donation,
     "pallas": audit_pallas_static,
     "registry": audit_registry,
+    "tune": audit_tune,
     "sched": _audit_sched,
     "memory": _audit_memory,
     "fingerprint": _audit_fingerprint,

@@ -56,8 +56,10 @@ RULES: dict[str, tuple[Severity, str]] = {
                           "would share a resume/ledger identity"),
     "REG-001": ("warn", "impl-registry tier routes to a kernel citing no "
                         "measurement artifact"),
-    "REG-002": ("info", "impl-registry tier extrapolated by tie policy "
-                        "(no head-to-head measurement at these shapes)"),
+    "REG-002": ("info", "impl-registry tier extrapolated by tie policy with "
+                        "no tuning-DB cell behind it (promote a cell citing "
+                        "a measured artifact or an explicit analytic prior "
+                        "— tune promote / scripts/regen_tune_db.py)"),
     "SCHED-001": ("error", "forced serialization: a collective transitively "
                            "consumes the same step's matmul product "
                            "(required on no_overlap baselines, fatal on "
@@ -82,6 +84,14 @@ RULES: dict[str, tuple[Severity, str]] = {
     "DRIFT-002": ("warn", "fingerprint baseline incomplete or stale for a "
                           "traced program (regen "
                           "tests/golden/program_fingerprints.json)"),
+    "TUNE-001": ("error", "impl_select route resolves to no tuning-DB cell "
+                          "and no declared fallback (a table tier citing a "
+                          "committed artifact) — the routing decision has "
+                          "no evidence"),
+    "TUNE-002": ("warn", "impl_select route resolves to a stale tuning-DB "
+                         "cell (jax version moved or the routed program's "
+                         "digest drifted) — re-measure or re-promote the "
+                         "cell"),
 }
 
 

@@ -1,22 +1,32 @@
 """Measured-winner matmul implementation routing — `--matmul-impl auto`.
 
+Routing is a two-tier lookup since the autotuning DB landed:
+
+1. **Tuning DB** (`tune/db.py`, the committed
+   `measurements/tune_db.jsonl`): cells keyed by (problem fingerprint,
+   device kind), each citing either a measured ledger artifact or an
+   explicit analytic prior, with jax-version + program-digest staleness
+   tracking. Every audited registry point resolves here, including the
+   bf16 1k–4k band that used to ride on an undocumented tie policy
+   (REG-002, retired: its cell now states the roofline prior and the
+   missing head-to-head explicitly).
+2. **Baked table** (`table_select`, below): the r4 head-to-head winners
+   as code — the documented fallback for shapes without a cell, for
+   empty/foreign DB checkouts, and the source `seed_cells_from_table`
+   regenerates the committed DB from.
+
 Round 4 measured both implementations (XLA's dot and our Pallas kernel)
 head-to-head under the fused protocol across dtypes and shapes, and the
 winner is size- and shape-qualified (VERDICT r4 weak #1): XLA leads int8
 below 16k and the tall-M rectangle; Pallas leads bf16 at every swept
 size, int8 at 16k, fp32, and the wide-N MLP rectangle. `auto` routes
 each (dtype, shape) to its measured winner, so "matching-or-beating"
-holds at the user-facing surface wherever a head-to-head exists — with
-one documented qualification: the bf16 1k–4k band (the sharded
-ring-chunk class) has NO XLA head-to-head at those shapes; its Pallas
-row is tuned against the Pallas fallback only (187.7 vs 148.1, RESULTS
-r2) and routes to Pallas by tie policy, an extrapolation ADVICE r5
-leaves open. `python -m tpu_matmul_bench lint` surfaces that tier as
-REG-002 until the head-to-head lands.
+holds at the user-facing surface wherever a head-to-head exists.
 
 Every row cites the committed measurement artifact that justifies it
-(the artifact-hygiene bar: no routing decision without a file; the lint
-rule REG-001 flags any Pallas tier that stops citing one). Ties and
+(the artifact-hygiene bar: no routing decision without a file; lint's
+REG-001 flags any Pallas tier that stops citing one, and TUNE-001/002
+flag registry points whose cell is missing or stale). Ties and
 unmeasured configurations on a tuned chip fall to Pallas — our kernel's
 tuned table generalizes (the 16k int8 winner came from the 8k sweep's
 shape); configurations on UNKNOWN chips (CPU, GPU, untuned TPU gens)
@@ -25,7 +35,7 @@ Pallas kernel would run in interpreter mode off-TPU).
 
 The reference has no analogue — it exposes exactly one native matmul
 (cuBLAS via `torch.matmul`, reference `matmul_benchmark.py:62`); owning
-a second implementation plus the data to route between them is
+a second implementation plus the measured data to route between them is
 capability beyond the reference's surface.
 """
 
@@ -54,6 +64,8 @@ class ImplChoice:
 
     impl: str         # "xla" | "pallas"
     provenance: str   # committed artifact (or rule) behind the decision
+    source: str = "table"            # "db" | "table"
+    blocks: tuple[int, int, int] | None = None  # DB winner tiling, if any
 
 
 def _rect_axis(m: int, n: int, k: int) -> str | None:
@@ -67,11 +79,11 @@ def _rect_axis(m: int, n: int, k: int) -> str | None:
     return None
 
 
-def select_impl(m: int, n: int, k: int, device_kind: str,
-                dtype: Any) -> ImplChoice:
-    """The measured-winner implementation for C[m,n] = A[m,k]·B[k,n] of
-    `dtype` on `device_kind`. Pure table lookup — no backend calls — so
-    it is callable at trace time and from record builders."""
+def table_select(m: int, n: int, k: int, device_kind: str,
+                 dtype: Any) -> ImplChoice:
+    """Tier 2: the baked r4 head-to-head table. Pure lookup — no I/O, no
+    backend calls — and the source the committed DB is seeded from
+    (tune/promote.seed_cells_from_table)."""
     kind = (device_kind or "").lower()
     if not any(key in kind for key in _ROUTED_KINDS):
         return ImplChoice("xla", "unrouted device kind: XLA native dot "
@@ -133,6 +145,45 @@ def select_impl(m: int, n: int, k: int, device_kind: str,
     return ImplChoice("xla", f"unrouted dtype {name}: XLA default")
 
 
+def select_impl(m: int, n: int, k: int, device_kind: str,
+                dtype: Any, *, db: Any = None) -> ImplChoice:
+    """The winning implementation for C[m,n] = A[m,k]·B[k,n] of `dtype`
+    on `device_kind`: tuning-DB cell first, baked table as the documented
+    fallback. Pure lookups only — no backend calls — so it is callable at
+    trace time and from record builders.
+
+    `db` (keyword-only; tests and audits inject their own) defaults to
+    the committed store, loaded once per process."""
+    cell = _db_lookup(m, n, k, device_kind, dtype, db)
+    if cell is not None:
+        return ImplChoice(cell.impl, cell.provenance_str,
+                          source="db", blocks=cell.blocks)
+    return table_select(m, n, k, device_kind, dtype)
+
+
+def resolve_route(m: int, n: int, k: int, device_kind: str, dtype: Any,
+                  *, db: Any = None) -> tuple[ImplChoice, Any]:
+    """(choice, cell-or-None) — the audit-facing spelling of
+    `select_impl` that keeps the resolved cell visible so lint can check
+    its staleness (TUNE-002) without re-probing the DB."""
+    cell = _db_lookup(m, n, k, device_kind, dtype, db)
+    if cell is not None:
+        return (ImplChoice(cell.impl, cell.provenance_str,
+                           source="db", blocks=cell.blocks), cell)
+    return table_select(m, n, k, device_kind, dtype), None
+
+
+def _db_lookup(m: int, n: int, k: int, device_kind: str, dtype: Any, db):
+    """The DB probe, lazily importing tune.db so explicit-impl paths pay
+    nothing. Note the argument-order seam: routing speaks (m, n, k), the
+    DB's problem key speaks (m, k, n)."""
+    if db is None:
+        from tpu_matmul_bench.tune.db import default_db
+
+        db = default_db()
+    return db.lookup(m, k, n, dtype, device_kind)
+
+
 def auto_extras(matmul_impl: str, m: int, n: int, k: int,
                 device_kind: str, dtype: Any) -> dict:
     """Record extras for an `auto` run: the resolved impl and the
@@ -142,4 +193,5 @@ def auto_extras(matmul_impl: str, m: int, n: int, k: int,
         return {}
     choice = select_impl(m, n, k, device_kind, dtype)
     return {"matmul_impl_resolved": choice.impl,
-            "impl_provenance": choice.provenance}
+            "impl_provenance": choice.provenance,
+            "impl_source": choice.source}

@@ -67,7 +67,10 @@ def matmul_2d(
                     else jax.devices()[0].device_kind)
             choice = select_impl(a.shape[0], b.shape[1], a.shape[1],
                                  kind, a.dtype)
-            return matmul_2d(choice.impl, blocks)(a, b)
+            # an explicit --block-m/n/k override wins; otherwise a
+            # DB-cell route carries its measured winner tiling
+            picked = blocks if blocks is not None else choice.blocks
+            return matmul_2d(choice.impl, picked)(a, b)
 
         return _auto
     if impl == "pallas":

@@ -130,7 +130,18 @@ class _OperandPool:
 def _make_cache(config: ServeConfig, device_kind: str,
                 pool: _OperandPool) -> ExecutableCache:
     def build(key: ExecKey):
-        return matmul_2d(key.impl, None, device_kind)
+        impl, blocks = key.impl, None
+        if impl == "auto":
+            # resolve the route once per executable at build time —
+            # tuning-DB cell first, baked table fallback — so the
+            # compiled program carries the DB winner's tiling, not just
+            # its impl name (the key's padded dims ARE the traced shape)
+            from tpu_matmul_bench.ops.impl_select import select_impl
+
+            choice = select_impl(key.m, key.n, key.k, device_kind,
+                                 key.dtype)
+            impl, blocks = choice.impl, choice.blocks
+        return matmul_2d(impl, blocks, device_kind)
 
     return ExecutableCache(build, capacity=config.cache_capacity,
                            operands=pool.get)
@@ -328,6 +339,9 @@ def _report_summary(stats: dict[str, Any]) -> None:
         f"  - Cache: {cache['hits']} hits / {cache['misses']} misses "
         f"({cache['hit_rate_pct']}% hit rate, "
         f"{cache['evictions']} evictions)",
+        *([f"  - Preload: {cache['preload']['count']} executable(s) "
+           f"warm-started in {cache['preload']['total_ms']} ms"]
+          if cache.get("preload", {}).get("count") else []),
         f"  - Padding overhead: {stats['padding_overhead_pct']}% extra FLOPs",
     ]
     for label, e in cache["by_entry"].items():
@@ -366,9 +380,7 @@ def _prewarm(config: ServeConfig, grid: ShapeGrid, cache: ExecutableCache,
                     impl=config.matmul_impl, mesh_shape=(world,))
             for e in config.mix_entries}
     with telemetry.span("prewarm", buckets=len(keys)):
-        for key in sorted(keys, key=lambda kk: kk.label):
-            cache.get(key)
-    return len(keys)
+        return cache.warm_start(keys)
 
 
 def _flops(samples: Sequence[Sample],
@@ -488,10 +500,12 @@ SELFTEST_REQUESTS = 10
 
 
 def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
-    """No-load sanity pass: compile one entry, serve SELFTEST_REQUESTS
-    requests synchronously, validate the ledger contract. Exits nonzero
-    on any violated invariant — the CI hook that keeps the serving path
-    honest without a load run."""
+    """No-load sanity pass: warm-start one entry's executable, serve
+    SELFTEST_REQUESTS requests synchronously, validate the ledger
+    contract — including that the preloaded bucket recorded zero cold
+    requests (the warm-start guarantee the tuning DB's AOT path rests
+    on). Exits nonzero on any violated invariant — the CI hook that
+    keeps the serving path honest without a load run."""
     devices, info, pool, cache, q = _setup(config)
     world = len(devices)
     report(header("Serve selftest (no load)", {
@@ -500,8 +514,12 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
         "Data type": config.dtype_name,
     }))
     e = config.mix_entries[0]
+    key = ExecKey(*q.grid.bucket(e.m, e.k, e.n), dtype=config.dtype_name,
+                  impl=config.matmul_impl, mesh_shape=(world,))
     samples: list[Sample] = []
     with telemetry.session(config.trace_out):
+        with telemetry.span("warm-start", buckets=1):
+            preloaded = cache.warm_start([key])
         t0 = time.perf_counter()
         for rid in range(SELFTEST_REQUESTS):
             q.submit(Request(rid=rid, m=e.m, k=e.k, n=e.n,
@@ -517,7 +535,7 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
                             executed_flops=executed_f)
         rec = _serve_record(config, stats, samples, info.device_kind, world,
                             mode="selftest", executed_flops=executed_f,
-                            wall_s=wall_s, prewarmed=0)
+                            wall_s=wall_s, prewarmed=preloaded)
         _report_summary(stats)
         with JsonWriter(config.json_out,
                         manifest=telemetry.build_manifest(
@@ -526,12 +544,20 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
                         append=config.append_ledger) as writer:
             writer.write(rec)
     problems = validate_serve_record(rec)
+    s = rec.extras["serve"]
+    # the warm-start guarantee: the preload phase compiled the serving
+    # bucket, so no request may have paid a cold compile
+    if s["cold_requests"]:
+        problems.append(
+            f"warm-start failed: {s['cold_requests']} of {len(samples)} "
+            "requests paid a cold compile after the preload phase")
     if problems:
         report(*[f"selftest FAILED: {p}" for p in problems],
                file=sys.stderr)
         raise SystemExit(1)
-    report("selftest ok: 1 executable compiled, "
-           f"{len(samples)} requests served, ledger contract holds")
+    report(f"selftest ok: {preloaded} executable warm-started, "
+           f"{len(samples)} requests served cold-free, "
+           "ledger contract holds")
     return [rec]
 
 

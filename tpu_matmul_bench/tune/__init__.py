@@ -1,0 +1,5 @@
+"""Fingerprint-keyed autotuning: persistent cell store (`db`), cost-model
+candidate pruning (`prune`), and measured-winner promotion (`promote`),
+wired as `python -m tpu_matmul_bench tune {show,prune,fill,promote,
+selftest}` (tune/cli.py) with the measurement sweep itself still owned by
+`benchmarks/pallas_tune.py` (flag-style invocations fall through)."""

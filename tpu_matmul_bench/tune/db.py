@@ -1,0 +1,426 @@
+"""Fingerprint-keyed autotuning database — the persistent routing store.
+
+`ops/impl_select.py` used to be the only memory the routing layer had: a
+hand-baked table whose provenance lived in comments. This module gives
+routing a durable, auditable store instead. Each **cell** answers one
+question — "which impl/blocking wins C[m,n] = A[m,k]·B[k,n] of `dtype`
+on this chip?" — and is keyed by
+
+  (problem fingerprint, device-kind token)
+
+with the jax version and a canonical *program digest* recorded alongside
+for staleness detection. The problem fingerprint reuses
+`analysis/fingerprint.digest` (the DRIFT-gate hashing convention) over a
+canonical problem record; the program digest is the digest of the routed
+program's canonical jaxpr record + the winning blocks, so a jax upgrade
+or kernel refactor that changes the compiled structure marks exactly the
+affected cells stale (DRIFT-001 semantics) instead of dropping the DB.
+
+Provenance is mandatory and typed: every cell is either ``measured``
+(cites a committed ledger artifact under measurements/) or ``analytic``
+(cites an explicit prior — VMEM feasibility + roofline intensity from
+`tune/prune.py`, plus any supporting artifact). A cell that can cite
+neither does not get written — that is the REG-002 gap this subsystem
+retires, and the lint rules TUNE-001/TUNE-002 keep it retired.
+
+Durability follows `campaign/state.py`: JSONL, one fsync'd line per
+cell, append-only — later records supersede earlier ones for the same
+key, so promotions never rewrite history and a crash mid-write loses at
+most the line being written.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Iterable
+
+PROVENANCE_KINDS = ("measured", "analytic")
+
+CELL_SCHEMA = 1
+
+#: repo-relative default store (committed — the shipped routing surface)
+DB_RELPATH = os.path.join("measurements", "tune_db.jsonl")
+
+#: chips sharing one tuned surface map to one token (the same substring
+#: convention as pallas_matmul._TUNED_BLOCKS / impl_select._ROUTED_KINDS)
+_KIND_TOKENS = ("v5 lite", "v5e")
+_SHARED_TOKEN = "v5e"
+
+
+def default_path(root: str | None = None) -> str:
+    """Absolute DB path; `root` defaults to the repo root inferred from
+    this package's location (same inference as fingerprint.golden_path)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    return os.path.join(root, DB_RELPATH)
+
+
+def kind_token(device_kind: str) -> str:
+    """Canonical device-kind key: every chip naming of the tuned TPU
+    ("TPU v5 lite", "TPU v5e", ...) maps to one token so cells measured
+    on either spelling serve both."""
+    kind = (device_kind or "").lower()
+    if any(tok in kind for tok in _KIND_TOKENS):
+        return _SHARED_TOKEN
+    return kind.strip() or "unknown"
+
+
+def canonical_dtype(dtype: Any) -> str:
+    """The dtype name a problem is keyed under. float16 shares the
+    bfloat16 cells (same operand width — the convention tuned_blocks and
+    impl_select already apply)."""
+    import jax.numpy as jnp
+
+    name = jnp.dtype(dtype).name
+    return "bfloat16" if name == "float16" else name
+
+
+def problem_fingerprint(m: int, k: int, n: int, dtype: Any) -> str:
+    """Stable digest of one routing question. Hashing convention shared
+    with the DRIFT gate (analysis/fingerprint.digest)."""
+    from tpu_matmul_bench.analysis.fingerprint import digest
+
+    return digest({"op": "matmul_2d", "m": int(m), "k": int(k),
+                   "n": int(n), "dtype": canonical_dtype(dtype)})
+
+
+def program_digest(m: int, k: int, n: int, dtype: Any, impl: str,
+                   blocks: tuple[int, int, int] | None = None,
+                   device_kind: str = "TPU v5e") -> str:
+    """Digest of the canonical jaxpr record of the program this cell
+    routes to, salted with the winning blocks. Trace-only (make_jaxpr —
+    no compile, no device), and built from primitive names + aval
+    shapes/dtypes, so it is deterministic across backends: the CPU lint
+    host recomputes the same digest the TPU promotion wrote."""
+    import jax
+
+    from tpu_matmul_bench.analysis.fingerprint import (
+        canonical_record,
+        digest,
+    )
+    from tpu_matmul_bench.ops.matmul import matmul_2d
+
+    fn = matmul_2d(impl, tuple(blocks) if blocks else None, device_kind)
+    dt = canonical_dtype(dtype)
+    avals = (jax.ShapeDtypeStruct((m, k), dt),
+             jax.ShapeDtypeStruct((k, n), dt))
+    record = canonical_record(jax.make_jaxpr(fn)(*avals))
+    record["blocks"] = list(blocks) if blocks else None
+    return digest(record)
+
+
+@dataclasses.dataclass(frozen=True)
+class Cell:
+    """One tuning decision: problem → winner, with typed provenance."""
+
+    m: int
+    k: int
+    n: int
+    dtype: str                 # canonical name (bfloat16/float32/int8)
+    device_kind: str           # kind token (see kind_token)
+    impl: str                  # "xla" | "pallas"
+    provenance_kind: str       # "measured" | "analytic"
+    artifact: str              # committed evidence path(s)
+    detail: str = ""           # prior / margin / sweep context
+    blocks: tuple[int, int, int] | None = None
+    tflops: float | None = None
+    jax_version: str = ""
+    program_digest: str = ""
+    created_at: str = ""
+
+    def __post_init__(self) -> None:
+        if self.provenance_kind not in PROVENANCE_KINDS:
+            raise ValueError(
+                f"provenance kind {self.provenance_kind!r} not in "
+                f"{PROVENANCE_KINDS}")
+        if not self.artifact:
+            raise ValueError("a cell without evidence is the gap this DB "
+                             "exists to close — artifact is mandatory")
+
+    @property
+    def fingerprint(self) -> str:
+        return problem_fingerprint(self.m, self.k, self.n, self.dtype)
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.fingerprint, self.device_kind)
+
+    @property
+    def provenance_str(self) -> str:
+        """The ImplChoice.provenance string a DB-backed route carries:
+        names the cell, its kind, and the evidence path(s) verbatim (the
+        artifact-hygiene bar checks for literal measurements/ paths)."""
+        text = (f"tune-db cell {self.fingerprint} "
+                f"[{self.provenance_kind}]: {self.artifact}")
+        return f"{text} — {self.detail}" if self.detail else text
+
+    def to_record(self) -> dict[str, Any]:
+        return {
+            "record_type": "tune_cell",
+            "schema": CELL_SCHEMA,
+            "fingerprint": self.fingerprint,
+            "device_kind": self.device_kind,
+            "problem": {"m": self.m, "k": self.k, "n": self.n,
+                        "dtype": self.dtype},
+            "impl": self.impl,
+            "blocks": list(self.blocks) if self.blocks else None,
+            "provenance": {"kind": self.provenance_kind,
+                           "artifact": self.artifact,
+                           "detail": self.detail},
+            "tflops": self.tflops,
+            "jax_version": self.jax_version,
+            "program_digest": self.program_digest,
+            "created_at": self.created_at,
+        }
+
+    @classmethod
+    def from_record(cls, rec: dict[str, Any]) -> "Cell":
+        prob = rec["problem"]
+        prov = rec.get("provenance") or {}
+        blocks = rec.get("blocks")
+        return cls(
+            m=int(prob["m"]), k=int(prob["k"]), n=int(prob["n"]),
+            dtype=str(prob["dtype"]),
+            device_kind=str(rec["device_kind"]),
+            impl=str(rec["impl"]),
+            provenance_kind=str(prov.get("kind", "")),
+            artifact=str(prov.get("artifact", "")),
+            detail=str(prov.get("detail", "")),
+            blocks=tuple(int(b) for b in blocks) if blocks else None,
+            tflops=rec.get("tflops"),
+            jax_version=str(rec.get("jax_version", "")),
+            program_digest=str(rec.get("program_digest", "")),
+            created_at=str(rec.get("created_at", "")),
+        )
+
+
+class TuningDB:
+    """The cell store: JSONL on disk, a superseding dict in memory.
+
+    The file is append-only with one fsync per line (`campaign/state.py`
+    durability): `put` never rewrites earlier records, and `load` keeps
+    the LAST record per (fingerprint, device_kind) — a promotion is an
+    append, a rollback is an append of the previous winner.
+    """
+
+    def __init__(self, path: str | None = None) -> None:
+        self.path = path or default_path()
+        self._cells: dict[tuple[str, str], Cell] = {}
+        self.records_read = 0
+        self.parse_errors: list[str] = []
+
+    # -------------------------------------------------------------- load
+
+    @classmethod
+    def load(cls, path: str | None = None) -> "TuningDB":
+        """Read the store (missing file → empty DB: every lookup falls
+        through to the baked table, which is the documented fallback)."""
+        db = cls(path)
+        if not os.path.exists(db.path):
+            return db
+        with open(db.path) as fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    # a torn trailing line from a crash is tolerated, as
+                    # in the campaign journal; anything else is reported
+                    # by selftest
+                    db.parse_errors.append(f"line {lineno}: unparseable")
+                    continue
+                if not isinstance(rec, dict) \
+                        or rec.get("record_type") != "tune_cell":
+                    continue  # manifest-style headers ride along fine
+                try:
+                    cell = Cell.from_record(rec)
+                except (KeyError, ValueError, TypeError) as e:
+                    db.parse_errors.append(f"line {lineno}: {e}")
+                    continue
+                db.records_read += 1
+                stored = rec.get("fingerprint")
+                if stored and stored != cell.fingerprint:
+                    db.parse_errors.append(
+                        f"line {lineno}: stored fingerprint {stored} != "
+                        f"recomputed {cell.fingerprint}")
+                    continue
+                db._cells[cell.key] = cell
+        return db
+
+    # ------------------------------------------------------------- write
+
+    def put(self, cell: Cell, *, fsync: bool = True) -> Cell:
+        """Append one cell (fsync'd) and supersede it in memory. Fills
+        jax_version/program_digest/created_at when the caller left them
+        empty, so promotions always land fully keyed."""
+        cell = self._complete(cell)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "a") as fh:
+            fh.write(json.dumps(cell.to_record()) + "\n")
+            fh.flush()
+            if fsync:
+                os.fsync(fh.fileno())
+        self._cells[cell.key] = cell
+        return cell
+
+    def _complete(self, cell: Cell) -> Cell:
+        import datetime
+
+        import jax
+
+        updates: dict[str, Any] = {}
+        if not cell.jax_version:
+            updates["jax_version"] = jax.__version__
+        if not cell.program_digest:
+            updates["program_digest"] = program_digest(
+                cell.m, cell.k, cell.n, cell.dtype, cell.impl, cell.blocks)
+        if not cell.created_at:
+            updates["created_at"] = datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds")
+        return dataclasses.replace(cell, **updates) if updates else cell
+
+    # ------------------------------------------------------------ lookup
+
+    def lookup(self, m: int, k: int, n: int, dtype: Any,
+               device_kind: str) -> Cell | None:
+        """The live cell for this routing question, or None (→ the baked
+        table answers). Pure dict probe — callable at trace time."""
+        return self._cells.get(
+            (problem_fingerprint(m, k, n, dtype), kind_token(device_kind)))
+
+    def cells(self) -> list[Cell]:
+        """Live (non-superseded) cells, deterministic order."""
+        return [self._cells[key] for key in sorted(self._cells)]
+
+    def __len__(self) -> int:
+        return len(self._cells)
+
+    def __contains__(self, key: tuple[str, str]) -> bool:
+        return key in self._cells
+
+    # --------------------------------------------------------- staleness
+
+    def stale_reasons(self, cell: Cell, *,
+                      jax_version: str | None = None,
+                      digests: dict[tuple[str, str], str] | None = None,
+                      ) -> list[str]:
+        """Why this cell can no longer be trusted (empty list = fresh).
+
+        Two independent invalidation axes, both DRIFT-001-shaped:
+        - the jax version moved since the cell was written;
+        - the routed program's canonical structure no longer digests to
+          what the cell recorded (kernel refactor, lowering change).
+
+        `digests` lets seeded tests (and batch audits) inject recomputed
+        digests keyed by (fingerprint, device_kind) instead of tracing
+        per call."""
+        import jax
+
+        reasons: list[str] = []
+        current_jax = jax_version if jax_version is not None \
+            else jax.__version__
+        if cell.jax_version and cell.jax_version != current_jax:
+            reasons.append(
+                f"jax {cell.jax_version} → {current_jax} since the cell "
+                "was written (re-measure or re-promote)")
+        if cell.program_digest:
+            if digests is not None:
+                current = digests.get(cell.key)
+            else:
+                current = program_digest(cell.m, cell.k, cell.n, cell.dtype,
+                                         cell.impl, cell.blocks)
+            if current is not None and current != cell.program_digest:
+                reasons.append(
+                    f"program digest {cell.program_digest} → {current}: "
+                    "the routed program's compiled structure changed "
+                    "(DRIFT-style invalidation)")
+        return reasons
+
+    def stale_cells(self, **kwargs: Any) -> list[tuple[Cell, list[str]]]:
+        """(cell, reasons) for every stale live cell."""
+        out = []
+        for cell in self.cells():
+            reasons = self.stale_reasons(cell, **kwargs)
+            if reasons:
+                out.append((cell, reasons))
+        return out
+
+    # ---------------------------------------------------------- validate
+
+    def validate(self, root: str | None = None) -> list[str]:
+        """Schema + provenance consistency problems (empty = healthy).
+        The `tune selftest` core: parse errors, provenance typing, dead
+        artifact paths, measured cells without measurements/ evidence."""
+        if root is None:
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        problems = list(self.parse_errors)
+        for cell in self.cells():
+            label = f"{cell.dtype}@{cell.m}x{cell.k}x{cell.n}" \
+                    f"/{cell.device_kind}"
+            if cell.impl not in ("xla", "pallas"):
+                problems.append(f"{label}: unknown impl {cell.impl!r}")
+            if cell.impl == "pallas" and not cell.blocks:
+                problems.append(f"{label}: pallas cell without blocks — "
+                                "the winner's tiling is the point")
+            if cell.provenance_kind == "measured" \
+                    and "measurements/" not in cell.artifact:
+                problems.append(
+                    f"{label}: measured cell cites no measurements/ "
+                    f"ledger: {cell.artifact!r}")
+            if cell.provenance_kind == "analytic" and not cell.detail:
+                problems.append(
+                    f"{label}: analytic cell without an explicit prior "
+                    "in detail — 'analytic' must name its model")
+            for path in _artifact_paths(cell.artifact):
+                if not os.path.exists(os.path.join(root, path)):
+                    problems.append(f"{label}: artifact {path!r} does not "
+                                    "exist in the repo")
+            if not cell.program_digest:
+                problems.append(f"{label}: no program digest — staleness "
+                                "cannot be detected")
+        return problems
+
+
+def _artifact_paths(artifact: str) -> list[str]:
+    """Repo-relative paths named in an artifact citation (comma/space
+    separated; non-path prose is ignored)."""
+    out = []
+    for token in artifact.replace(",", " ").split():
+        token = token.strip()
+        if token.startswith("measurements/") or token == "RESULTS_TPU.md":
+            out.append(token)
+    return out
+
+
+def default_db() -> TuningDB:
+    """The committed store, loaded once per process. Mutating callers
+    (promote) should load their own instance; `invalidate_default_db`
+    resets the cache after an in-place promotion."""
+    global _DEFAULT_DB
+    if _DEFAULT_DB is None:
+        _DEFAULT_DB = TuningDB.load()
+    return _DEFAULT_DB
+
+
+def invalidate_default_db() -> None:
+    global _DEFAULT_DB
+    _DEFAULT_DB = None
+
+
+_DEFAULT_DB: TuningDB | None = None
+
+
+def recomputed_digests(cells: Iterable[Cell]) -> dict[tuple[str, str], str]:
+    """Batch-recompute program digests for `cells` (trace-only). Feeds
+    `stale_reasons(digests=...)` so audits trace each program once."""
+    out: dict[tuple[str, str], str] = {}
+    for cell in cells:
+        out[cell.key] = program_digest(cell.m, cell.k, cell.n, cell.dtype,
+                                       cell.impl, cell.blocks)
+    return out

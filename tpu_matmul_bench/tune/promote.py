@@ -1,0 +1,225 @@
+"""Promote measured sweep winners into the tuning DB.
+
+`scripts/bake_rows.py` turns tune ledgers into table rows a human pastes
+into `ops/pallas_matmul.py`; this module is the same ranking made
+machine-final: the winner per (dtype, precision, shape) group becomes a
+``measured`` DB cell citing its source ledger(s), and `impl_select`
+starts routing on it without anyone editing a table. The ranking rules
+are deliberately identical to bake_rows (two spellings of one winner
+definition would let a blocking win one surface and lose the other):
+
+- confirm-pass records are authoritative when present — a drift-inflated
+  raw sweep number must not outrank its own interleaved confirm;
+- one entry per (blocks, grid_order, ksplit), best run wins — the
+  structural axes are part of a candidate's identity;
+- a top-2 margin under 1% of the runner-up is a TIE and is **not
+  promoted** — a coin-flip must never become a routing decision;
+- structural winners (grid_order/ksplit ≠ defaults) are reported but not
+  promoted: a cell carries (bm, bn, bk) only, and a row that cannot
+  reproduce its number is worse than no row;
+- ring sweeps are reported but not promoted (rings key the plain table).
+
+`seed_cells_from_table` is the other fill direction: it converts the
+shipped `impl_select` fallback table into cells — measured tiers keep
+their ledger citations, the formerly-REG-002 tiers become explicit
+``analytic`` cells naming their prior — which is how the committed
+`measurements/tune_db.jsonl` is generated (scripts/regen_tune_db.py).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Iterable
+
+from tpu_matmul_bench.tune.db import Cell, TuningDB, canonical_dtype, kind_token
+
+TIE_GATE_PCT = 1.0  # same runner-up-denominator gate as pallas_tune/bake_rows
+
+
+def load_tune_records(paths: Iterable[str]):
+    """Group tune ledger records by (dtype, precision, shape label) —
+    bake_rows.load with the same filters."""
+    groups = defaultdict(list)
+    for path in paths:
+        try:
+            with open(path) as fh:
+                lines = fh.read().splitlines()
+        except OSError as e:
+            print(f"skip {path}: {e}", file=sys.stderr)
+            continue
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("benchmark") != "tune":
+                continue
+            ex = rec.get("extras", {})
+            if not {"block_m", "block_n", "block_k"} <= ex.keys():
+                continue
+            shape = ex.get("shape") or f"{rec['size']}^2"
+            if str(rec.get("mode", "")).startswith("tune_pallas_ring"):
+                shape = f"{rec['mode'][5:]}:{shape}"
+            key = (rec["dtype"], ex.get("precision", "default"), shape)
+            groups[key].append((rec, path))
+    return groups
+
+
+def _rank(entries):
+    """bake_rows' ranking: confirm-authoritative pool, per-candidate
+    dedupe keeping the best run, sorted by tflops_total descending."""
+    confirmed = [e for e in entries if e[0]["extras"].get("confirm_pass")]
+    pool = confirmed or entries
+    by_blocks: dict = {}
+    for rec, path in pool:
+        e = rec["extras"]
+        k = (e["block_m"], e["block_n"], e["block_k"],
+             e.get("grid_order", "mnk"), e.get("ksplit", 1))
+        if (k not in by_blocks
+                or rec["tflops_total"] > by_blocks[k][0]["tflops_total"]):
+            by_blocks[k] = (rec, path)
+    return sorted(by_blocks.values(), key=lambda e: -e[0]["tflops_total"])
+
+
+def _problem_dims(shape: str, best_rec: dict) -> tuple[int, int, int] | None:
+    """(m, k, n) for a promotable shape label; None for ring sweeps."""
+    if ":" in shape:
+        return None  # ring sweep — rings key the plain table, no cell
+    if "^2" in shape:
+        size = int(best_rec["size"])
+        return size, size, size
+    m, k, n = (int(v) for v in shape.split("x"))
+    return m, k, n
+
+
+def promote(paths: Iterable[str], db: TuningDB | None = None, *,
+            device_kind: str = "TPU v5e",
+            dry_run: bool = False) -> dict[str, Any]:
+    """Rank every group in `paths` and write each clean winner as a
+    measured cell. Returns {"promoted": [cells], "skipped": [reasons]}."""
+    if db is None:
+        db = TuningDB.load()
+    groups = load_tune_records(paths)
+    promoted: list[Cell] = []
+    skipped: list[str] = []
+    for (dtype, precision, shape), entries in sorted(groups.items()):
+        label = f"{dtype} {shape}" + (
+            "" if precision == "default" else f" precision={precision}")
+        ranked = _rank(entries)
+        (best, src) = ranked[0]
+        ex = best["extras"]
+        if "tie_margin_pct" in ex:
+            skipped.append(
+                f"{label}: confirm margin {ex['tie_margin_pct']}% is inside "
+                "run noise — re-measure before promoting")
+            continue
+        if len(ranked) > 1 and ranked[1][0]["tflops_total"] > 0:
+            runner_up = ranked[1][0]
+            margin_pct = ((best["tflops_total"] - runner_up["tflops_total"])
+                          / runner_up["tflops_total"] * 100.0)
+            if margin_pct < TIE_GATE_PCT:
+                skipped.append(
+                    f"{label}: top-2 margin {margin_pct:.2f}% is inside the "
+                    f"{TIE_GATE_PCT}% confirm-noise gate — not promoted")
+                continue
+        if ex.get("grid_order", "mnk") != "mnk" or ex.get("ksplit", 1) != 1:
+            skipped.append(
+                f"{label}: structural winner (grid_order/ksplit) — a cell "
+                "carries blocks only; extend the cell schema before "
+                "promoting")
+            continue
+        dims = _problem_dims(shape, best)
+        if dims is None:
+            skipped.append(f"{label}: ring sweep — no cell target")
+            continue
+        m, k, n = dims
+        cell = Cell(
+            m=m, k=k, n=n, dtype=canonical_dtype(dtype),
+            device_kind=kind_token(device_kind),
+            impl="pallas",
+            provenance_kind="measured",
+            artifact=src,
+            detail=(f"pallas_tune sweep winner over {len(ranked)} "
+                    f"candidates, {best['tflops_total']:.2f} "
+                    f"{'TOPS' if dtype == 'int8' else 'TFLOPS'}"),
+            blocks=(ex["block_m"], ex["block_n"], ex["block_k"]),
+            tflops=float(best["tflops_total"]),
+        )
+        if dry_run:
+            promoted.append(db._complete(cell))
+        else:
+            promoted.append(db.put(cell))
+    return {"promoted": promoted, "skipped": skipped}
+
+
+# --------------------------------------------------------------- seeding
+
+#: the registry surface the static auditor walks (auditor._REGISTRY_*) —
+#: the seeded DB covers exactly what lint audits, so REG/TUNE findings
+#: and the shipped cells describe the same set of routing questions.
+SEED_SIZES = (256, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+SEED_RECTS = ((8192, 28672, 4096), (28672, 8192, 4096))  # (m, n, k)
+SEED_DTYPES = ("bfloat16", "int8", "float32")  # float16 shares bf16 cells
+
+#: explicit analytic priors for the table tiers whose provenance cites
+#: no per-shape ledger — the REG-002 band and the small-shape defaults.
+#: Keyed by a distinctive substring of the table tier's provenance.
+_ANALYTIC_PRIORS = {
+    "ties route to Pallas": (
+        "RESULTS_TPU.md",
+        "analytic prior (tune.prune roofline): the tuned 1024-row measured "
+        "187.7 vs 148.1 TFLOPS over the Pallas fallback (RESULTS_TPU.md r2 "
+        "chunk sweep) and the intensity model ranks its large tiles ahead "
+        "of any sub-4k alternative; no XLA head-to-head exists at this "
+        "band — re-promote from a measured sweep when a TPU is available"),
+    "sub-1024 dims": (
+        "RESULTS_TPU.md",
+        "analytic prior (tune.prune): below 1024 the grid is too small to "
+        "amortize the Pallas pipeline (dispatch-bound regime, RESULTS_TPU.md "
+        "scaling curve) — XLA native dot is the modeled winner"),
+    "no tuned fp32 row": (
+        "RESULTS_TPU.md",
+        "analytic prior (tune.prune): no tuned fp32 row below 4096; VMEM "
+        "feasibility holds but the intensity model gives no margin over "
+        "XLA's native dot at these sizes — XLA default"),
+}
+
+
+def seed_cells_from_table(device_kind: str = "TPU v5e") -> list[Cell]:
+    """Convert the baked fallback table into DB cells over the audited
+    registry surface. Measured tiers keep their ledger citations; the
+    artifact-less tiers become explicit analytic cells (this is the
+    REG-002 retirement: the extrapolated band now states its prior)."""
+    from tpu_matmul_bench.ops.impl_select import table_select
+    from tpu_matmul_bench.ops.pallas_matmul import tuned_blocks
+
+    problems = [(s, s, s) for s in SEED_SIZES]
+    problems += [(m, k, n) for (m, n, k) in SEED_RECTS]
+    cells = []
+    for dtype in SEED_DTYPES:
+        for m, k, n in problems:
+            choice = table_select(m, n, k, device_kind, dtype)
+            blocks = None
+            if choice.impl == "pallas":
+                blocks = tuned_blocks(m, n, k, device_kind, dtype)
+            prior = next((v for key, v in _ANALYTIC_PRIORS.items()
+                          if key in choice.provenance), None)
+            if prior is not None:
+                artifact, detail = prior
+                kind = "analytic"
+            elif "measurements/" in choice.provenance:
+                artifact = choice.provenance
+                detail = "promoted from the r4 head-to-head routing table"
+                kind = "measured"
+            else:  # pragma: no cover — every current tier matches above
+                raise ValueError(
+                    f"table tier without artifact or prior: "
+                    f"{choice.provenance!r}")
+            cells.append(Cell(
+                m=m, k=k, n=n, dtype=canonical_dtype(dtype),
+                device_kind=kind_token(device_kind),
+                impl=choice.impl, provenance_kind=kind,
+                artifact=artifact, detail=detail, blocks=blocks))
+    return cells

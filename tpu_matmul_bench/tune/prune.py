@@ -1,0 +1,237 @@
+"""Cost-model pruning of the Pallas tile candidate space.
+
+Measuring every blocking in `pallas_tune.DEFAULT_CANDIDATES` costs one
+compile + timed window per candidate per shape — minutes of device time
+each on a tunneled TPU. Most of that spend is statically decidable: a
+candidate whose tile set cannot fit VMEM will fail to compile, a clamped
+duplicate re-measures a blocking already in the sweep, and a tile pair
+that re-reads HBM 4× more than another is not going to win a bandwidth-
+bound problem. This module spends zero device seconds ranking the
+candidates with the repo's analytic models and keeps only the top-K:
+
+- **feasibility** — `pallas_matmul.vmem_bytes_estimate` against
+  `VMEM_LIMIT_CAP` (the same estimate lint's PALLAS-003 gates on), after
+  clamping through `effective_blocks` and deduping what actually runs;
+- **roofline ranking** — arithmetic intensity against modeled HBM
+  traffic: A is re-read ceil(n/bn) times, B ceil(m/bm) times, C written
+  once, so intensity ≈ 2·m·k·n / traffic — exactly the large-tile
+  argument the measured v5e winners validated (`_V5E_ROWS` docstring);
+- **wire costs** — for ring-chunk problems, `comms_model`'s
+  RING_WIRE_FACTOR prices the collective bytes the chunk shape implies,
+  reported alongside so a tuner reading the prune report sees the comm
+  floor the compute tiles sit on.
+
+Ties in intensity break toward deeper K (fewer grid passes over the
+accumulator — the direction the r4 deep-K int8 sweeps moved) and then
+smaller VMEM. The kept set always contains every measured table winner
+on the shipped fixtures (tests/test_tune_db.py pins this): pruning that
+could drop a real winner would be a negative-value model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Iterable
+
+DEFAULT_TOP_K = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One blocking's static scorecard for a specific problem."""
+
+    requested: tuple[int, int, int]
+    blocks: tuple[int, int, int]    # after effective_blocks clamping
+    feasible: bool
+    reason: str                      # why infeasible ("" when feasible)
+    vmem_bytes: int
+    hbm_bytes: int
+    intensity: float                 # matmul flops per modeled HBM byte
+
+
+@dataclasses.dataclass
+class PruneReport:
+    """The prune decision for one problem, with its audit trail."""
+
+    m: int
+    k: int
+    n: int
+    dtype: str
+    candidates: list[Candidate]      # deduped, ranked (feasible first)
+    kept: list[tuple[int, int, int]]
+    dropped_infeasible: list[Candidate]
+    dropped_ranked: list[Candidate]
+    trials_before: int               # requested candidates (pre-dedupe)
+    trials_after: int                # = len(kept): what gets measured
+    wire: dict[str, Any] | None = None  # ring context (see ring_wire)
+
+    @property
+    def reduction_pct(self) -> float:
+        if not self.trials_before:
+            return 0.0
+        return round(100.0 * (self.trials_before - self.trials_after)
+                     / self.trials_before, 1)
+
+    def log_lines(self) -> list[str]:
+        """The per-shape trial-count evidence the acceptance bar asks
+        for: N candidates → K measured, and why each drop happened."""
+        label = f"{self.m}x{self.k}x{self.n}/{self.dtype}"
+        lines = [f"[{label}] prune: {self.trials_before} candidates → "
+                 f"{self.trials_after} measured trials "
+                 f"(-{self.reduction_pct}%)"]
+        dup = self.trials_before - len(self.candidates)
+        if dup:
+            lines.append(f"  {dup} clamp to an already-kept blocking "
+                         "(effective_blocks dedupe)")
+        for c in self.dropped_infeasible:
+            lines.append(f"  drop {c.requested}: {c.reason}")
+        for c in self.dropped_ranked:
+            lines.append(
+                f"  drop {c.requested}: ranked below top-{len(self.kept)} "
+                f"(intensity {c.intensity:.1f} flops/B)")
+        if self.wire:
+            w = self.wire
+            lines.append(
+                f"  ring {w['ring']}@d{w['world']}: chunk "
+                f"{w['chunk_m']}x{w['chunk_k']}x{w['chunk_n']}, "
+                f"{w['collective']} wire ≈ {w['wire_bytes'] / 2**20:.1f} "
+                "MiB/step (comms_model floor under the compute tiles)")
+        return lines
+
+
+def _dtypes_of(dtype: Any):
+    """(in, out, acc) dtypes of the Pallas kernel for an input dtype —
+    the same contract auditor._pallas_dtypes checks."""
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    if dt.name == "float16":
+        dt = jnp.dtype(jnp.bfloat16)
+    if jnp.issubdtype(dt, jnp.integer):
+        return dt, jnp.dtype(jnp.int32), jnp.dtype(jnp.int32)
+    return dt, dt, jnp.dtype(jnp.float32)
+
+
+def score_candidate(m: int, k: int, n: int, dtype: Any,
+                    requested: tuple[int, int, int]) -> Candidate:
+    """Static scorecard for one requested blocking on one problem."""
+    from tpu_matmul_bench.ops.pallas_matmul import (
+        VMEM_LIMIT_CAP,
+        effective_blocks,
+        vmem_bytes_estimate,
+    )
+
+    in_dt, out_dt, acc_dt = _dtypes_of(dtype)
+    eff = effective_blocks(m, n, k, *requested)
+    bm, bn, bk = eff
+    vmem = vmem_bytes_estimate(bm, bn, bk, in_dt, out_dt, acc_dt)
+    # modeled HBM traffic: A streamed once per N-panel, B once per
+    # M-panel, C written once (grid_order mnk; nmk swaps which operand
+    # dominates but not the total's ordering between candidates)
+    traffic = (m * k * math.ceil(n / bn) * in_dt.itemsize
+               + k * n * math.ceil(m / bm) * in_dt.itemsize
+               + m * n * out_dt.itemsize)
+    intensity = 2.0 * m * k * n / traffic
+    feasible, reason = True, ""
+    if vmem > VMEM_LIMIT_CAP:
+        feasible = False
+        reason = (f"VMEM estimate {vmem / 2**20:.0f} MiB exceeds the "
+                  f"{VMEM_LIMIT_CAP / 2**20:.0f} MiB cap (would fail to "
+                  "compile — lint PALLAS-003's bar)")
+    return Candidate(requested=tuple(requested), blocks=eff,
+                     feasible=feasible, reason=reason, vmem_bytes=vmem,
+                     hbm_bytes=traffic, intensity=intensity)
+
+
+def rank_candidates(m: int, k: int, n: int, dtype: Any,
+                    candidates: Iterable[tuple[int, int, int]],
+                    ) -> tuple[list[Candidate], int]:
+    """(deduped ranked candidates, requested count). Feasible candidates
+    sort by descending intensity, then deeper K, then smaller VMEM (all
+    deterministic); infeasible ones sink to the tail."""
+    requested = [tuple(c) for c in candidates]
+    seen: set[tuple[int, int, int]] = set()
+    scored: list[Candidate] = []
+    for want in requested:
+        c = score_candidate(m, k, n, dtype, want)
+        if c.blocks in seen:
+            continue  # clamps to an already-scored trial
+        seen.add(c.blocks)
+        scored.append(c)
+    scored.sort(key=lambda c: (not c.feasible, -c.intensity,
+                               -c.blocks[2], c.vmem_bytes, c.blocks))
+    return scored, len(requested)
+
+
+def ring_wire(ring: str, world: int, size: int, dtype: Any,
+              ) -> dict[str, Any]:
+    """The ring-chunk problem + wire bytes a `--ring` sweep at `size`
+    implies: chunk geometry mirrors pallas_tune._ring_effective_blocks
+    (AG rings multiply [rows, k]×[k, n/d] chunks, RS rings
+    [rows, k/d]×[k/d, n]; bidirectional forms halve the rows), and the
+    wire cost prices the collective's payload with comms_model's
+    RING_WIRE_FACTOR."""
+    from tpu_matmul_bench.analysis.comms_model import (
+        RING_WIRE_FACTOR,
+        matmul_out_itemsize,
+    )
+    import jax.numpy as jnp
+
+    kind = "rs" if "rs" in ring else "ag"
+    bidir = "bidir" in ring
+    rows = size // world
+    if bidir:
+        rows //= 2
+    if kind == "ag":
+        chunk_m, chunk_k, chunk_n = rows, size, size // world
+        collective, item = "all_gather", jnp.dtype(dtype).itemsize
+        payload = (size // world) * size * item  # per-shard operand bytes
+    else:
+        chunk_m, chunk_k, chunk_n = rows, size // world, size
+        collective = "reduce_scatter"
+        item = matmul_out_itemsize(jnp.dtype(dtype))
+        payload = size * size * item  # the partial product being reduced
+    return {
+        "ring": ring, "world": world, "collective": collective,
+        "chunk_m": chunk_m, "chunk_k": chunk_k, "chunk_n": chunk_n,
+        "wire_bytes": int(RING_WIRE_FACTOR[collective](world) * payload),
+    }
+
+
+def prune(m: int, k: int, n: int, dtype: Any,
+          candidates: Iterable[tuple[int, int, int]] | None = None,
+          *, top_k: int = DEFAULT_TOP_K,
+          ring: str | None = None, world: int = 1) -> PruneReport:
+    """Rank the candidate space for C[m,n] = A[m,k]·B[k,n] and keep the
+    top-K feasible blockings (the set `tune fill` measures).
+
+    With `ring`, the ranked problem becomes the per-step chunk the ring
+    kernel actually multiplies, and the report carries the collective's
+    wire-byte floor for context."""
+    from tpu_matmul_bench.benchmarks.pallas_tune import DEFAULT_CANDIDATES
+
+    if candidates is None:
+        candidates = list(DEFAULT_CANDIDATES)
+    import jax.numpy as jnp
+
+    dtype_name = jnp.dtype(dtype).name
+    wire = None
+    pm, pk, pn = m, k, n
+    if ring is not None:
+        wire = ring_wire(ring, world, max(m, k, n), dtype)
+        pm, pk, pn = wire["chunk_m"], wire["chunk_k"], wire["chunk_n"]
+    ranked, requested = rank_candidates(pm, pk, pn, dtype, candidates)
+    feasible = [c for c in ranked if c.feasible]
+    infeasible = [c for c in ranked if not c.feasible]
+    kept = feasible[:top_k]
+    return PruneReport(
+        m=pm, k=pk, n=pn, dtype=dtype_name,
+        candidates=ranked,
+        kept=[c.blocks for c in kept],
+        dropped_infeasible=infeasible,
+        dropped_ranked=feasible[top_k:],
+        trials_before=requested,
+        trials_after=len(kept),
+        wire=wire,
+    )

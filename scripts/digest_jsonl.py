@@ -188,6 +188,35 @@ def _digest_tune(recs: list[dict]) -> None:
           + ("" if jax_now else " [no jax importable: staleness unchecked]"))
 
 
+def _digest_obs(recs: list[dict]) -> None:
+    """Obs-snapshot digest (obs_snapshot.jsonl from `--obs-dir` / a
+    campaign's obs/): per run_id, counter deltas between the first and
+    last snapshot plus the final histogram quantile ladder — the whole
+    run's metric story in one table without replaying every tick."""
+    by_run: dict[str, list[dict]] = {}
+    for r in recs:
+        if r.get("record_type") == "obs_snapshot":
+            by_run.setdefault(str(r.get("run_id")), []).append(r)
+    for run_id, snaps in sorted(by_run.items()):
+        snaps.sort(key=lambda s: (s.get("seq") or 0))
+        first, last = snaps[0], snaps[-1]
+        span_s = (last.get("ts_unix") or 0) - (first.get("ts_unix") or 0)
+        print(f"  run={run_id} {len(snaps)} snapshots over {span_s:.2f}s")
+        first_c = first.get("counters") or {}
+        for key, val in sorted((last.get("counters") or {}).items()):
+            delta = val - (first_c.get(key) or 0)
+            dbit = f" (+{delta:g} in window)" if len(snaps) > 1 else ""
+            print(f"    {key:<48} {val:>12g}{dbit}")
+        for key, val in sorted((last.get("gauges") or {}).items()):
+            print(f"    {key:<48} {val:>12g} [gauge]")
+        for key, h in sorted((last.get("histograms") or {}).items()):
+            if not h.get("count"):
+                continue
+            print(f"    {key:<48} n={h.get('count')} "
+                  f"p50={h.get('p50')} p95={h.get('p95')} "
+                  f"p99={h.get('p99')} max={h.get('max')}")
+
+
 def _is_campaign_dir(p: Path) -> bool:
     return (p / _JOURNAL).exists() or (p / _JOBS_SUBDIR).is_dir()
 
@@ -292,10 +321,16 @@ def main(paths: list[str]) -> None:
         for m in manifests:
             sha = (m.get("git_sha") or "?")[:9]
             cfg = m.get("config") or {}
+            trace = m.get("trace") or {}
+            run_bits = ""
+            if trace.get("run_id"):
+                run_bits = f" run={trace['run_id']}"
+                if trace.get("parent_run_id"):
+                    run_bits += f"<{trace['parent_run_id']}"
             print(f"  [manifest] schema=v{m.get('schema_version')} "
                   f"jax={m.get('jax_version')} "
                   f"{m.get('device_count')}x{m.get('device_kind')} "
-                  f"git={sha} dtype={cfg.get('dtype')} "
+                  f"git={sha} dtype={cfg.get('dtype')}{run_bits} "
                   f"argv={' '.join(m.get('argv') or [])}")
         if any(r.get("record_type") in ("lint_finding", "lint_summary")
                for r in recs):
@@ -303,6 +338,9 @@ def main(paths: list[str]) -> None:
             continue
         if any(r.get("record_type") == "tune_cell" for r in recs):
             _digest_tune(recs)
+            continue
+        if any(r.get("record_type") == "obs_snapshot" for r in recs):
+            _digest_obs(recs)
             continue
         recs.sort(key=_rank_key)
         for r in recs:

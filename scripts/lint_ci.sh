@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Three layers, cheapest first:
+# Four layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -21,6 +21,11 @@
 #      (every cell cites a live artifact), plus a program-digest drift
 #      recompute under the CI jax. Fails when the DB is torn, cites dead
 #      artifacts, or went stale (fix: scripts/regen_tune_db.py).
+#   4. python -m tpu_matmul_bench obs selftest — runs a tiny serve bench
+#      on CPU and fails unless it emitted at least one metrics snapshot
+#      whose counters reconcile with the ledger's extras["serve"] block
+#      and whose cost_analysis attribution agrees with the hand FLOPs
+#      model (the dynamic halves of lint's OBS-001/OBS-002).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -36,3 +41,6 @@ JAX_PLATFORMS=cpu python -m tpu_matmul_bench lint --fail-on error "$@"
 
 echo "== tune selftest (tuning-DB schema + provenance + drift) =="
 JAX_PLATFORMS=cpu python -m tpu_matmul_bench tune selftest
+
+echo "== obs selftest (metrics bus / ledger reconciliation) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench obs selftest

@@ -74,6 +74,32 @@ def test_smoke_artifacts(campaign_dir):
     assert (campaign_dir / "spec.json").exists()
 
 
+def test_campaign_trace_and_run_id_propagation(campaign_dir):
+    """Tentpole acceptance: one merged Chrome trace spanning all jobs,
+    each job manifest naming the campaign run that spawned it, and live
+    snapshots in <dir>/obs for `obs status`."""
+    merged = json.loads((campaign_dir / "trace.json").read_text())
+    evs = merged["traceEvents"]
+    labels = {e["args"]["name"] for e in evs if e.get("ph") == "M"}
+    assert labels == {"small", "large"}
+    assert len({e["pid"] for e in evs}) == 2  # one pid per job
+    assert any(e.get("ph") == "X" for e in evs)
+
+    from tpu_matmul_bench.obs.export import read_snapshots
+
+    snaps = read_snapshots(campaign_dir / "obs" / "obs_snapshot.jsonl")
+    assert snaps, "campaign exported no obs snapshots"
+    campaign_run = snaps[-1]["run_id"]
+    assert snaps[-1]["counters"]['campaign_jobs_total{status="done"}'] == 2
+
+    for job_id in ("small", "large"):
+        ledger = campaign_dir / "jobs" / f"{job_id}.jsonl"
+        manifest = json.loads(ledger.read_text().splitlines()[0])
+        trace = manifest["trace"]
+        assert trace["run_id"]  # every child minted its own id
+        assert trace["parent_run_id"] == campaign_run
+
+
 def test_status_and_dry_run_in_process(campaign_dir, tmp_path, capsys):
     assert cli.main(["status", str(campaign_dir)]) == 0
     out = capsys.readouterr().out
@@ -196,3 +222,28 @@ def test_sigkill_midcampaign_then_resume_completes(tmp_path):
             if '"record_type": "manifest"' in line or
             '"record_type":"manifest"' in line)
         assert manifests <= 1
+
+    # run-id propagation across the kill: every manifest names a
+    # spawning campaign run, and the pre-kill jobs name a DIFFERENT one
+    # than the resumed jobs — two campaign processes, two run ids
+    from tpu_matmul_bench.campaign.spec import load_spec
+
+    job_id_by_fp = {j.fingerprint: j.job_id
+                    for j in load_spec(d / "spec.json").jobs}
+    done_ids = {job_id_by_fp[fp] for fp in done_before}
+    parents = {}
+    for n in (1, 2, 3):
+        manifest = json.loads(
+            (d / "jobs" / f"j{n}.jsonl").read_text().splitlines()[0])
+        parents[f"j{n}"] = manifest["trace"]["parent_run_id"]
+    assert all(parents.values())
+    pre_kill = {parents[j] for j in done_ids}
+    resumed = {parents[j] for j in parents if j not in done_ids}
+    assert pre_kill.isdisjoint(resumed)
+
+    # the resume merged every job — including the killed one's rerun —
+    # into a single campaign timeline
+    merged = json.loads((d / executor.MERGED_TRACE_NAME).read_text())
+    labels = {e["args"]["name"] for e in merged["traceEvents"]
+              if e.get("ph") == "M"}
+    assert labels == {"j1", "j2", "j3"}

@@ -1,0 +1,362 @@
+"""Observability bus tests: registry under concurrent writers, run-context
+propagation, the exporter + `obs status`, Chrome-trace merging (including
+the partial JSONL a SIGKILLed child leaves), cost-analysis attribution,
+and the end-to-end selftest against a real CPU serve bench.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_matmul_bench.obs import attribution
+from tpu_matmul_bench.obs import cli as obs_cli
+from tpu_matmul_bench.obs import context as obs_context
+from tpu_matmul_bench.obs import export as obs_export
+from tpu_matmul_bench.obs.registry import (
+    MetricsRegistry,
+    reset_registry,
+    series_key,
+)
+
+from tests.envutil import scrubbed_env
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _reporting_override_guard():
+    """obs_cli.main forces reporting on; restore the prior override so
+    in-process CLI tests don't leak global state into other tests."""
+    from tpu_matmul_bench.utils.reporting import (
+        force_reporting_process,
+        reporting_process_override,
+    )
+
+    prev = reporting_process_override()
+    yield
+    force_reporting_process(prev)
+
+
+# ---------------------------------------------------------------- registry
+
+def test_series_key_sorts_labels():
+    assert series_key("x_total", {}) == "x_total"
+    assert series_key("x_total", {"b": 1, "a": "v"}) == 'x_total{a="v",b="1"}'
+
+
+def test_registry_concurrent_writers_lose_nothing():
+    """The thread-safety contract: 8 writer threads hammering counters
+    (4 shared series) and one shared histogram; the snapshot must hold
+    exactly every write."""
+    reg = MetricsRegistry()
+    n_threads, n_incs = 8, 2000
+    counters = [reg.counter("obs_test_total", worker=str(i % 4))
+                for i in range(n_threads)]
+    hist = reg.histogram("obs_test_ms")
+    gauge = reg.gauge("obs_test_depth")
+
+    def work(c, tid):
+        for j in range(n_incs):
+            c.inc()
+            hist.observe(float(j % 100))
+            gauge.set(tid)
+
+    threads = [threading.Thread(target=work, args=(c, i))
+               for i, c in enumerate(counters)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    snap = reg.snapshot()
+    per_series = [snap["counters"][f'obs_test_total{{worker="{w}"}}']
+                  for w in "0123"]
+    assert per_series == [2 * n_incs] * 4
+    assert snap["histograms"]["obs_test_ms"]["count"] == n_threads * n_incs
+    assert snap["gauges"]["obs_test_depth"] in range(n_threads)
+
+
+def test_counter_instances_aggregate_per_series():
+    """Two instruments on one series: each keeps its own value (the
+    compat-view contract serve's per-window stats rely on) while the
+    snapshot shows the sum."""
+    reg = MetricsRegistry()
+    a = reg.counter("dup_total")
+    b = reg.counter("dup_total")
+    a.inc(3)
+    b.inc(4)
+    assert (a.value, b.value) == (3, 4)
+    assert reg.snapshot()["counters"]["dup_total"] == 7
+
+
+def test_histogram_window_bounds_memory_not_count():
+    reg = MetricsRegistry()
+    h = reg.histogram("w_ms", window=16)
+    for i in range(100):
+        h.observe(float(i))
+    summary = reg.snapshot()["histograms"]["w_ms"]
+    assert summary["count"] == 100  # lifetime count survives the window
+    assert summary["sum"] == sum(range(100))
+    assert summary["max"] == 99.0
+    assert summary["p50"] >= 84.0  # quantiles come from the last 16 only
+
+
+# ----------------------------------------------------------------- context
+
+def test_run_context_minted_once_and_env_pinned(monkeypatch):
+    obs_context.reset_context()
+    try:
+        monkeypatch.setenv(obs_context.ENV_RUN_ID, "feedc0ffee12")
+        monkeypatch.setenv(obs_context.ENV_PARENT_RUN_ID, "abad1dea0000")
+        ctx = obs_context.current()
+        assert ctx.run_id == "feedc0ffee12"
+        assert ctx.parent_run_id == "abad1dea0000"
+        assert ctx.pid == os.getpid()
+        assert obs_context.current() is ctx  # minted once
+
+        block = obs_context.trace_block()
+        assert block == {"run_id": "feedc0ffee12", "pid": os.getpid(),
+                         "parent_run_id": "abad1dea0000"}
+
+        env = obs_context.child_env({"PATH": "/bin",
+                                     obs_context.ENV_RUN_ID: "feedc0ffee12"})
+        assert env[obs_context.ENV_PARENT_RUN_ID] == "feedc0ffee12"
+        assert obs_context.ENV_RUN_ID not in env  # children mint their own
+        assert env["PATH"] == "/bin"
+    finally:
+        obs_context.reset_context()
+
+
+def test_manifest_carries_trace_block(monkeypatch):
+    from tpu_matmul_bench.utils.telemetry import build_manifest
+
+    obs_context.reset_context()
+    monkeypatch.delenv(obs_context.ENV_RUN_ID, raising=False)
+    monkeypatch.delenv(obs_context.ENV_PARENT_RUN_ID, raising=False)
+    try:
+        man = build_manifest(argv=["x"])
+        ctx = obs_context.current()
+        assert man["trace"]["run_id"] == ctx.run_id
+        assert man["trace"]["pid"] == os.getpid()
+    finally:
+        obs_context.reset_context()
+
+
+# ---------------------------------------------------------------- exporter
+
+def test_exporter_write_once_and_prometheus(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("probe_total", kind="a").inc(5)
+    reg.gauge("probe_depth").set(2)
+    h = reg.histogram("probe_ms")
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+
+    exp = obs_export.SnapshotExporter(tmp_path / "obs", registry=reg,
+                                      run_id="runx", interval_s=60.0)
+    snap = exp.write_once()
+    assert snap["run_id"] == "runx" and snap["seq"] == 1
+    assert snap["counters"]['probe_total{kind="a"}'] == 5
+
+    snaps = obs_export.read_snapshots(tmp_path / "obs" /
+                                      obs_export.SNAPSHOT_NAME)
+    assert [s["seq"] for s in snaps] == [1]
+
+    prom = (tmp_path / "obs" / obs_export.PROM_NAME).read_text()
+    assert "# TYPE probe_total counter" in prom
+    assert 'probe_total{kind="a"} 5' in prom
+    assert 'probe_ms{quantile="0.5"} 2.0' in prom
+    assert "probe_ms_count 3" in prom
+
+
+def test_exporter_stop_flushes_final_snapshot(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("late_total").inc()
+    # interval far beyond the test's life: only stop()'s flush can land
+    with obs_export.SnapshotExporter(tmp_path, registry=reg,
+                                     interval_s=3600.0) as exp:
+        pass
+    assert exp.snapshots_written >= 1
+    last = obs_export.latest_snapshot(tmp_path)
+    assert last is not None and last["counters"]["late_total"] == 1
+
+
+def test_read_snapshots_tolerates_torn_tail(tmp_path):
+    f = tmp_path / obs_export.SNAPSHOT_NAME
+    good = json.dumps({"record_type": "obs_snapshot", "run_id": "r",
+                       "seq": 1, "counters": {}})
+    f.write_text(good + "\n" + '{"record_type": "obs_sna')
+    assert [s["seq"] for s in obs_export.read_snapshots(f)] == [1]
+
+
+def test_obs_status_reads_snapshot(tmp_path, capsys):
+    reg = MetricsRegistry()
+    reg.counter("probe_total").inc(5)
+    obs_export.SnapshotExporter(tmp_path / "obs", registry=reg,
+                                run_id="statusrun",
+                                interval_s=60.0).write_once()
+    # table form, resolving through the parent dir like a campaign dir
+    assert obs_cli.main(["status", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "run=statusrun" in out and "probe_total" in out
+    # --json form round-trips the record
+    assert obs_cli.main(["status", str(tmp_path / "obs"), "--json"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["counters"]["probe_total"] == 5
+
+
+def test_obs_status_missing_dir_exits_2(tmp_path):
+    with pytest.raises(SystemExit) as ei:
+        obs_cli.main(["status", str(tmp_path / "nowhere")])
+    assert ei.value.code == 2
+
+
+# ------------------------------------------------------------- trace merge
+
+def test_merge_chrome_traces_handles_partial_jsonl(tmp_path):
+    complete = tmp_path / "a.trace.json"
+    complete.write_text(json.dumps({"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 123,
+         "args": {"name": "original"}},
+        {"ph": "X", "name": "compile", "pid": 123, "tid": 1,
+         "ts": 10.0, "dur": 5.0},
+    ]}))
+    partial = tmp_path / "b.trace.json"
+    partial.write_text(
+        json.dumps({"ph": "X", "name": "phase", "pid": 9, "tid": 1,
+                    "ts": 1.0, "dur": 2.0}) + "\n"
+        + '{"ph": "X", "name": "torn-mid-wri')  # SIGKILL tore this line
+    merged = obs_context.merge_chrome_traces([
+        ("job-a", complete, 0.0), ("job-b", partial, 1000.0)])
+    evs = merged["traceEvents"]
+    meta = {(e["pid"], e["args"]["name"])
+            for e in evs if e.get("ph") == "M"}
+    assert meta == {(1, "job-a"), (2, "job-b")}  # per-job pids, our labels
+    xs = {e["name"]: e for e in evs if e.get("ph") == "X"}
+    assert xs["compile"]["pid"] == 1 and xs["compile"]["ts"] == 10.0
+    assert xs["phase"]["pid"] == 2 and xs["phase"]["ts"] == 1001.0
+    assert "torn-mid-wri" not in json.dumps(merged)
+
+
+def test_span_sink_survives_sigkill(tmp_path):
+    """The satellite fix: a campaign child killed mid-phase must leave
+    its already-closed spans on disk. The child flushes each span line
+    (fsynced) as it closes; SIGKILL then loses nothing already closed."""
+    trace = tmp_path / "child.trace.json"
+    child_src = (
+        "import sys, time\n"
+        "from tpu_matmul_bench.utils import telemetry\n"
+        "from tpu_matmul_bench.utils.reporting import force_reporting_process\n"
+        "force_reporting_process(True)\n"
+        "with telemetry.session(sys.argv[1]):\n"
+        "    with telemetry.span('phase-one'):\n"
+        "        pass\n"
+        "    print('SPAN_CLOSED', flush=True)\n"
+        "    time.sleep(120)\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child_src, str(trace)],
+        cwd=REPO, env=scrubbed_env("cpu"), stdout=subprocess.PIPE,
+        text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "SPAN_CLOSED" in line
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    events = obs_context.load_trace_events(trace)
+    assert [e["name"] for e in events if e.get("ph") == "X"] == ["phase-one"]
+
+
+# -------------------------------------------------------------- attribution
+
+class _FakeCompiled:
+    def __init__(self, result):
+        self._result = result
+
+    def cost_analysis(self):
+        if isinstance(self._result, Exception):
+            raise self._result
+        return self._result
+
+
+def test_attribution_block_normalizes_list_form():
+    m, k, n = 64, 32, 16
+    fake = _FakeCompiled([{"flops": float(2 * m * k * n),
+                           "bytes accessed": 1024.0}])
+    block = attribution.attribution_block(fake, m, k, n)
+    assert block["agrees"] and block["flops_ratio"] == 1.0
+    assert block["hand_model_flops"] == 2 * m * k * n
+    assert block["bytes_accessed"] == 1024.0
+    assert block["arithmetic_intensity"] == round(2 * m * k * n / 1024.0, 3)
+
+
+def test_attribution_disagreement_fires_obs_001():
+    m = k = n = 32
+    fake = _FakeCompiled({"flops": float(2 * m * k * n) * 1.5})
+    block = attribution.attribution_block(fake, m, k, n)
+    assert not block["agrees"]
+    findings = attribution.check_blocks({"entry": block}, "test-ledger")
+    assert len(findings) == 1
+    assert findings[0].rule == "OBS-001"
+    assert findings[0].severity == "error"
+    assert "test-ledger:entry" == findings[0].where
+
+
+def test_attribution_absent_or_broken_degrades_to_none():
+    assert attribution.attribution_block(
+        _FakeCompiled(RuntimeError("no analysis")), 8, 8, 8) is None
+    assert attribution.attribution_block(_FakeCompiled([]), 8, 8, 8) is None
+    assert attribution.check_blocks({}, "x") == []
+    assert attribution.check_blocks(None, "x") == []
+
+
+# ------------------------------------------------- end-to-end (jax, CPU)
+
+def test_bench_single_record_carries_cost_analysis():
+    from tpu_matmul_bench.benchmarks.matmul_benchmark import _bench_single
+    from tpu_matmul_bench.utils.config import BenchConfig
+
+    config = BenchConfig(
+        sizes=[64], iterations=1, warmup=0, dtype_name="float32",
+        mode=None, device="cpu", num_devices=1, json_out=None,
+        matmul_impl="xla", seed=0)
+    rec = _bench_single(config, 64, "cpu")
+    block = rec.extras["cost_analysis"]
+    assert block["agrees"]
+    assert block["hand_model_flops"] == 2 * 64 ** 3
+
+
+def test_obs_selftest_in_process(tmp_path):
+    """The acceptance check, in-process: a real CPU serve bench must
+    emit a snapshot whose counters reconcile with the ledger and carry
+    an agreeing cost_analysis block — zero findings."""
+    try:
+        findings = obs_cli._selftest_findings(tmp_path)
+        assert findings == [], [f.message for f in findings]
+
+        ledger = tmp_path / "serve.jsonl"
+        recs = [json.loads(line)
+                for line in ledger.read_text().splitlines()]
+        (rec,) = [r for r in recs if r.get("record_type") != "manifest"]
+        blocks = rec["extras"]["cost_analysis"]
+        assert blocks and all(b["agrees"] for b in blocks.values())
+
+        snaps = obs_export.read_snapshots(
+            tmp_path / "obs" / obs_export.SNAPSHOT_NAME)
+        assert snaps, "serve bench exported no snapshot"
+        assert snaps[-1]["counters"]["serve_requests_total"] == \
+            rec["extras"]["serve"]["requests"]
+    finally:
+        reset_registry()  # the selftest reset the process-global bus

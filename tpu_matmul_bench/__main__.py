@@ -30,6 +30,10 @@ _PROGRAMS = {
     # load generator, reporting latency percentiles instead of sustained
     # TFLOP/s (serve/cli.py) — the latency-SLO complement to the sweeps
     "serve": "tpu_matmul_bench.serve.cli",
+    # the observability bus: live metrics snapshots of an in-flight
+    # campaign/serve run (`obs status`) and the end-to-end bus selftest
+    # (`obs selftest`) — registry/export/attribution live in obs/
+    "obs": "tpu_matmul_bench.obs.cli",
     # the static contract auditor: jaxpr/HLO checks for every impl x mode
     # plus offline spec validation — CPU-only, trace-time, no TPU needed
     # (analysis/cli.py)

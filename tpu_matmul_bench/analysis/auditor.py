@@ -479,6 +479,71 @@ def _audit_fingerprint() -> list[Finding]:
     return audit_fingerprints()
 
 
+# representative shapes for the obs attribution audit: one square sweep
+# size and one rectangle, enough to catch a wrong-op-count model without
+# compiling the full registry surface
+_OBS_AUDIT_SHAPES = ((256, 256, 256), (256, 512, 128))
+
+
+def audit_obs() -> list[Finding]:
+    """OBS-001/OBS-002 statically: AOT-compile representative matmuls and
+    check the XLA cost_analysis attribution against the hand FLOPs model,
+    then round-trip the registry → exporter path in-process (a registry
+    whose counters can't land in a snapshot means every instrumented
+    entrypoint would trip OBS-002 at run time)."""
+    import json as _json
+
+    from tpu_matmul_bench.obs import attribution, export
+    from tpu_matmul_bench.obs.registry import MetricsRegistry
+    from tpu_matmul_bench.ops.matmul import make_matmul
+
+    findings: list[Finding] = []
+    blocks: dict[str, dict[str, Any]] = {}
+    for m, k, n in _OBS_AUDIT_SHAPES:
+        where = f"obs:attribution:{m}x{k}x{n}"
+        mm = make_matmul("xla")
+        shapes = (jax.ShapeDtypeStruct((m, k), "float32"),
+                  jax.ShapeDtypeStruct((k, n), "float32"))
+        compiled = mm.lower(*shapes).compile()
+        block = attribution.attribution_block(compiled, m, k, n)
+        if block is None:
+            findings.append(Finding(
+                "OBS-001", where,
+                "compiled matmul reported no cost_analysis flops — "
+                "attribution cannot be cross-checked on this backend",
+                severity="warn"))
+            continue
+        blocks[where] = block
+    findings.extend(attribution.check_blocks(blocks, "obs:attribution"))
+
+    # registry → snapshot round trip, no filesystem needed
+    where = "obs:roundtrip"
+    reg = MetricsRegistry()
+    reg.counter("lint_probe_total", kind="audit").inc(3)
+    reg.histogram("lint_probe_ms").observe(1.5)
+    snap = export.snapshot_record(registry=reg, run_id="lint", seq=0)
+    try:
+        snap = _json.loads(_json.dumps(snap))
+    except (TypeError, ValueError) as e:
+        findings.append(Finding(
+            "OBS-002", where,
+            f"snapshot record is not JSON-serializable: {e}"))
+        return findings
+    if snap.get("counters", {}).get(
+            'lint_probe_total{kind="audit"}') != 3:
+        findings.append(Finding(
+            "OBS-002", where,
+            "registry counter did not survive the snapshot round trip",
+            details={"counters": snap.get("counters")}))
+    prom = export.prometheus_text(snap)
+    if "lint_probe_total" not in prom or "quantile=" not in prom:
+        findings.append(Finding(
+            "OBS-002", where,
+            "prometheus exposition is missing the probe series or the "
+            "histogram quantile labels"))
+    return findings
+
+
 AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "modes": audit_modes,
     "impls": audit_impls,
@@ -486,6 +551,7 @@ AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "pallas": audit_pallas_static,
     "registry": audit_registry,
     "tune": audit_tune,
+    "obs": audit_obs,
     "sched": _audit_sched,
     "memory": _audit_memory,
     "fingerprint": _audit_fingerprint,

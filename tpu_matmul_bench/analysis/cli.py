@@ -58,7 +58,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "under the repo root)")
     parser.add_argument("--skip", nargs="*", default=(),
                         choices=("modes", "impls", "donation", "pallas",
-                                 "registry", "tune", "specs", "sched",
+                                 "registry", "tune", "obs", "specs", "sched",
                                  "memory", "fingerprint"),
                         help="audit groups to skip")
     parser.add_argument("--no-hlo", action="store_true",

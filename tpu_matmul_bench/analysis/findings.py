@@ -92,6 +92,14 @@ RULES: dict[str, tuple[Severity, str]] = {
                          "cell (jax version moved or the routed program's "
                          "digest drifted) — re-measure or re-promote the "
                          "cell"),
+    "OBS-001": ("error", "XLA cost_analysis attribution disagrees with the "
+                         "hand FLOPs model (utils.metrics.calculate_tflops) "
+                         "beyond tolerance — reported TFLOP/s are computed "
+                         "from the wrong op count"),
+    "OBS-002": ("error", "instrumented entrypoint emitted no metrics "
+                         "snapshot, or its snapshot counters do not "
+                         "reconcile with the ledger's extras — the obs bus "
+                         "and the ledger disagree about what happened"),
 }
 
 

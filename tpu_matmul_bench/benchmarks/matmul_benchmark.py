@@ -102,6 +102,24 @@ def _effective_warmup(config: BenchConfig) -> int:
     return effective_warmup(config.timing, config.iterations, config.warmup)
 
 
+def _cost_extras(mm, m: int, k: int, n: int, dtype) -> dict:
+    """Best-effort ``extras["cost_analysis"]``: AOT-compile the timed
+    matmul at the operand shapes and record XLA's own flops/bytes books
+    next to the hand model (obs/attribution.py). The persistent
+    compilation cache makes this a re-lookup, not a second compile; any
+    failure degrades to no block — attribution never gates a run."""
+    from tpu_matmul_bench.obs import attribution
+
+    try:
+        compiled = jax.jit(mm).lower(
+            jax.ShapeDtypeStruct((m, k), dtype),
+            jax.ShapeDtypeStruct((k, n), dtype)).compile()
+        block = attribution.attribution_block(compiled, m, k, n)
+    except Exception:  # noqa: BLE001 — best-effort evidence only
+        return {}
+    return {"cost_analysis": block} if block else {}
+
+
 def _bench_single(
     config: BenchConfig, size: int, device_kind: str, device: jax.Device | None = None
 ) -> BenchmarkRecord:
@@ -120,6 +138,7 @@ def _bench_single(
         extras = _base_extras(config, t)
         extras.update(auto_extras(config.matmul_impl, size, size, size,
                                   device_kind, config.dtype))
+        extras.update(_cost_extras(mm, size, size, size, config.dtype))
         if config.percentiles:
             extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
         if config.samples:
@@ -214,6 +233,7 @@ def _bench_rect(
         extras = {"shape": f"{m}x{k}x{n}", **_base_extras(config, t)}
         extras.update(auto_extras(config.matmul_impl, m, n, k,
                                   device_kind, config.dtype))
+        extras.update(_cost_extras(mm, m, k, n, config.dtype))
         if config.percentiles:
             extras["latency_ms"] = latency_percentiles_ms(mm, (a, b), config)
         if config.samples:

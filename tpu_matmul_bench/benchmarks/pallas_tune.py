@@ -108,6 +108,22 @@ def _candidate_fn(eff: tuple[int, int, int], grid_order: str = "mnk",
         a, b, block_m=bm, block_n=bn, block_k=bk, grid_order=grid_order))
 
 
+def _candidate_cost(mm, a, b, m: int, k: int, n: int) -> dict:
+    """Best-effort ``cost_analysis`` extras for one tuned candidate —
+    XLA's flops/bytes attribution of the compiled blocked kernel next to
+    the hand model (obs/attribution.py). The candidate was just timed,
+    so `.lower().compile()` resolves from the jit cache; failures (e.g.
+    a backend without cost_analysis) degrade to no block."""
+    from tpu_matmul_bench.obs import attribution
+
+    try:
+        compiled = mm.lower(a, b).compile()
+        block = attribution.attribution_block(compiled, m, k, n)
+    except Exception:  # noqa: BLE001 — attribution never fails a tune run
+        return {}
+    return {"cost_analysis": block} if block else {}
+
+
 def _structural_extras(grid_order: str, ksplit: int) -> dict:
     """Record extras for the non-default structural axes — a baked row
     needs to know the order/splits that produced the number, not just
@@ -412,7 +428,8 @@ def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
                     extras = {"block_m": bm, "block_n": bn, "block_k": bk,
                               **_structural_extras(args.grid_order,
                                                    eff_ks),
-                              **protocol_extras(config.timing, t), **verdict}
+                              **protocol_extras(config.timing, t), **verdict,
+                              **_candidate_cost(mm, a, b, m, k, n)}
                     if rect:
                         extras["shape"] = label
                     if config.precision != "default":

@@ -132,6 +132,9 @@ def _cmd_run(args: argparse.Namespace) -> int:
     print(f"campaign: {len(done)}/{len(outcomes)} jobs done"
           + (f", {len(failed)} FAILED: "
              + ", ".join(o.job.job_id for o in failed) if failed else ""))
+    merged = Path(args.campaign_dir) / executor.MERGED_TRACE_NAME
+    if merged.exists():
+        print(f"campaign: merged trace at {merged}")
     return 1 if failed else 0
 
 

@@ -31,11 +31,15 @@ from typing import Any, Callable, Mapping
 
 from tpu_matmul_bench.campaign import state
 from tpu_matmul_bench.campaign.spec import CampaignSpec, Job
+from tpu_matmul_bench.obs import context as obs_context
+from tpu_matmul_bench.obs.registry import get_registry
 from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.errors import is_transport_message
 
 JOBS_SUBDIR = "jobs"
 SPEC_COPY_NAME = "spec.json"
+OBS_SUBDIR = "obs"
+MERGED_TRACE_NAME = "trace.json"
 
 # backoff grows base * 2^(attempt-1), capped — a transport-dead tunnel
 # needs minutes, not unbounded hours (measure_r5.sh used 180 s..900 s)
@@ -72,13 +76,20 @@ def job_paths(campaign_dir: str | Path, job: Job) -> tuple[Path, Path]:
     return jobs / f"{job.job_id}.jsonl", jobs / f"{job.job_id}.log"
 
 
+def job_trace_path(campaign_dir: str | Path, job: Job) -> Path:
+    """The per-job Chrome trace the child writes (incrementally fsynced
+    via telemetry's span sink) and the campaign merger reads."""
+    return Path(campaign_dir) / JOBS_SUBDIR / f"{job.job_id}.trace.json"
+
+
 def job_command(job: Job, campaign_dir: str | Path,
                 ledger: Path) -> list[str]:
-    """The child argv: the program CLI with the per-job ledger injected.
-    `{dir}` placeholders resolve here — after fingerprinting."""
+    """The child argv: the program CLI with the per-job ledger and trace
+    injected. `{dir}` placeholders resolve here — after fingerprinting."""
     argv = [a.replace("{dir}", str(campaign_dir)) for a in job.argv]
     return [sys.executable, "-m", "tpu_matmul_bench", job.program,
-            *argv, "--json-out", str(ledger)]
+            *argv, "--json-out", str(ledger),
+            "--trace-out", str(job_trace_path(campaign_dir, job))]
 
 
 def _default_launch(cmd: list[str], *, log: Path, timeout_s: float,
@@ -150,6 +161,10 @@ def _campaign_env(env: Mapping[str, str] | None) -> dict[str, str] | None:
     import os
 
     out = dict(os.environ if env is None else env)
+    # run-context propagation: the campaign's run_id rides into every
+    # child as TPU_BENCH_PARENT_RUN_ID, so each job manifest's `trace`
+    # block names the campaign run that produced it
+    out = obs_context.child_env(out)
     out.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     out.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     pkg_root = str(Path(__file__).resolve().parents[2])
@@ -198,7 +213,15 @@ def run_campaign(
     done_fps = state.finished_fingerprints(state.load_events(d))
     outcomes: list[JobOutcome] = []
 
-    with state.Journal(d / state.JOURNAL_NAME) as journal:
+    reg = get_registry()
+    jobs_done = {s: reg.counter("campaign_jobs_total", status=s)
+                 for s in (state.DONE, state.FAILED, state.SKIPPED)}
+    retries = reg.counter("campaign_job_retries_total")
+
+    from tpu_matmul_bench.obs.export import SnapshotExporter
+
+    with state.Journal(d / state.JOURNAL_NAME) as journal, \
+            SnapshotExporter(d / OBS_SUBDIR):
         # roster first: a kill during job 1 must still leave the full
         # plan visible to `status` (pending = journaled, not implicit)
         for job in spec.jobs:
@@ -210,16 +233,53 @@ def run_campaign(
             if job.fingerprint in done_fps:
                 journal.record(job.fingerprint, job.job_id, state.SKIPPED,
                                detail="resume: already done")
+                jobs_done[state.SKIPPED].inc()
                 outcomes.append(JobOutcome(job, state.SKIPPED, 0, ledger,
                                            "already done"))
                 continue
-            outcomes.append(_run_one(job, d, ledger, log, journal,
-                                     launch=launch, env=env, sleep=sleep))
+            outcome = _run_one(job, d, ledger, log, journal,
+                               launch=launch, env=env, sleep=sleep,
+                               retries_counter=retries)
+            jobs_done[outcome.status].inc()
+            outcomes.append(outcome)
+    merge_campaign_trace(d)
     return outcomes
 
 
+def merge_campaign_trace(campaign_dir: str | Path) -> Path | None:
+    """Merge every job's Chrome trace into one campaign-level timeline.
+
+    Jobs run sequentially, each with its own µs-zero clock; the journal's
+    last RUNNING timestamp per job is the wall-clock anchor that places
+    each job's spans on a shared axis (offset from the earliest start).
+    A killed child's trace is the incrementally-fsynced JSONL form —
+    `merge_chrome_traces` reads it as-is, so partial jobs still appear.
+    Returns the merged trace path, or None when no job wrote a trace.
+    """
+    d = Path(campaign_dir)
+    starts: dict[str, float] = {}  # job_id -> last RUNNING wall ts
+    for ev in state.load_events(d):
+        if ev.status == state.RUNNING:
+            starts[ev.job_id] = ev.ts
+    sources = []
+    for job_id, ts in sorted(starts.items(), key=lambda kv: kv[1]):
+        path = d / JOBS_SUBDIR / f"{job_id}.trace.json"
+        if path.exists():
+            sources.append((job_id, path, ts))
+    if not sources:
+        return None
+    epoch = min(ts for _, _, ts in sources)
+    merged = obs_context.merge_chrome_traces(
+        [(job_id, path, (ts - epoch) * 1e6)
+         for job_id, path, ts in sources])
+    out = d / MERGED_TRACE_NAME
+    out.write_text(json.dumps(merged) + "\n")
+    return out
+
+
 def _run_one(job: Job, d: Path, ledger: Path, log: Path,
-             journal: state.Journal, *, launch, env, sleep) -> JobOutcome:
+             journal: state.Journal, *, launch, env, sleep,
+             retries_counter=None) -> JobOutcome:
     cmd = job_command(job, d, ledger)
     max_attempts = job.retries + 1
     detail = ""
@@ -252,6 +312,8 @@ def _run_one(job: Job, d: Path, ledger: Path, log: Path,
             journal.record(job.fingerprint, job.job_id, state.RUNNING,
                            attempt=attempt, rc=result.rc,
                            detail=f"retry in {delay:.0f}s: {detail}")
+            if retries_counter is not None:
+                retries_counter.inc()
             sleep(delay)
     journal.record(job.fingerprint, job.job_id, state.FAILED,
                    attempt=max_attempts, rc=result.rc, detail=detail)

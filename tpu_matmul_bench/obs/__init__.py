@@ -1,0 +1,26 @@
+"""Process-wide observability bus (DESIGN §14).
+
+Four parts, stdlib-only at the core so the bus is importable from any
+entrypoint (including the backend-free campaign parent) without paying
+a jax import:
+
+- ``registry``  — thread-safe labeled counters / gauges / sliding-window
+  quantile histograms. Serve's worker threads and the campaign executor
+  record into one process-global registry with near-zero overhead.
+- ``context``   — run-context propagation: a run_id minted once per
+  process, the parent's id carried into campaign children via the
+  environment, stamped into every schema-v2 manifest's ``trace`` block,
+  plus the Chrome-trace merger that folds per-job timelines into one
+  campaign-level Perfetto view.
+- ``export``    — periodic snapshot exporter (JSONL + Prometheus text
+  exposition) behind ``python -m tpu_matmul_bench obs status``.
+- ``attribution`` — XLA ``cost_analysis()`` flops/bytes recorded at AOT
+  compile time, cross-checked against the hand model in
+  ``utils/metrics.py`` (lint rule OBS-001).
+"""
+
+from tpu_matmul_bench.obs.registry import (  # noqa: F401
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+)

@@ -1,0 +1,104 @@
+"""XLA cost-analysis attribution: compiler-counted flops vs the hand model.
+
+Every achieved-TFLOPS number in the ledgers divides measured time into
+`utils.metrics.matmul_flops` — a hand-derived 2·m·k·n. The compiler
+keeps its own books: ``compiled.cost_analysis()`` reports the flops and
+bytes-accessed XLA actually attributes to the optimized program. This
+module records that accounting wherever the repo AOT-compiles (serve's
+executable cache, the bench harness, tune fill) so every row carries
+*both* numbers and their ratio — and lint rule OBS-001 fires when they
+disagree beyond tolerance, which is exactly the signal that the hand
+model (and therefore every roofline/achieved-fraction claim built on
+it) no longer describes the compiled program.
+
+`cost_analysis()` is best-effort across backends and jax versions: it
+returns a dict on some, a one-element list of dicts on others (jax
+0.4.x CPU), and may raise on backends that don't implement it. All of
+that is normalized here; a missing analysis degrades to an absent
+block, never an error — attribution is evidence, not a gate on running.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_matmul_bench.utils import metrics
+
+# |compiler/hand − 1| above this fires OBS-001. XLA counts a plain dot
+# at exactly 2·m·k·n, so the slack only absorbs genuine program changes
+# (padding, fused epilogues) — anything past 10% means the hand model
+# is describing a different program than the one that ran.
+DEFAULT_TOLERANCE_PCT = 10.0
+
+
+def cost_analysis_dict(compiled: Any) -> dict[str, Any]:
+    """Normalized ``cost_analysis()`` of a compiled executable: a flat
+    dict of numeric properties, or ``{}`` when the backend doesn't
+    provide one."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    return {k: float(v) for k, v in ca.items()
+            if isinstance(v, (int, float))}
+
+
+def attribution_block(compiled: Any, m: int, k: int, n: int, *,
+                      tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
+                      ) -> dict[str, Any] | None:
+    """The ledger's ``cost_analysis`` block for one (m,k,n) matmul
+    executable, or None when the backend reports nothing usable."""
+    ca = cost_analysis_dict(compiled)
+    flops = ca.get("flops")
+    if not flops or flops <= 0:
+        return None
+    hand = metrics.matmul_flops(m, n, k)
+    ratio = flops / hand if hand else 0.0
+    block: dict[str, Any] = {
+        "flops": flops,
+        "hand_model_flops": hand,
+        "flops_ratio": round(ratio, 6),
+        "agrees": abs(ratio - 1.0) * 100.0 <= tolerance_pct,
+        "tolerance_pct": tolerance_pct,
+    }
+    ba = ca.get("bytes accessed", ca.get("bytes_accessed"))
+    if ba is not None:
+        block["bytes_accessed"] = ba
+        if ba > 0:
+            block["arithmetic_intensity"] = round(flops / ba, 3)
+    return block
+
+
+def achieved_fraction_pct(flops: float, time_s: float, device_kind: str,
+                          dtype: Any) -> float | None:
+    """The uniform achieved-fraction: compiler-attributed FLOPs over
+    measured time, as % of the device's theoretical peak. None when the
+    peak table doesn't know the device/dtype (e.g. CPU)."""
+    peak = metrics.theoretical_peak_tflops(device_kind, dtype)
+    if not peak or time_s <= 0:
+        return None
+    return round(100.0 * (flops / time_s / 1e12) / peak, 3)
+
+
+def check_blocks(blocks: dict[str, dict[str, Any]], where: str) -> list:
+    """OBS-001 findings for a ledger's cost_analysis blocks (keyed by
+    entry label). Imported lazily by lint/selftest — attribution itself
+    must not pull the analysis package in."""
+    from tpu_matmul_bench.analysis.findings import Finding
+
+    findings = []
+    for label, block in sorted((blocks or {}).items()):
+        if not isinstance(block, dict) or block.get("agrees", True):
+            continue
+        findings.append(Finding(
+            "OBS-001", f"{where}:{label}",
+            f"compiler attributes {block.get('flops'):.0f} flops but the "
+            f"hand model says {block.get('hand_model_flops'):.0f} "
+            f"(ratio {block.get('flops_ratio')}, tolerance "
+            f"{block.get('tolerance_pct')}%)",
+            details=dict(block)))
+    return findings

@@ -1,0 +1,235 @@
+"""`python -m tpu_matmul_bench obs {status,selftest}`.
+
+`status` reads the snapshot stream an instrumented run exports
+(``--obs-dir`` on serve, automatic under ``campaign run``) and prints
+the latest registry aggregate — usable **while the run is in flight**:
+the exporter appends fsynced JSONL lines, so tailing is safe. `--follow`
+keeps polling for new snapshots.
+
+`selftest` is the CI hook proving the whole bus end-to-end on CPU: it
+runs a real (tiny) serve bench with the exporter attached, then checks
+that (1) at least one snapshot landed (OBS-002), (2) the snapshot's
+counters reconcile with the ledger's ``extras["serve"]`` stats — the
+registry and the compat views must be two views of one truth — and
+(3) the ledger's ``cost_analysis`` block agrees with the hand FLOPs
+model within tolerance (OBS-001). Exit 0 = the bus is live and honest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import Any, Sequence
+
+from tpu_matmul_bench.obs import export as obs_export
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_matmul_bench obs",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    status = sub.add_parser(
+        "status", help="latest metrics snapshot of an instrumented run")
+    status.add_argument("path", nargs="?", default=".",
+                        help="snapshot file, its directory, or a "
+                             "campaign/serve dir with an obs/ subdir "
+                             "(default: .)")
+    status.add_argument("--json", action="store_true",
+                        help="print the raw snapshot record instead of "
+                             "the table")
+    status.add_argument("--follow", action="store_true",
+                        help="keep polling and print each new snapshot")
+    status.add_argument("--interval", type=float, default=0.5,
+                        help="poll interval with --follow (default "
+                             "%(default)s s)")
+    status.add_argument("--timeout", type=float, default=None,
+                        help="stop --follow after this many seconds "
+                             "without a new snapshot (default: poll "
+                             "until interrupted)")
+
+    selftest = sub.add_parser(
+        "selftest", help="end-to-end bus check on a tiny CPU serve run")
+    selftest.add_argument("--dir", default=None,
+                          help="working directory for the run's ledger "
+                               "and snapshots (default: a temp dir)")
+    selftest.add_argument("--keep", action="store_true",
+                          help="with --dir: leave the artifacts in place")
+    return p
+
+
+def _format_snapshot(snap: dict[str, Any]) -> list[str]:
+    age = time.time() - float(snap.get("ts_unix") or 0)
+    lines = [f"[obs] run={snap.get('run_id')} seq={snap.get('seq')} "
+             f"age={age:.1f}s"]
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    hists = snap.get("histograms") or {}
+    if counters or gauges:
+        width = max(len(k) for k in [*counters, *gauges])
+        for key in sorted(counters):
+            lines.append(f"  {key:<{width}}  {counters[key]:g}")
+        for key in sorted(gauges):
+            lines.append(f"  {key:<{width}}  {gauges[key]:g} (gauge)")
+    for key in sorted(hists):
+        h = hists[key]
+        lines.append(
+            f"  {key}  n={h.get('count')} p50={h.get('p50')} "
+            f"p95={h.get('p95')} p99={h.get('p99')} max={h.get('max')}")
+    if not (counters or gauges or hists):
+        lines.append("  (no instruments recorded yet)")
+    return lines
+
+
+def _cmd_status(args: argparse.Namespace) -> int:
+    f = obs_export.find_snapshot_file(args.path)
+    if f is None:
+        print(f"obs status: no {obs_export.SNAPSHOT_NAME} under "
+              f"{args.path!r} (is the run exporting? serve takes "
+              "--obs-dir; campaign runs export under <dir>/obs/)",
+              file=sys.stderr)
+        return 2
+    last_seq = None
+    idle_since = time.monotonic()
+    while True:
+        snaps = obs_export.read_snapshots(f)
+        if snaps and (last_seq is None
+                      or snaps[-1].get("seq") != last_seq):
+            last_seq = snaps[-1].get("seq")
+            idle_since = time.monotonic()
+            if args.json:
+                print(json.dumps(snaps[-1], sort_keys=True))
+            else:
+                print("\n".join(_format_snapshot(snaps[-1])))
+        elif not snaps and last_seq is None and not args.follow:
+            print(f"obs status: {f} holds no snapshot records yet",
+                  file=sys.stderr)
+            return 2
+        if not args.follow:
+            return 0
+        if args.timeout is not None \
+                and time.monotonic() - idle_since > args.timeout:
+            return 0
+        time.sleep(args.interval)
+
+
+SELFTEST_MIX = "96x96x96"
+SELFTEST_QPS = 300.0
+SELFTEST_DURATION_S = 0.3
+
+
+def _selftest_findings(workdir: Path) -> list:
+    """The three selftest checks; returns lint Findings (empty = pass)."""
+    from tpu_matmul_bench.analysis.findings import Finding
+    from tpu_matmul_bench.obs import attribution
+    from tpu_matmul_bench.obs.registry import reset_registry
+    from tpu_matmul_bench.serve.service import ServeConfig, run_bench
+
+    reset_registry()  # the reconciliation below needs a clean bus
+    obs_dir = workdir / "obs"
+    config = ServeConfig(
+        mix=SELFTEST_MIX, qps=SELFTEST_QPS, duration_s=SELFTEST_DURATION_S,
+        prewarm=True, json_out=str(workdir / "serve.jsonl"),
+        obs_dir=str(obs_dir))
+    (rec,) = run_bench(config)
+    serve = rec.extras["serve"]
+    findings: list = []
+
+    snaps = obs_export.read_snapshots(obs_dir / obs_export.SNAPSHOT_NAME)
+    if not snaps:
+        return [Finding(
+            "OBS-002", "obs-selftest",
+            "instrumented serve bench emitted no snapshot — the exporter "
+            "never ticked and never flushed on stop")]
+    snap = snaps[-1]
+    counters = snap.get("counters") or {}
+    hists = snap.get("histograms") or {}
+    cache, queue = serve["cache"], serve["queue"]
+    expectations = {
+        "serve_requests_total": serve["requests"],
+        'serve_cache_events{event="hit"}': cache["hits"],
+        'serve_cache_events{event="miss"}': cache["misses"],
+        'serve_queue_submitted_total': queue["submitted"],
+    }
+    for series, want in expectations.items():
+        got = counters.get(series, 0)
+        if got != want:
+            findings.append(Finding(
+                "OBS-002", f"obs-selftest:{series}",
+                f"snapshot counter {series} = {got} does not reconcile "
+                f"with the ledger's {want} — registry and compat view "
+                "have diverged", severity="error",
+                details={"snapshot": got, "ledger": want}))
+    hist_count = sum(h.get("count", 0) for k, h in hists.items()
+                     if k.startswith("serve_latency_ms"))
+    if hist_count != serve["requests"]:
+        findings.append(Finding(
+            "OBS-002", "obs-selftest:serve_latency_ms",
+            f"latency histogram holds {hist_count} observations for "
+            f"{serve['requests']} served requests"))
+
+    blocks = rec.extras.get("cost_analysis")
+    if not blocks:
+        findings.append(Finding(
+            "OBS-001", "obs-selftest",
+            "serve ledger carries no cost_analysis block — AOT compile "
+            "recorded no compiler attribution"))
+    else:
+        findings.extend(attribution.check_blocks(blocks, "obs-selftest"))
+    return findings
+
+
+def _force_cpu_backend() -> None:
+    """The selftest is a CPU contract (lint's discipline): never occupy
+    — or require — an accelerator. Best-effort: an in-process caller
+    that already initialized a backend passes through untouched."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized; trust the caller's setup
+
+
+def _cmd_selftest(args: argparse.Namespace) -> int:
+    _force_cpu_backend()
+    if args.dir:
+        workdir = Path(args.dir)
+        workdir.mkdir(parents=True, exist_ok=True)
+        findings = _selftest_findings(workdir)
+    else:
+        with tempfile.TemporaryDirectory(prefix="obs_selftest_") as tmp:
+            findings = _selftest_findings(Path(tmp))
+    for f in findings:
+        print(f"[{f.severity:5s}] {f.rule} {f.where}: {f.message}",
+              file=sys.stderr)
+    if findings:
+        print(f"obs selftest FAILED: {len(findings)} finding(s)",
+              file=sys.stderr)
+        return 1
+    print("obs selftest ok: snapshot emitted, counters reconcile with "
+          "the serve ledger, cost-analysis attribution agrees with the "
+          "hand FLOPs model")
+    return 0
+
+
+def main(argv: Sequence[str] | None = None):
+    # obs runs from campaign parents and bare shells alike — reporting on
+    from tpu_matmul_bench.utils.reporting import force_reporting_process
+
+    force_reporting_process(True)
+    args = build_parser().parse_args(argv)
+    rc = {"status": _cmd_status, "selftest": _cmd_selftest}[args.command](args)
+    if rc:
+        raise SystemExit(rc)
+    return rc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,204 @@
+"""Periodic snapshot exporter: JSONL snapshots + Prometheus exposition.
+
+An instrumented entrypoint (serve bench/selftest with ``--obs-dir``, a
+campaign run) attaches a `SnapshotExporter` to the process-global
+registry. A daemon thread wakes every ``interval_s`` and writes:
+
+- ``<dir>/obs_snapshot.jsonl`` — one appended, fsynced JSON line per
+  tick (``record_type: "obs_snapshot"``, the run_id, a sequence number,
+  and the full registry aggregate). Append + fsync is the same
+  durability discipline as the campaign journal: a SIGKILL loses at
+  most the in-flight line, and `obs status` can tail a *live* run's
+  file while the run is still writing it.
+- ``<dir>/metrics.prom`` — the latest snapshot in Prometheus text
+  exposition format (counters/gauges as-is, histograms as summaries
+  with quantile labels), atomically replaced each tick so a scraper
+  never reads a torn file.
+
+The exporter is also usable one-shot (`write_once`) — `obs selftest`
+and the tests drive it that way for determinism.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+from tpu_matmul_bench.obs import context as obs_context
+from tpu_matmul_bench.obs.registry import MetricsRegistry, get_registry
+
+SNAPSHOT_NAME = "obs_snapshot.jsonl"
+PROM_NAME = "metrics.prom"
+OBS_SNAPSHOT_RECORD_TYPE = "obs_snapshot"
+
+DEFAULT_INTERVAL_S = 0.25
+
+
+def snapshot_record(registry: MetricsRegistry | None = None, *,
+                    run_id: str | None = None, seq: int = 0) -> dict[str, Any]:
+    reg = registry if registry is not None else get_registry()
+    return {
+        "record_type": OBS_SNAPSHOT_RECORD_TYPE,
+        "run_id": run_id or obs_context.current().run_id,
+        "seq": seq,
+        "ts_unix": round(time.time(), 3),
+        **reg.snapshot(),
+    }
+
+
+def prometheus_text(snap: dict[str, Any]) -> str:
+    """Text exposition of one snapshot. Histograms render as Prometheus
+    *summaries*: pre-computed quantiles as ``{quantile="0.5"}`` labels
+    plus ``_count``/``_sum`` series (windowed quantiles can't be
+    re-aggregated server-side, which is exactly a summary's contract)."""
+    lines: list[str] = []
+    typed: set[str] = set()
+
+    def emit(series: str, kind: str, value: Any,
+             extra_label: str | None = None) -> None:
+        name = series.split("{", 1)[0]
+        if name not in typed:
+            lines.append(f"# TYPE {name} {kind}")
+            typed.add(name)
+        if extra_label:
+            if "{" in series:
+                series = series[:-1] + "," + extra_label + "}"
+            else:
+                series = series + "{" + extra_label + "}"
+        lines.append(f"{series} {value}")
+
+    for series, value in (snap.get("counters") or {}).items():
+        emit(series, "counter", value)
+    for series, value in (snap.get("gauges") or {}).items():
+        emit(series, "gauge", value)
+    for series, summary in (snap.get("histograms") or {}).items():
+        name, labels = series, ""
+        if "{" in series:
+            name, labels = series.split("{", 1)
+            labels = "{" + labels
+        for qlabel, q in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
+            if qlabel in summary:
+                emit(series, "summary", summary[qlabel],
+                     extra_label=f'quantile="{q}"')
+        emit(name + "_count" + labels, "summary", summary.get("count", 0))
+        emit(name + "_sum" + labels, "summary", summary.get("sum", 0.0))
+    return "\n".join(lines) + "\n"
+
+
+def _fsync_best_effort(fh: Any) -> None:
+    try:
+        os.fsync(fh.fileno())
+    except (AttributeError, OSError, ValueError, io.UnsupportedOperation):
+        pass  # captured/odd streams: flush is the best we can do
+
+
+class SnapshotExporter:
+    """Periodic writer of the registry aggregate (see module docstring)."""
+
+    def __init__(self, out_dir: str | Path, *,
+                 registry: MetricsRegistry | None = None,
+                 interval_s: float = DEFAULT_INTERVAL_S,
+                 run_id: str | None = None) -> None:
+        self.out_dir = Path(out_dir)
+        self.snapshot_path = self.out_dir / SNAPSHOT_NAME
+        self.prom_path = self.out_dir / PROM_NAME
+        self._registry = registry
+        self._interval_s = max(float(interval_s), 0.01)
+        self._run_id = run_id
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def snapshots_written(self) -> int:
+        return self._seq
+
+    def write_once(self) -> dict[str, Any]:
+        """One snapshot tick: append the JSONL line (fsynced), replace
+        the Prometheus file atomically. Returns the snapshot record."""
+        self.out_dir.mkdir(parents=True, exist_ok=True)
+        self._seq += 1
+        snap = snapshot_record(self._registry, run_id=self._run_id,
+                               seq=self._seq)
+        with open(self.snapshot_path, "a") as fh:
+            fh.write(json.dumps(snap, sort_keys=True) + "\n")
+            fh.flush()
+            _fsync_best_effort(fh)
+        tmp = self.prom_path.with_suffix(".prom.tmp")
+        tmp.write_text(prometheus_text(snap))
+        os.replace(tmp, self.prom_path)
+        return snap
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            self.write_once()
+
+    def start(self) -> "SnapshotExporter":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="obs-exporter", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the ticker and write one final snapshot — a run shorter
+        than the interval still lands its end-state (OBS-002's bar is
+        >= 1 snapshot per instrumented run)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.write_once()
+
+    def __enter__(self) -> "SnapshotExporter":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+def read_snapshots(path: str | Path) -> list[dict[str, Any]]:
+    """All snapshot records in a file, oldest first; torn lines (the
+    exporter may be mid-write — tailing a live run is the point) are
+    skipped."""
+    try:
+        lines = Path(path).read_text().splitlines()
+    except OSError:
+        return []
+    out = []
+    for line in lines:
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) \
+                and d.get("record_type") == OBS_SNAPSHOT_RECORD_TYPE:
+            out.append(d)
+    return out
+
+
+def find_snapshot_file(path: str | Path) -> Path | None:
+    """Resolve a user-given path to the snapshot file: the file itself,
+    a directory holding one, or a campaign/serve dir with an ``obs/``
+    subdirectory."""
+    p = Path(path)
+    if p.is_file():
+        return p
+    for candidate in (p / SNAPSHOT_NAME, p / "obs" / SNAPSHOT_NAME):
+        if candidate.is_file():
+            return candidate
+    return None
+
+
+def latest_snapshot(path: str | Path) -> dict[str, Any] | None:
+    f = find_snapshot_file(path)
+    if f is None:
+        return None
+    snaps = read_snapshots(f)
+    return snaps[-1] if snaps else None

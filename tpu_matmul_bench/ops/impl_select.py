@@ -156,9 +156,20 @@ def select_impl(m: int, n: int, k: int, device_kind: str,
     the committed store, loaded once per process."""
     cell = _db_lookup(m, n, k, device_kind, dtype, db)
     if cell is not None:
+        _route_counter("db").inc()
         return ImplChoice(cell.impl, cell.provenance_str,
                           source="db", blocks=cell.blocks)
+    _route_counter("table").inc()
     return table_select(m, n, k, device_kind, dtype)
+
+
+def _route_counter(source: str):
+    """`tune_route_total{source=db|table}` on the obs bus: how often
+    routing resolved from a measured DB cell vs the baked fallback table
+    — the DB-coverage signal `obs status` surfaces during a tune fill."""
+    from tpu_matmul_bench.obs.registry import get_registry
+
+    return get_registry().counter("tune_route_total", source=source)
 
 
 def resolve_route(m: int, n: int, k: int, device_kind: str, dtype: Any,

@@ -33,9 +33,13 @@ from typing import Any, Callable, Iterable
 
 import jax
 
+from tpu_matmul_bench.obs import attribution
+from tpu_matmul_bench.obs.registry import get_registry
 from tpu_matmul_bench.utils import telemetry
 
 DEFAULT_CAPACITY = 64
+
+_CACHE_EVENTS = ("hit", "miss", "eviction", "preload")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,6 +74,9 @@ class CacheEntry:
     warm_dispatch_s: float  # one dispatch + sync of the compiled program
     hits: int = 0
     built_at: float = 0.0
+    # XLA cost_analysis() attribution recorded at compile time
+    # (obs/attribution.py); None when the backend reports nothing
+    cost: dict[str, Any] | None = None
 
 
 class ExecutableCache:
@@ -96,11 +103,36 @@ class ExecutableCache:
         self._capacity = capacity
         self._entries: collections.OrderedDict[ExecKey, CacheEntry] = (
             collections.OrderedDict())
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
-        self.preloaded = 0
-        self.preload_s = 0.0
+        # counters live on the obs bus; each cache instance gets its own
+        # instruments (snapshot() aggregates across instances, while the
+        # compat properties below read only this cache's — so per-window
+        # ledger stats stay byte-identical to the pre-bus ad-hoc ints)
+        reg = get_registry()
+        self._events = {e: reg.counter("serve_cache_events", event=e)
+                        for e in _CACHE_EVENTS}
+        self._preload_seconds = reg.counter("serve_cache_preload_seconds")
+
+    # -- compat view: the pre-registry int attributes, now reading the
+    # -- bus instruments (stats()/tests keep their exact shape + values)
+    @property
+    def hits(self) -> int:
+        return int(self._events["hit"].value)
+
+    @property
+    def misses(self) -> int:
+        return int(self._events["miss"].value)
+
+    @property
+    def evictions(self) -> int:
+        return int(self._events["eviction"].value)
+
+    @property
+    def preloaded(self) -> int:
+        return int(self._events["preload"].value)
+
+    @property
+    def preload_s(self) -> float:
+        return self._preload_seconds.value
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -113,15 +145,15 @@ class ExecutableCache:
         entry = self._entries.get(key)
         if entry is not None:
             self._entries.move_to_end(key)
-            self.hits += 1
+            self._events["hit"].inc()
             entry.hits += 1
             return entry
-        self.misses += 1
+        self._events["miss"].inc()
         entry = self._compile(key)
         self._entries[key] = entry
         while len(self._entries) > self._capacity:
             self._entries.popitem(last=False)
-            self.evictions += 1
+            self._events["eviction"].inc()
         return entry
 
     def warm_start(self, keys: Iterable[ExecKey]) -> int:
@@ -137,8 +169,8 @@ class ExecutableCache:
         t0 = time.perf_counter()
         for key in sorted(fresh, key=lambda kk: kk.label):
             self.get(key)
-        self.preload_s += time.perf_counter() - t0
-        self.preloaded += len(fresh)
+        self._preload_seconds.inc(time.perf_counter() - t0)
+        self._events["preload"].inc(len(fresh))
         return len(fresh)
 
     def _compile(self, key: ExecKey) -> CacheEntry:
@@ -162,7 +194,9 @@ class ExecutableCache:
             sync(compiled(*ops))
             warm_s = time.perf_counter() - t0
         return CacheEntry(key=key, compiled=compiled, cold_compile_s=cold_s,
-                          warm_dispatch_s=warm_s, built_at=time.time())
+                          warm_dispatch_s=warm_s, built_at=time.time(),
+                          cost=attribution.attribution_block(
+                              compiled, key.m, key.k, key.n))
 
     def stats(self) -> dict[str, Any]:
         """Ledger-ready counters + per-entry cost split (ms, rounded)."""
@@ -188,3 +222,11 @@ class ExecutableCache:
                 for e in self._entries.values()
             },
         }
+
+    def cost_analysis(self) -> dict[str, Any]:
+        """Per-entry compiler attribution, keyed by entry label — the
+        ledger's additive ``cost_analysis`` block. Separate from
+        `stats()` so the byte-compatible ``extras["serve"]`` contract is
+        untouched."""
+        return {e.key.label: dict(e.cost)
+                for e in self._entries.values() if e.cost}

@@ -68,6 +68,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "truncating (the manifest is written only once)")
     p.add_argument("--trace-out", default=None,
                    help="Chrome-trace span timeline ('-' for stdout)")
+    p.add_argument("--obs-dir", default=None,
+                   help="export live metrics snapshots (obs_snapshot.jsonl "
+                        "+ metrics.prom) into this directory; tail them "
+                        "with `python -m tpu_matmul_bench obs status`")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -129,6 +133,7 @@ def _config_from(args: argparse.Namespace) -> ServeConfig:
         json_out=args.json_out,
         append_ledger=args.append,
         trace_out=args.trace_out,
+        obs_dir=args.obs_dir,
     )
     if args.cache_capacity is not None:
         kwargs["cache_capacity"] = args.cache_capacity

@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Any, Sequence
 
+from tpu_matmul_bench.obs.registry import get_registry
 from tpu_matmul_bench.utils.errors import QueueOverflowError
 
 # Default padding grid: the lane-aligned ladder from the smallest shape
@@ -108,8 +109,22 @@ class AdmissionQueue:
         self._items: list[tuple[float, Request]] = []  # (enqueue_wall, req)
         self._cond = threading.Condition()
         self._closed = False
-        self.submitted = 0
-        self.shed = 0
+        # obs-bus instruments (per-instance; see serve/cache.py for the
+        # compat-view rationale). The depth gauge tracks live queue
+        # length so `obs status` sees backpressure while it happens.
+        reg = get_registry()
+        self._m_submitted = reg.counter("serve_queue_submitted_total")
+        self._m_shed = reg.counter("serve_queue_shed_total")
+        self._m_depth = reg.gauge("serve_queue_depth")
+
+    # -- compat view: pre-registry int attributes, reading the bus
+    @property
+    def submitted(self) -> int:
+        return int(self._m_submitted.value)
+
+    @property
+    def shed(self) -> int:
+        return int(self._m_shed.value)
 
     @property
     def depth(self) -> int:
@@ -124,11 +139,12 @@ class AdmissionQueue:
             if self._closed:
                 raise RuntimeError("queue is closed to new submissions")
             if len(self._items) >= self.max_depth:
-                self.shed += 1
+                self._m_shed.inc()
                 raise QueueOverflowError(len(self._items), self.max_depth)
             req.submitted_at = time.perf_counter()
             self._items.append((req.submitted_at, req))
-            self.submitted += 1
+            self._m_submitted.inc()
+            self._m_depth.set(len(self._items))
             self._cond.notify()
         return req
 
@@ -175,6 +191,7 @@ class AdmissionQueue:
                 picked = set(id(r) for r in batch)
                 self._items = [it for it in self._items
                                if id(it[1]) not in picked]
+                self._m_depth.set(len(self._items))
                 return batch
 
     def stats(self) -> dict[str, Any]:

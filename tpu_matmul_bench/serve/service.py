@@ -20,6 +20,7 @@ of `digest_jsonl`, `campaign`, and the regression gate.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import sys
 import threading
@@ -28,6 +29,7 @@ from typing import Any, Iterator, Sequence
 
 import numpy as np
 
+from tpu_matmul_bench.obs.registry import get_registry
 from tpu_matmul_bench.ops.matmul import matmul_2d, random_operands
 from tpu_matmul_bench.serve.cache import DEFAULT_CAPACITY, ExecKey, ExecutableCache
 from tpu_matmul_bench.serve.loadgen import (
@@ -84,6 +86,7 @@ class ServeConfig:
     append_ledger: bool = False
     trace_out: str | None = None
     prewarm: bool = False
+    obs_dir: str | None = None  # snapshot exporter output (obs/export.py)
 
     @property
     def mix_entries(self) -> tuple[MixEntry, ...]:
@@ -159,12 +162,19 @@ def _worker_drain(
 ) -> None:
     """Drain the queue to exhaustion (producer closes it). Runs on the
     main thread — the only JAX-touching thread in the harness."""
+    reg = get_registry()
+    m_requests = reg.counter("serve_requests_total")
+    latency_hists: dict[str, Any] = {}
     while (batch := q.take_batch()) is not None:
         m, k, n = batch[0].bucket
         key = ExecKey(m=m, k=k, n=n, dtype=batch[0].dtype, impl=impl,
                       mesh_shape=mesh_shape)
         was_cached = key in cache
         a, b = pool.get(key)
+        hist = latency_hists.get(key.label)
+        if hist is None:
+            hist = latency_hists[key.label] = reg.histogram(
+                "serve_latency_ms", bucket=key.label)
         for req in batch:
             t0 = time.perf_counter()
             # per-request get: the batch's first miss pays the cold
@@ -180,6 +190,8 @@ def _worker_drain(
                 latency_s=done - req.submitted_at,
                 service_s=done - t0,
                 cold=not was_cached))
+            m_requests.inc()
+            hist.observe((done - req.submitted_at) * 1e3)
             was_cached = True  # only the batch's first request was cold
             if on_complete is not None:
                 on_complete(req)
@@ -351,6 +363,27 @@ def _report_summary(stats: dict[str, Any]) -> None:
     report(*lines)
 
 
+def _exporter(config: ServeConfig):
+    """The obs snapshot exporter for this run (`--obs-dir`), or a null
+    context when not requested. Lives alongside the telemetry session:
+    enter starts the ticker thread, exit writes the final snapshot."""
+    if not config.obs_dir:
+        return contextlib.nullcontext()
+    from tpu_matmul_bench.obs.export import SnapshotExporter
+
+    return SnapshotExporter(config.obs_dir)
+
+
+def _attach_cost_analysis(rec: BenchmarkRecord,
+                          cache: ExecutableCache) -> None:
+    """Additive ``extras["cost_analysis"]`` block: per-executable XLA
+    attribution recorded at AOT-compile time. Never touches
+    ``extras["serve"]`` — that contract stays byte-identical."""
+    blocks = cache.cost_analysis()
+    if blocks:
+        rec.extras["cost_analysis"] = blocks
+
+
 def _setup(config: ServeConfig):
     """Device + plumbing shared by bench and selftest."""
     from tpu_matmul_bench.utils.device import (
@@ -417,7 +450,7 @@ def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
 
     samples: list[Sample] = []
     schedule_shapes: dict[int, tuple[int, int, int]] = {}
-    with telemetry.session(config.trace_out):
+    with telemetry.session(config.trace_out), _exporter(config):
         prewarmed = _prewarm(config, q.grid, cache, world) \
             if config.prewarm else 0
         with telemetry.span("load", mode=config.load_mode):
@@ -462,6 +495,7 @@ def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
                             mode=config.load_mode,
                             executed_flops=executed_f, wall_s=wall_s,
                             prewarmed=prewarmed)
+        _attach_cost_analysis(rec, cache)
         _report_summary(stats)
         with JsonWriter(config.json_out,
                         manifest=telemetry.build_manifest(
@@ -517,7 +551,7 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
     key = ExecKey(*q.grid.bucket(e.m, e.k, e.n), dtype=config.dtype_name,
                   impl=config.matmul_impl, mesh_shape=(world,))
     samples: list[Sample] = []
-    with telemetry.session(config.trace_out):
+    with telemetry.session(config.trace_out), _exporter(config):
         with telemetry.span("warm-start", buckets=1):
             preloaded = cache.warm_start([key])
         t0 = time.perf_counter()
@@ -536,6 +570,7 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
         rec = _serve_record(config, stats, samples, info.device_kind, world,
                             mode="selftest", executed_flops=executed_f,
                             wall_s=wall_s, prewarmed=preloaded)
+        _attach_cost_analysis(rec, cache)
         _report_summary(stats)
         with JsonWriter(config.json_out,
                         manifest=telemetry.build_manifest(

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import io
 import json
 import os
 import subprocess
@@ -63,6 +64,64 @@ class SpanEvent:
     args: dict[str, Any]
 
 
+def _chrome_event(e: SpanEvent) -> dict[str, Any]:
+    """One Chrome-trace complete ("X") event, µs timestamps."""
+    return {
+        "name": e.name,
+        "ph": "X",
+        "ts": round(e.start_s * 1e6, 3),
+        "dur": round(e.dur_s * 1e6, 3),
+        "pid": os.getpid(),
+        "tid": 1,
+        **({"args": e.args} if e.args else {}),
+    }
+
+
+class _SpanSink:
+    """Incremental span flush: every closed span lands in the trace file
+    as one fsynced JSON line *immediately* (the `campaign/state.py`
+    journal discipline), so a SIGKILLed child still leaves its finished
+    phases on disk for the campaign trace merger. A clean exit rewrites
+    the file as complete Chrome-trace JSON (`write_trace`) — the partial
+    event-per-line form only survives the crashes it exists for."""
+
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._fh: Any = None
+        self._disabled = False
+
+    def write(self, event: dict[str, Any]) -> None:
+        if self._disabled:
+            return
+        try:
+            if self._fh is None:
+                # only the reporting process owns the trace file (same
+                # gate as write_trace; checked lazily — the backend may
+                # not be up when the session opens)
+                from tpu_matmul_bench.utils.reporting import (
+                    is_reporting_process,
+                )
+
+                if not is_reporting_process():
+                    self._disabled = True
+                    return
+                self._fh = open(self._path, "w")
+            self._fh.write(json.dumps(event, sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except (OSError, ValueError, AttributeError,
+                io.UnsupportedOperation):
+            self._disabled = True  # a broken sink must not fail the run
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
 class SpanTracker:
     """Collects nested phase spans for one benchmark run."""
 
@@ -70,6 +129,15 @@ class SpanTracker:
         self.epoch = time.perf_counter()
         self.events: list[SpanEvent] = []
         self._depth = 0
+        self._sink: _SpanSink | None = None
+
+    def attach_sink(self, sink: _SpanSink) -> None:
+        self._sink = sink
+
+    def close_sink(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
 
     @contextlib.contextmanager
     def span(self, name: str, **args: Any) -> Iterator[dict[str, Any]]:
@@ -83,13 +151,16 @@ class SpanTracker:
             yield meta
         finally:
             self._depth -= 1
-            self.events.append(SpanEvent(
+            event = SpanEvent(
                 name=name,
                 start_s=start,
                 dur_s=time.perf_counter() - self.epoch - start,
                 depth=self._depth,
                 args=dict(meta),
-            ))
+            )
+            self.events.append(event)
+            if self._sink is not None:
+                self._sink.write(_chrome_event(event))
 
     def to_chrome_trace(self) -> dict[str, Any]:
         """Chrome trace event format: complete ("X") events on one
@@ -97,18 +168,7 @@ class SpanTracker:
         events = sorted(self.events, key=lambda e: (e.start_s, -e.dur_s))
         return {
             "displayTimeUnit": "ms",
-            "traceEvents": [
-                {
-                    "name": e.name,
-                    "ph": "X",
-                    "ts": round(e.start_s * 1e6, 3),  # µs
-                    "dur": round(e.dur_s * 1e6, 3),
-                    "pid": os.getpid(),
-                    "tid": 1,
-                    **({"args": e.args} if e.args else {}),
-                }
-                for e in events
-            ],
+            "traceEvents": [_chrome_event(e) for e in events],
         }
 
     def summary_lines(self) -> list[str]:
@@ -185,11 +245,17 @@ def session(trace_out: str | None) -> Iterator[SpanTracker | None]:
         return
     note_artifact("chrome_trace", trace_out)
     tracker = SpanTracker()
+    if trace_out != "-":
+        # spans flush to the trace file as they close, so a killed
+        # process still leaves a readable partial timeline (the
+        # campaign merger accepts both forms)
+        tracker.attach_sink(_SpanSink(trace_out))
     _TRACKER = tracker
     try:
         yield tracker
     finally:
         _TRACKER = None
+        tracker.close_sink()
         write_trace(tracker, trace_out)
 
 
@@ -255,6 +321,9 @@ def build_manifest(config: Any = None, *,
         "process_count": jax.process_count(),
         "argv": list(sys.argv if argv is None else argv),
         "git_sha": git_sha(),
+        # run-context propagation (obs/context.py): this run's id plus
+        # the spawning run's (campaign) id when one rode the environment
+        "trace": _trace_block(),
     }
     if config is not None:
         # 1-D mesh programs: the world the run actually resolved
@@ -275,6 +344,17 @@ def build_manifest(config: Any = None, *,
     if _ARTIFACTS:
         manifest["artifacts"] = dict(_ARTIFACTS)
     return manifest
+
+
+def _trace_block() -> dict[str, Any]:
+    """obs.context.trace_block(), tolerant of a broken obs package —
+    provenance must never make a manifest unwritable."""
+    try:
+        from tpu_matmul_bench.obs import context as obs_context
+
+        return obs_context.trace_block()
+    except Exception:  # noqa: BLE001 — best-effort provenance
+        return {}
 
 
 def _jaxlib_version() -> str | None:

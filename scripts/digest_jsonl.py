@@ -37,7 +37,8 @@ def _rank_key(r):
 
 def _serve_row(r, s) -> str:
     """Serve records headline latency under load, not throughput: the
-    p50/p95/p99 ladder, achieved vs offered QPS, shed %, cache hit rate."""
+    p50/p95/p99 ladder, achieved vs offered QPS, goodput, shed %, cache
+    hit rate."""
     ex = r.get("extras") or {}
     shape = ex.get("shape") or f"{r.get('size')}²"
     qps = f"{s.get('achieved_qps')}qps"
@@ -48,15 +49,58 @@ def _serve_row(r, s) -> str:
             f"p99={s.get('p99_ms')} max={s.get('max_ms')}ms "
             f"{qps} shed={s.get('shed_rate_pct')}% "
             f"cache={cache.get('hit_rate_pct')}%hit")
+    if s.get("scheduler"):
+        bits = f"[{s['scheduler']}] " + bits
+    if "goodput_qps" in s:
+        bits += (f" good={s.get('goodput_qps')}qps"
+                 f"@{s.get('slo_attainment_pct')}%slo")
     if cache.get("evictions"):
         bits += f" evict={cache.get('evictions')}"
     if s.get("cold_requests"):
         bits += f" cold={s.get('cold_requests')}"
     if s.get("padding_overhead_pct"):
         bits += f" pad={s.get('padding_overhead_pct')}%"
+    ab = ex.get("ab")
+    if isinstance(ab, dict):
+        bits += (f" [A/B p99 {ab.get('p99_delta_pct')}% "
+                 f"good {ab.get('goodput_delta_pct'):+}% "
+                 + ("REGRESSED" if ab.get("regressed") else "ok") + "]")
     return (f"  {'serve':>8} {s.get('load_mode', ''):6} "
             f"{shape:>18} {r.get('mode', ''):24} "
             f"{'':>18} it={r.get('iterations')} {bits}")
+
+
+def _serve_sublines(r) -> list[str]:
+    """Indented detail lines under a serve row: per-tenant SLO/latency
+    rows and per-bucket padding efficiency — the multi-tenant story the
+    one-liner can't carry."""
+    s = (r.get("extras") or {}).get("serve")
+    if not isinstance(s, dict):
+        return []
+    lines: list[str] = []
+    tenants = s.get("tenants") or {}
+    if len(tenants) > 1:
+        for tid, row in sorted(tenants.items()):
+            slo = (f"slo={row.get('slo_ms'):g}ms "
+                   f"{row.get('slo_attainment_pct')}%att"
+                   if row.get("slo_ms") is not None else "no-slo")
+            lines.append(
+                f"      tenant {tid:<14} {row.get('requests', 0):>6} done "
+                f"{row.get('shed', 0):>5} shed  p99={row.get('p99_ms')}ms "
+                f"wait={row.get('wait_p99_ms')}ms  {slo}")
+    buckets = s.get("buckets") or {}
+    effs = {label: b.get("flops_efficiency_pct")
+            for label, b in buckets.items()
+            if isinstance(b, dict)
+            and isinstance(b.get("flops_efficiency_pct"), (int, float))}
+    # only worth a line when padding actually wastes something
+    if effs and any(e < 100.0 for e in effs.values()):
+        for label, eff in sorted(effs.items()):
+            count = (buckets[label] or {}).get("count")
+            lines.append(
+                f"      bucket {label:<28} {count:>6} reqs  "
+                f"flops-eff={eff}%")
+    return lines
 
 
 def _row(r) -> str:
@@ -281,6 +325,8 @@ def _digest_campaign(d: Path) -> None:
     rows.sort(key=lambda jr: _rank_key(jr[1]))
     for job_id, r in rows:
         print(_row(r) + f" job={job_id}")
+        for line in _serve_sublines(r):
+            print(line)
 
 
 def main(paths: list[str]) -> None:
@@ -345,6 +391,8 @@ def main(paths: list[str]) -> None:
         recs.sort(key=_rank_key)
         for r in recs:
             print(_row(r))
+            for line in _serve_sublines(r):
+                print(line)
 
 
 if __name__ == "__main__":

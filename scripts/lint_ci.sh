@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Four layers, cheapest first:
+# Five layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -26,6 +26,11 @@
 #      whose counters reconcile with the ledger's extras["serve"] block
 #      and whose cost_analysis attribution agrees with the hand FLOPs
 #      model (the dynamic halves of lint's OBS-001/OBS-002).
+#   5. python -m tpu_matmul_bench serve selftest — drives the
+#      multi-tenant continuous-batching scheduler end-to-end on CPU and
+#      validates the serve ledger contract: scheduler identity, cache
+#      and queue reconciliation, per-tenant rows summing to the request
+#      total, and SLO attainment for every budgeted tenant.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -44,3 +49,6 @@ JAX_PLATFORMS=cpu python -m tpu_matmul_bench tune selftest
 
 echo "== obs selftest (metrics bus / ledger reconciliation) =="
 JAX_PLATFORMS=cpu python -m tpu_matmul_bench obs selftest
+
+echo "== serve selftest (multi-tenant scheduler / ledger contract) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench serve selftest

@@ -236,6 +236,75 @@ def test_seeded_indivisible_sweep_size(tmp_path):
     assert _rule_sevs(findings) == [("SPEC-003", "warn")]
 
 
+def test_seeded_unknown_tenant_key(tmp_path):
+    # standalone tenants file (root table is exactly {tenants}) with a
+    # typo'd key: silently ignored at load time, so SPEC-002 must catch it
+    spec = tmp_path / "tenants_typo.toml"
+    spec.write_text(
+        '[tenants.interactive]\nweight = 2.0\nslo_mss = 250.0\n')
+    findings = spec_lint.lint_spec_file(spec)
+    assert _rule_sevs(findings) == [("SPEC-002", "error")]
+    assert findings[0].details["key"] == "slo_mss"
+
+
+def test_seeded_tenant_bounds_violations(tmp_path):
+    # each block violates one bound; every violation must surface, not
+    # just the first (a multi-tenant spec review reads the full list)
+    spec = tmp_path / "tenants_bounds.toml"
+    spec.write_text(
+        '[tenants.negweight]\nweight = -1.0\n\n'
+        '[tenants.zeroslo]\nslo_ms = 0.0\n\n'
+        '[tenants.badmix]\nmix = "not-a-shape"\n')
+    findings = spec_lint.lint_spec_file(spec)
+    assert _rule_sevs(findings) == [("SPEC-005", "error")] * 3
+
+
+def test_seeded_duplicate_tenant_id(tmp_path):
+    # TOML keys are case-sensitive so both blocks parse, but tenant ids
+    # normalize case-insensitively — the two would share one bill
+    spec = tmp_path / "tenants_dup.toml"
+    spec.write_text(
+        '[tenants.interactive]\nweight = 2.0\n\n'
+        '[tenants.INTERACTIVE]\nweight = 1.0\n')
+    findings = spec_lint.lint_spec_file(spec)
+    assert _rule_sevs(findings) == [("SPEC-006", "error")]
+
+
+def test_seeded_inline_tenant_flags(tmp_path):
+    # serve jobs carrying --tenants inline syntax lint through the same
+    # rules: duplicates → SPEC-006, bound violations → SPEC-005, and an
+    # unknown --scheduler → SPEC-001
+    def _serve_spec(flags):
+        spec = tmp_path / "serve_inline.toml"
+        spec.write_text(
+            '[campaign]\nname = "seeded"\n\n'
+            '[[job]]\nid = "j1"\nprogram = "serve"\n'
+            f'flags = {json.dumps(flags)}\n')
+        return spec_lint.lint_spec_file(spec)
+
+    base = ["bench", "--qps", "10", "--duration", "0.2", "--mix", "64"]
+    dup = _serve_spec(base + ["--tenants", "a=1,A=2"])
+    assert _rule_sevs(dup) == [("SPEC-006", "error")]
+    bad = _serve_spec(base + ["--tenants", "a=0/0"])
+    assert _rule_sevs(bad) == [("SPEC-005", "error")]
+    sched = _serve_spec(base + ["--scheduler", "quantum"])
+    assert _rule_sevs(sched) == [("SPEC-001", "error")]
+    clean = _serve_spec(base + ["--tenants", "a=2/0/250,b=1/1",
+                                "--scheduler", "continuous"])
+    assert clean == []
+
+
+def test_seeded_missing_tenants_file(tmp_path):
+    spec = tmp_path / "serve_missing.toml"
+    spec.write_text(
+        '[campaign]\nname = "seeded"\n\n'
+        '[[job]]\nid = "j1"\nprogram = "serve"\n'
+        'flags = ["bench", "--mix", "64", '
+        '"--tenants", "no_such_tenants.toml"]\n')
+    findings = spec_lint.lint_spec_file(spec)
+    assert _rule_sevs(findings) == [("SPEC-001", "error")]
+
+
 def test_shipped_specs_lint_clean():
     repo = Path(__file__).resolve().parent.parent
     paths = sorted(str(p) for p in (repo / "specs").glob("*.toml"))

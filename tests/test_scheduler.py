@@ -1,0 +1,401 @@
+"""The multi-tenant continuous-batching scheduler (`serve/scheduler.py`)
+and its tenant model: weighted-fair share bounds, priority preemption
+with the starvation guard, shedding confined to the violating tenant,
+conservation under adversarial mixes, byte-deterministic per-tenant load
+streams, and the gate/ledger contracts the scheduler feeds. Property
+style — the fairness and isolation claims in the module docstring are
+the spec; these tests are the teeth."""
+
+import random
+
+import pytest
+
+from tpu_matmul_bench.campaign import gate as gate_mod
+from tpu_matmul_bench.obs.registry import get_registry, reset_registry
+from tpu_matmul_bench.serve.loadgen import (
+    tenant_closed_loop_shapes,
+    tenant_open_loop_schedule,
+)
+from tpu_matmul_bench.serve.queue import Request, ShapeGrid
+from tpu_matmul_bench.serve.scheduler import ContinuousScheduler
+from tpu_matmul_bench.serve.tenants import (
+    TenantSpec,
+    TenantSpecError,
+    load_tenants,
+    parse_tenants_arg,
+)
+from tpu_matmul_bench.utils.errors import QueueOverflowError
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    # scheduler counters live on the process-global obs registry; each
+    # test gets a clean bus so counts don't bleed across instances
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def _req(rid, tenant, m=128, k=128, n=128, dtype="float32"):
+    return Request(rid=rid, m=m, k=k, n=n, dtype=dtype, tenant=tenant)
+
+
+def _drain(sched):
+    """close + take_batch until None; returns the dispatched batches."""
+    sched.close()
+    batches = []
+    while True:
+        b = sched.take_batch()
+        if b is None:
+            return batches
+        batches.append(b)
+
+
+# ------------------------------------------------------- weighted fairness
+
+def test_wfq_dispatch_ratio_tracks_weights():
+    """Two always-backlogged tenants with equal-FLOPs but distinct
+    buckets and weights 3:1 must split dispatches ~3:1 — the SFQ
+    virtual-time invariant, not a scheduling accident."""
+    tenants = (TenantSpec("heavy", weight=3.0),
+               TenantSpec("light", weight=1.0))
+    sched = ContinuousScheduler(ShapeGrid(), tenants=tenants,
+                                max_batch=1, max_depth=256)
+    # (128,128,256) and (256,128,128) pad to equal FLOPs, distinct
+    # buckets — so top-up can never merge the two streams
+    for i in range(60):
+        sched.submit(_req(i, "heavy", m=128, k=128, n=256))
+        sched.submit(_req(100 + i, "light", m=256, k=128, n=128))
+    counts = {"heavy": 0, "light": 0}
+    for _ in range(40):
+        (r,) = sched.take_batch()
+        counts[r.tenant] += 1
+    # SFQ bounds the service gap by one max-cost batch over any
+    # backlogged interval: 40 dispatches → 30/10 ± 1 quantization slack
+    assert 28 <= counts["heavy"] <= 32, counts
+    assert counts["heavy"] + counts["light"] == 40
+
+
+def test_wfq_no_starvation_of_light_tenant():
+    """The light tenant must receive its fair fraction of service while
+    the heavy one stays backlogged — weighted-fair (≈1/101 of dispatches
+    at 100:1), not strict-priority-by-weight (which would serve it
+    nothing until the heavy queue drained)."""
+    tenants = (TenantSpec("heavy", weight=100.0),
+               TenantSpec("light", weight=1.0))
+    sched = ContinuousScheduler(ShapeGrid(), tenants=tenants,
+                                max_batch=1, max_depth=512)
+    for i in range(120):
+        sched.submit(_req(i, "heavy", m=128, k=128, n=256))
+    for i in range(5):
+        sched.submit(_req(500 + i, "light", m=256, k=128, n=128))
+    order = [sched.take_batch()[0].tenant for _ in range(115)]
+    served_light = order.count("light")
+    # SFQ at 100:1 over 115 equal-cost dispatches: light's share rounds
+    # to 1-2 dispatches, and the first arrives early (its start tag is
+    # 0, not behind the heavy backlog)
+    assert 1 <= served_light <= 6, order.count("light")
+    assert "light" in order[:5]
+
+
+# ------------------------------------------- priority classes + starvation
+
+def test_priority_class_preempts_at_bucket_granularity():
+    tenants = (TenantSpec("hi", priority=0), TenantSpec("lo", priority=1))
+    sched = ContinuousScheduler(ShapeGrid(), tenants=tenants, max_batch=4,
+                                starvation_ms=60_000.0)
+    sched.submit(_req(0, "lo", m=256, k=128, n=128))  # arrived first
+    sched.submit(_req(1, "hi", m=128, k=128, n=256))
+    batch = sched.take_batch()
+    assert [r.tenant for r in batch] == ["hi"]
+    assert sched.preemptions == 1  # earlier lo work waited for hi's class
+    assert [r.tenant for r in sched.take_batch()] == ["lo"]
+
+
+def test_starvation_guard_promotes_aged_low_class_work():
+    import time
+
+    tenants = (TenantSpec("hi", priority=0), TenantSpec("lo", priority=1))
+    sched = ContinuousScheduler(ShapeGrid(), tenants=tenants, max_batch=4,
+                                starvation_ms=1.0)
+    sched.submit(_req(0, "lo", m=256, k=128, n=128))
+    time.sleep(0.02)  # well past the 1 ms starvation budget
+    for i in range(4):
+        sched.submit(_req(1 + i, "hi", m=128, k=128, n=256))
+    batch = sched.take_batch()
+    assert [r.tenant for r in batch] == ["lo"]  # jumped the class order
+    assert sched.starvation_promotions == 1
+
+
+# ------------------------------------------------------ selective shedding
+
+def test_overflow_evicts_the_over_share_tenant_not_the_submitter():
+    tenants = (TenantSpec("bulk", weight=1.0), TenantSpec("vip", weight=8.0))
+    sched = ContinuousScheduler(ShapeGrid(), tenants=tenants, max_depth=4)
+    for i in range(4):
+        sched.submit(_req(i, "bulk"))
+    admitted = sched.submit(_req(10, "vip"))  # full queue, no exception
+    assert admitted.bucket is not None
+    rows = sched.stats()["tenants"]
+    assert rows["bulk"]["shed"] == 1 and rows["vip"]["shed"] == 0
+    assert sched.stats()["evictions"] == 1
+    assert sched.depth == 4
+    # the victim's NEWEST request went, its oldest is still next in line
+    dispatched = [r.rid for b in _drain(sched) for r in b]
+    assert 3 not in dispatched and 0 in dispatched and 10 in dispatched
+
+
+def test_overflow_from_the_violator_itself_sheds_at_the_door():
+    tenants = (TenantSpec("bulk", weight=1.0), TenantSpec("vip", weight=8.0))
+    sched = ContinuousScheduler(ShapeGrid(), tenants=tenants, max_depth=4)
+    for i in range(4):
+        sched.submit(_req(i, "bulk"))
+    with pytest.raises(QueueOverflowError):
+        sched.submit(_req(20, "bulk"))  # over-share tenant pays itself
+    rows = sched.stats()["tenants"]
+    assert rows["bulk"]["shed"] == 1 and rows["vip"]["shed"] == 0
+    assert sched.stats()["evictions"] == 0  # refused at submit, no eviction
+    assert sched.offered == 5
+
+
+def test_slo_shedding_confined_to_the_budgeted_tenant():
+    tenants = (TenantSpec("tight", slo_ms=1.0, weight=1.0),
+               TenantSpec("loose", weight=1.0))
+    sched = ContinuousScheduler(ShapeGrid(), tenants=tenants)
+    sched.note_service(0.5, 1)  # 500 ms/request service estimate
+    sched.submit(_req(0, "tight"))  # empty backlog → admitted
+    with pytest.raises(QueueOverflowError):
+        # one queued request × 500 ms ÷ ½ share ≫ the 1 ms budget:
+        # admitting this would manufacture an SLO miss
+        sched.submit(_req(1, "tight"))
+    for i in range(8):  # the unbudgeted tenant is untouched
+        sched.submit(_req(10 + i, "loose"))
+    stats = sched.stats()
+    assert stats["slo_sheds"] == 1
+    assert stats["tenants"]["tight"]["shed"] == 1
+    assert stats["tenants"]["loose"]["shed"] == 0
+
+
+# ----------------------------------------------------------- conservation
+
+def test_conservation_under_adversarial_seeded_mix():
+    """Every submission attempt ends exactly one way per tenant:
+    dispatched or shed. Batches stay single-(bucket,dtype) and capped."""
+    tenants = (TenantSpec("a", weight=4.0, priority=0),
+               TenantSpec("b", weight=2.0, priority=1, slo_ms=50.0),
+               TenantSpec("c", weight=1.0, priority=1))
+    sched = ContinuousScheduler(ShapeGrid(), tenants=tenants,
+                                max_depth=16, max_batch=4)
+    rng = random.Random(0)
+    shapes = [(128, 128, 128), (128, 128, 256), (256, 128, 128),
+              (256, 256, 256)]
+    attempts = {"a": 0, "b": 0, "c": 0}
+    batches = []
+    sched.note_service(0.01, 1)  # give SLO shedding a live estimate
+    for rid in range(300):
+        tid = rng.choice("abc")
+        m, k, n = rng.choice(shapes)
+        attempts[tid] += 1
+        try:
+            sched.submit(_req(rid, tid, m=m, k=k, n=n))
+        except QueueOverflowError:
+            pass
+        if rng.random() < 0.3:  # interleave dispatch to vary pressure
+            b = sched.take_batch()
+            if b:
+                batches.append(b)
+    batches.extend(_drain(sched))
+    stats = sched.stats()
+    assert sched.depth == 0
+    dispatched = {"a": 0, "b": 0, "c": 0}
+    for batch in batches:
+        assert 1 <= len(batch) <= 4
+        keys = {(r.bucket, r.dtype) for r in batch}
+        assert len(keys) == 1, "batch mixes buckets"
+        for r in batch:
+            dispatched[r.tenant] += 1
+    # every attempt ends exactly one way: dispatched, or shed (at the
+    # door, early via SLO, or evicted after admission) — no request is
+    # lost, duplicated, or billed to another tenant
+    for tid in attempts:
+        assert dispatched[tid] + stats["tenants"][tid]["shed"] \
+            == attempts[tid], tid
+    assert sum(dispatched.values()) + stats["shed"] == 300
+    # offered counts submission attempts exactly once: evicted requests
+    # were admitted at their attempt, not re-counted as rejections
+    assert sched.offered == 300
+
+
+def test_unknown_tenant_and_bad_policy_are_refused():
+    sched = ContinuousScheduler(ShapeGrid())
+    with pytest.raises(ValueError, match="unknown tenant"):
+        sched.submit(_req(0, "nobody"))
+    with pytest.raises(ValueError):
+        ContinuousScheduler(ShapeGrid(), max_depth=0)
+    with pytest.raises(ValueError):
+        ContinuousScheduler(ShapeGrid(), tenants=())
+    with pytest.raises(ValueError):
+        ContinuousScheduler(ShapeGrid(), starvation_ms=0.0)
+
+
+def test_scheduler_reuses_queue_series_and_adds_tenant_series():
+    """The obs contract: the continuous scheduler reports through the
+    SAME series names the fixed queue uses (dashboards and the obs
+    selftest reconciliation read either), plus per-tenant series."""
+    tenants = (TenantSpec("a",), TenantSpec("b", weight=2.0))
+    sched = ContinuousScheduler(ShapeGrid(), tenants=tenants)
+    sched.submit(_req(0, "a"))
+    sched.submit(_req(1, "b"))
+    snap = get_registry().snapshot()
+    counters = snap["counters"]
+    assert counters["serve_queue_submitted_total"] == 2
+    assert counters['serve_tenant_shed_total{tenant="a"}'] == 0
+    assert snap["gauges"]["serve_queue_depth"] == 2
+    assert snap["gauges"]['serve_tenant_depth{tenant="b"}'] == 1
+    assert sched.submitted == 2 and sched.shed == 0
+
+
+# -------------------------------------------------- tenant load generation
+
+def test_tenant_schedule_is_byte_deterministic():
+    tenants = (TenantSpec("x", share=2.0, mix="128"),
+               TenantSpec("y", share=1.0, ramp=0.5,
+                          burst_x=2.0, burst_every_s=0.5, burst_for_s=0.1))
+    a = tenant_open_loop_schedule(tenants, qps=200, duration_s=1.0,
+                                  dtype="float32", seed=7)
+    b = tenant_open_loop_schedule(tenants, qps=200, duration_s=1.0,
+                                  dtype="float32", seed=7)
+    assert [(r.rid, r.tenant, r.m, r.k, r.n, r.arrival_s) for r in a] \
+        == [(r.rid, r.tenant, r.m, r.k, r.n, r.arrival_s) for r in b]
+    assert a and all(0 <= r.arrival_s < 1.0 for r in a)
+    assert [r.rid for r in a] == list(range(len(a)))
+    changed = tenant_open_loop_schedule(tenants, qps=200, duration_s=1.0,
+                                        dtype="float32", seed=8)
+    assert [(r.tenant, r.arrival_s) for r in changed] \
+        != [(r.tenant, r.arrival_s) for r in a]
+
+
+def test_tenant_streams_are_independent_of_other_tenants():
+    """Adding a tenant must not perturb an existing tenant's stream
+    (same per-tenant base rate): per-tenant RNGs are derived from
+    (seed, tenant id), never shared."""
+    x = TenantSpec("x", share=1.0, mix="128,256:0.25")
+    y = TenantSpec("y", share=1.0, mix="512")
+    solo = tenant_open_loop_schedule((x,), qps=100, duration_s=1.0,
+                                     dtype="float32", seed=3)
+    # doubling qps with an equal-share second tenant keeps x's base
+    # rate at 100 qps — x's subsequence must be byte-identical
+    both = [r for r in tenant_open_loop_schedule(
+        (x, y), qps=200, duration_s=1.0, dtype="float32", seed=3)
+        if r.tenant == "x"]
+    assert [(r.m, r.k, r.n, r.arrival_s) for r in both] \
+        == [(r.m, r.k, r.n, r.arrival_s) for r in solo]
+
+
+def test_burst_profile_raises_offered_load():
+    flat = TenantSpec("t", mix="128")
+    bursty = TenantSpec("t", mix="128", burst_x=3.0,
+                        burst_every_s=0.25, burst_for_s=0.1)
+    n_flat = len(tenant_open_loop_schedule((flat,), qps=200, duration_s=1.0,
+                                           dtype="float32", seed=11))
+    n_burst = len(tenant_open_loop_schedule((bursty,), qps=200,
+                                            duration_s=1.0,
+                                            dtype="float32", seed=11))
+    # 3× bursts 40% of the time ≈ 1.8× the offered load; seeded, so the
+    # inequality is deterministic, not probabilistic
+    assert n_burst > n_flat * 1.3
+
+
+def test_tenant_closed_loop_draws_by_share_with_tenant_local_mixes():
+    tenants = (TenantSpec("big", share=3.0, mix="512"),
+               TenantSpec("small", share=1.0, mix="128"))
+    stream = tenant_closed_loop_shapes(tenants, dtype="float32", seed=5)
+    reqs = [next(stream) for _ in range(400)]
+    by_tenant = {t: [r for r in reqs if r.tenant == t]
+                 for t in ("big", "small")}
+    assert all(r.m == 512 for r in by_tenant["big"])
+    assert all(r.m == 128 for r in by_tenant["small"])
+    frac = len(by_tenant["big"]) / 400
+    assert 0.65 < frac < 0.85  # 3:1 share, 400 seeded draws
+
+
+# ------------------------------------------------------- tenant definition
+
+def test_parse_tenants_inline_and_defaults():
+    (t,) = parse_tenants_arg("api=4/0/250")
+    assert (t.tenant_id, t.weight, t.priority, t.slo_ms) \
+        == ("api", 4.0, 0, 250.0)
+    a, b = parse_tenants_arg("a=2,b=1/1")
+    assert (a.weight, a.priority, a.slo_ms) == (2.0, 0, None)
+    assert (b.weight, b.priority) == (1.0, 1)
+    assert parse_tenants_arg(None)[0].tenant_id == "default"
+    with pytest.raises(TenantSpecError):
+        parse_tenants_arg("a=1,A=2")  # duplicate after normalization
+    with pytest.raises(TenantSpecError):
+        parse_tenants_arg("a=0")  # weight must be > 0
+
+
+def test_load_tenants_toml_roundtrip(tmp_path):
+    f = tmp_path / "tenants.toml"
+    f.write_text('[tenants.api]\nweight = 2.0\nslo_ms = 100.0\n'
+                 'mix = "128"\n\n'
+                 '[tenants.batch]\npriority = 1\nburst_x = 2.0\n'
+                 'burst_every_s = 1.0\nburst_for_s = 0.5\n')
+    api, batch = load_tenants(f)
+    assert api.slo_ms == 100.0 and api.mix == "128"
+    assert batch.priority == 1 and batch.burst_x == 2.0
+    with pytest.raises(TenantSpecError):
+        load_tenants(tmp_path / "missing.toml")
+
+
+def test_tenant_bounds_rejected():
+    from tpu_matmul_bench.serve.tenants import tenant_from_dict
+
+    with pytest.raises(TenantSpecError, match="weight"):
+        tenant_from_dict("t", {"weight": -1})
+    with pytest.raises(TenantSpecError, match="priority"):
+        tenant_from_dict("t", {"priority": -1})
+    with pytest.raises(TenantSpecError, match="ramp"):
+        tenant_from_dict("t", {"ramp": 1.5})
+    with pytest.raises(TenantSpecError, match="burst_every_s"):
+        tenant_from_dict("t", {"burst_x": 2.0})  # burst with no period
+    with pytest.raises(TenantSpecError, match="mix"):
+        tenant_from_dict("t", {"mix": "not-a-shape"})
+    # unknown keys are the linter's job, not the runtime's
+    assert tenant_from_dict("t", {"weigth": 9.0}).weight == 1.0
+
+
+# --------------------------------------------------------- gate SLO rows
+
+def _serve_summary(p99, slo, noise=3.0):
+    return {"f": {"job_id": "s", "p99_latency_ms": p99,
+                  "slo_attainment_pct": slo, "noise_pct": noise}}
+
+
+def test_gate_adds_slo_attainment_row_for_serve_jobs():
+    base = _serve_summary(10.0, 100.0)
+    report = gate_mod.run_gate(_serve_summary(10.1, 99.5), base)
+    assert report.passed
+    metrics = [r.metric for r in report.rows]
+    assert metrics == [gate_mod.LATENCY_METRIC, gate_mod.SLO_METRIC]
+    slo_row = report.rows[1]
+    assert slo_row.verdict == "ok"  # −0.5 pts within the ±6 pt tolerance
+    assert "% SLO" in slo_row.format()
+
+
+def test_gate_flags_slo_attainment_drop_even_when_p99_holds():
+    # the scheduler-gaming case: headline p99 flat, one tenant starved
+    base = _serve_summary(10.0, 100.0)
+    report = gate_mod.run_gate(_serve_summary(10.0, 80.0), base)
+    assert report.exit_code == gate_mod.EXIT_REGRESSION
+    slo_row = report.rows[1]
+    assert slo_row.metric == gate_mod.SLO_METRIC
+    assert slo_row.verdict == "regression"
+    assert slo_row.delta_pct == -20.0  # absolute points, not relative %
+
+
+def test_gate_skips_slo_row_for_pre_tenant_baselines():
+    base = {"f": {"job_id": "s", "p99_latency_ms": 10.0, "noise_pct": 3.0}}
+    report = gate_mod.run_gate(_serve_summary(10.0, 100.0), base)
+    assert report.passed
+    assert [r.metric for r in report.rows] == [gate_mod.LATENCY_METRIC]

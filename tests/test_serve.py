@@ -289,6 +289,10 @@ def test_validate_serve_record_catches_tampering():
             extras={"serve": {
                 "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0, "max_ms": 4.0,
                 "shed_rate_pct": 0.0, "achieved_qps": 10.0, "requests": 3,
+                "scheduler": "continuous", "goodput_qps": 10.0,
+                "slo_attainment_pct": 100.0,
+                "tenants": {"default": {"requests": 3, "slo_ms": None,
+                                        "slo_attainment_pct": 100.0}},
                 "cache": {"hits": 2, "misses": 1},
                 "queue": {"submitted": 3, "shed": 0}}})
 
@@ -305,6 +309,17 @@ def test_validate_serve_record_catches_tampering():
     r = rec()
     del r.extras["serve"]
     assert validate_serve_record(r) == ["extras['serve'] block missing"]
+    # the multi-tenant contract: tenant rows must reconcile with the
+    # headline, attainment must be a percentage, goodput ≤ throughput
+    r = rec()
+    r.extras["serve"]["tenants"]["default"]["requests"] = 2
+    assert any("tenant rows account" in p for p in validate_serve_record(r))
+    r = rec()
+    r.extras["serve"]["tenants"]["default"]["slo_attainment_pct"] = 101.0
+    assert any("not in [0, 100]" in p for p in validate_serve_record(r))
+    r = rec()
+    r.extras["serve"]["goodput_qps"] = 11.0
+    assert any("exceeds achieved_qps" in p for p in validate_serve_record(r))
 
 
 # ------------------------------------------------------------ e2e smoke
@@ -352,6 +367,34 @@ def test_serve_bench_end_to_end_appended_windows(tmp_path):
     # identical seed + mix + qps → identical offered schedule length
     assert records[0]["extras"]["serve"]["queue"]["submitted"] == \
         records[1]["extras"]["serve"]["queue"]["submitted"]
+
+
+def test_serve_ab_end_to_end_compares_schedulers(tmp_path):
+    """The goodput A/B harness: one seeded run, both schedulers, one
+    ledger holding both records plus the noise-aware verdict. Exit 0
+    means continuous did not regress p99 or goodput vs fixed-window."""
+    ledger = tmp_path / "ab.jsonl"
+    out = _run_serve(["ab", "--qps", "120", "--duration", "0.6",
+                      "--mix", "64,128:0.5", "--prewarm", "--seed", "0",
+                      "--tenants", "vip=4/0/500,bulk=1/1",
+                      "--json-out", str(ledger)])
+    assert out.returncode == 0, out.stderr[-2000:]
+    manifests, records = _ledger(ledger)
+    assert len(records) == 2
+    by_sched = {r["extras"]["serve"]["scheduler"]: r for r in records}
+    assert set(by_sched) == {"fixed", "continuous"}
+    for r in records:
+        srv = r["extras"]["serve"]
+        assert set(srv["tenants"]) == {"vip", "bulk"}
+        assert srv["goodput_qps"] <= srv["achieved_qps"] + 1e-9
+    verdict = records[-1]["extras"]["ab"]
+    assert verdict["baseline"] == "fixed"
+    assert verdict["candidate"] == "continuous"
+    assert verdict["regressed"] is False
+    assert verdict["tolerance_pct"] > 0
+    # both arms replayed the same seeded stream: identical offered load
+    assert by_sched["fixed"]["extras"]["serve"]["queue"]["submitted"] > 0
+    assert manifests[0]["serve_config"]["load_mode"] == "ab"
 
 
 def test_serve_bench_sheds_under_tiny_depth(tmp_path):

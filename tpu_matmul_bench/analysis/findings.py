@@ -54,6 +54,13 @@ RULES: dict[str, tuple[Severity, str]] = {
                          "it will run under"),
     "SPEC-004": ("error", "job fingerprint collision: two distinct jobs "
                           "would share a resume/ledger identity"),
+    "SPEC-005": ("error", "invalid tenant definition: weight/priority/SLO "
+                          "bounds violated, bad traffic profile, or "
+                          "unparseable mix in a [tenants.*] block"),
+    "SPEC-006": ("error", "duplicate tenant id: two [tenants.*] blocks "
+                          "collide after case/whitespace normalization "
+                          "(one tenant's traffic would be billed to the "
+                          "other's share)"),
     "REG-001": ("warn", "impl-registry tier routes to a kernel citing no "
                         "measurement artifact"),
     "REG-002": ("info", "impl-registry tier extrapolated by tie policy with "

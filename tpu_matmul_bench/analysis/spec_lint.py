@@ -31,18 +31,21 @@ _DIVISIBILITY_MODES = {"matrix_parallel", "model_parallel"}
 
 # serve-CLI flag vocabulary, mirroring serve/cli.py — an unknown flag
 # crashes the job at spawn time, possibly hours into the campaign
-_SERVE_SUBCOMMANDS = ("bench", "selftest")
+_SERVE_SUBCOMMANDS = ("bench", "ab", "selftest")
 _SERVE_COMMON_FLAGS = {
-    "--mix", "--dtype", "--grid", "--window-ms", "--max-depth",
+    "--mix", "--dtype", "--grid", "--scheduler", "--tenants",
+    "--starvation-ms", "--window-ms", "--max-depth",
     "--max-batch", "--cache-capacity", "--matmul-impl", "--seed",
     "--device", "--num-devices", "--json-out", "--append", "--trace-out",
+    "--obs-dir",
 }
 _SERVE_BENCH_FLAGS = {"--qps", "--duration", "--concurrency", "--prewarm"}
 _SERVE_BOOL_FLAGS = {"--prewarm", "--append"}
 # flags whose value must be a strictly positive number
 _SERVE_POSITIVE_FLAGS = {"--qps", "--duration", "--concurrency",
-                         "--window-ms", "--max-depth", "--max-batch",
-                         "--cache-capacity"}
+                         "--window-ms", "--starvation-ms", "--max-depth",
+                         "--max-batch", "--cache-capacity"}
+_SERVE_SCHEDULERS = ("fixed", "continuous")
 
 
 def _flag_values(argv: list[str], flag: str) -> list[str]:
@@ -88,10 +91,12 @@ def _serve_flag_items(argv: list[str]) -> tuple[list[tuple[str, str | None]],
     return items, strays
 
 
-def _lint_serve_job(job: Any, where: str) -> list[Finding]:
+def _lint_serve_job(job: Any, where: str,
+                    spec_dir: Path | None = None) -> list[Finding]:
     """The serve analog of the round.toml job checks: subcommand + flag
-    vocabulary (SPEC-002), mix/grid/load validity (SPEC-001), and a
-    padding-grid coverage warning (SPEC-003)."""
+    vocabulary (SPEC-002), mix/grid/load/scheduler validity (SPEC-001),
+    tenant definitions (SPEC-005/SPEC-006), and a padding-grid coverage
+    warning (SPEC-003)."""
     from tpu_matmul_bench.serve.loadgen import parse_mix
     from tpu_matmul_bench.serve.queue import DEFAULT_GRID
 
@@ -103,8 +108,8 @@ def _lint_serve_job(job: Any, where: str) -> list[Finding]:
             f"{_SERVE_SUBCOMMANDS}, got {argv[:1] or '[]'}",
             details={"argv": argv})]
     sub = argv[0]
-    known = _SERVE_COMMON_FLAGS | (_SERVE_BENCH_FLAGS if sub == "bench"
-                                   else set())
+    known = _SERVE_COMMON_FLAGS | (_SERVE_BENCH_FLAGS
+                                   if sub in ("bench", "ab") else set())
     findings: list[Finding] = []
     items, strays = _serve_flag_items(argv[1:])
     for tok in strays:
@@ -153,6 +158,16 @@ def _lint_serve_job(job: Any, where: str) -> list[Finding]:
                 "SPEC-001", where,
                 f"{flag} must be a positive number, got {values[flag]!r}",
                 details={"flag": flag, "value": values[flag]}))
+    sched = values.get("--scheduler")
+    if sched is not None and sched not in _SERVE_SCHEDULERS:
+        findings.append(Finding(
+            "SPEC-001", where,
+            f"--scheduler must be one of {_SERVE_SCHEDULERS}, "
+            f"got {sched!r}",
+            details={"scheduler": sched}))
+    if "--tenants" in values:
+        findings.extend(
+            _lint_tenants_value(values["--tenants"], where, spec_dir))
     # coverage analog of the mesh-divisibility warn: a mix dim above the
     # grid top compiles an off-grid executable per shape (cache churn and
     # padding waste the grid was supposed to bound)
@@ -168,6 +183,92 @@ def _lint_serve_job(job: Any, where: str) -> list[Finding]:
                 "own off-grid executable",
                 details={"dims": list(dims), "grid_top": top}))
     return findings
+
+
+def _lint_tenants_data(data: Any, where: str) -> list[Finding]:
+    """All findings for a parsed ``{"tenants": {...}}`` root: unknown
+    keys per block (SPEC-002), bounds/profile validity (SPEC-005),
+    normalized-id duplicates (SPEC-006). Reports every violation, unlike
+    the runtime loader which raises on the first."""
+    from tpu_matmul_bench.serve.tenants import (
+        TENANT_KEYS,
+        TenantSpecError,
+        _norm_id,
+        tenant_from_dict,
+    )
+
+    table = data.get("tenants") if isinstance(data, dict) else None
+    if not isinstance(table, dict) or not table:
+        return [Finding(
+            "SPEC-001", where,
+            "tenant file needs a non-empty [tenants.<id>] table")]
+    findings: list[Finding] = []
+    seen: dict[str, str] = {}
+    for tid, entry in table.items():
+        label = f"{where}:tenants.{tid}"
+        if isinstance(entry, dict):
+            for key in sorted(set(entry) - TENANT_KEYS):
+                findings.append(Finding(
+                    "SPEC-002", label,
+                    f"unknown tenant key {key!r} (silently ignored at "
+                    "run time)",
+                    details={"key": key, "known": sorted(TENANT_KEYS)}))
+        try:
+            spec = tenant_from_dict(str(tid), entry)
+        except TenantSpecError as e:
+            findings.append(Finding("SPEC-005", label, str(e),
+                                    details={"tenant": str(tid)}))
+            continue
+        norm = _norm_id(spec.tenant_id)
+        if norm in seen:
+            findings.append(Finding(
+                "SPEC-006", label,
+                f"duplicate tenant id {spec.tenant_id!r} (collides with "
+                f"{seen[norm]!r} after case/whitespace normalization)",
+                details={"tenant": spec.tenant_id,
+                         "collides_with": seen[norm]}))
+        else:
+            seen[norm] = spec.tenant_id
+    return findings
+
+
+def _lint_tenants_value(value: str | None, where: str,
+                        spec_dir: Path | None) -> list[Finding]:
+    """A serve job's ``--tenants`` value: a TOML path (resolved against
+    the cwd like the executor will, then against the spec's directory)
+    linted in place, or the inline form parsed the way the CLI would."""
+    from tpu_matmul_bench.campaign.spec import CampaignSpecError, _parse_toml
+    from tpu_matmul_bench.serve.tenants import (
+        TenantSpecError,
+        parse_tenants_arg,
+    )
+
+    if value is None:
+        return [Finding("SPEC-001", where, "--tenants needs a value")]
+    if value.endswith(".toml"):
+        p = Path(value)
+        if not p.exists() and spec_dir is not None:
+            p = spec_dir / value
+        if not p.exists():
+            return [Finding(
+                "SPEC-001", where,
+                f"--tenants file {value!r} not found (looked in the cwd "
+                + (f"and {spec_dir}" if spec_dir else "only") + ")",
+                details={"tenants": value})]
+        try:
+            data = _parse_toml(p.read_text())
+        except (OSError, CampaignSpecError) as e:
+            return [Finding("SPEC-001", where,
+                            f"unreadable --tenants file {p}: {e}",
+                            details={"tenants": str(p)})]
+        return _lint_tenants_data(data, f"{where}:{value}")
+    try:
+        parse_tenants_arg(value)
+    except TenantSpecError as e:
+        rule = "SPEC-006" if "duplicate tenant id" in str(e) else "SPEC-005"
+        return [Finding(rule, where, f"bad inline --tenants: {e}",
+                        details={"tenants": value})]
+    return []
 
 
 def _unknown_key_findings(data: dict[str, Any], where: str) -> list[Finding]:
@@ -218,6 +319,11 @@ def lint_spec_file(path: str | Path) -> list[Finding]:
         return [Finding("SPEC-001", where,
                         f"spec root must be a table, got {type(data).__name__}")]
 
+    # a standalone tenant-definition file (root is exactly [tenants.*]):
+    # not a campaign spec at all — lint the tenant blocks and stop
+    if set(data) == {"tenants"}:
+        return _lint_tenants_data(data, where)
+
     findings = _unknown_key_findings(data, where)
 
     try:
@@ -239,10 +345,12 @@ def lint_spec_file(path: str | Path) -> list[Finding]:
                 details={"fingerprint": job.fingerprint,
                          "jobs": [prior, job.job_id]}))
 
-    # serve jobs: subcommand + flag vocabulary + mix/grid/load validation
+    # serve jobs: subcommand + flag vocabulary + mix/grid/load/tenant
+    # validation
     for job in spec.jobs:
         if job.program == "serve":
-            findings.extend(_lint_serve_job(job, f"{where}:{job.job_id}"))
+            findings.extend(_lint_serve_job(job, f"{where}:{job.job_id}",
+                                            spec_dir=p.parent))
 
     # mesh divisibility: sharding modes need size % num_devices == 0
     for job in spec.jobs:

@@ -54,6 +54,12 @@ EXIT_UNUSABLE = 2
 
 THROUGHPUT_METRIC = "tflops_per_device"  # higher is better
 LATENCY_METRIC = "p99_latency_ms"  # lower is better (serve jobs)
+# serve jobs also gate on SLO attainment: the WORST per-tenant p99-budget
+# attainment (percentage of completions within budget; store.py computes
+# the min over tenants). Compared in absolute percentage points with the
+# same noise-aware tolerance — a scheduler change that keeps headline p99
+# but trades one tenant's SLO misses for another's is a regression.
+SLO_METRIC = "slo_attainment_pct"  # higher is better (serve jobs)
 
 
 @dataclasses.dataclass
@@ -68,7 +74,8 @@ class GateRow:
     metric: str = THROUGHPUT_METRIC
 
     def format(self) -> str:
-        unit = " ms p99" if self.metric == LATENCY_METRIC else ""
+        unit = {LATENCY_METRIC: " ms p99",
+                SLO_METRIC: " % SLO"}.get(self.metric, "")
         if self.verdict == "new":
             return (f"  NEW        {self.job_id}: {self.current:.2f}{unit} "
                     "(no baseline row)")
@@ -179,10 +186,24 @@ def run_gate(current: dict[str, dict[str, Any]],
             verdict = "regression" if delta > tol else "ok"
         else:
             verdict = "regression" if delta < -tol else "ok"
-        rows.append(GateRow(fp, cur.get("job_id") or base.get("job_id", fp),
+        job_id = cur.get("job_id") or base.get("job_id", fp)
+        rows.append(GateRow(fp, job_id,
                             verdict, baseline=b, current=c,
                             delta_pct=delta, tolerance_pct=tol,
                             metric=metric))
+        # serve fingerprints carry a second verdict: worst-tenant SLO
+        # attainment, in absolute percentage points (delta_pct here IS
+        # points — attainment is already a percentage)
+        bs, cs = base.get(SLO_METRIC), cur.get(SLO_METRIC)
+        if metric == LATENCY_METRIC \
+                and isinstance(bs, (int, float)) \
+                and isinstance(cs, (int, float)):
+            pts = cs - bs
+            rows.append(GateRow(
+                fp, job_id,
+                "regression" if pts < -tol else "ok",
+                baseline=bs, current=cs, delta_pct=pts,
+                tolerance_pct=tol, metric=SLO_METRIC))
     for fp, cur in sorted(current.items(),
                           key=lambda kv: kv[1].get("job_id", kv[0])):
         if fp not in baseline:

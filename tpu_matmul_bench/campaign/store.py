@@ -120,6 +120,9 @@ class CampaignStore:
                     "p99_latency_ms": _serve_p99(best),
                     "p50_latency_ms": srv.get("p50_ms"),
                     "shed_rate_pct": srv.get("shed_rate_pct"),
+                    "goodput_qps": srv.get("goodput_qps"),
+                    "slo_attainment_pct": _min_slo_attainment(srv),
+                    "scheduler": srv.get("scheduler"),
                     "tflops_per_device": best.get("tflops_per_device"),
                     "n_records": len(serve_rows),
                     "noise_pct": srv.get("p99_noise_pct"),
@@ -139,6 +142,25 @@ class CampaignStore:
                 "noise_pct": _noise_pct(best),
             }
         return out
+
+
+def _min_slo_attainment(srv: dict[str, Any]) -> float | None:
+    """The gate's SLO headline: the WORST per-tenant attainment among
+    tenants that carry a p99 budget, falling back to the overall figure.
+    Min, not mean — multi-tenant fairness means the most-hurt tenant is
+    the one the gate defends."""
+    tenant_rows = srv.get("tenants")
+    if isinstance(tenant_rows, dict):
+        budgeted = [row.get("slo_attainment_pct")
+                    for row in tenant_rows.values()
+                    if isinstance(row, dict)
+                    and row.get("slo_ms") is not None
+                    and isinstance(row.get("slo_attainment_pct"),
+                                   (int, float))]
+        if budgeted:
+            return min(budgeted)
+    overall = srv.get("slo_attainment_pct")
+    return overall if isinstance(overall, (int, float)) else None
 
 
 def _serve_p99(rec: dict[str, Any]) -> float | None:

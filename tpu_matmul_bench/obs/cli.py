@@ -77,14 +77,31 @@ def _format_snapshot(snap: dict[str, Any]) -> list[str]:
             lines.append(f"  {key:<{width}}  {counters[key]:g}")
         for key in sorted(gauges):
             lines.append(f"  {key:<{width}}  {gauges[key]:g} (gauge)")
-    for key in sorted(hists):
-        h = hists[key]
-        lines.append(
-            f"  {key}  n={h.get('count')} p50={h.get('p50')} "
-            f"p95={h.get('p95')} p99={h.get('p99')} max={h.get('max')}")
+    if hists:
+        # quantile ladder: one aligned row per histogram series, so the
+        # admission→dispatch wait and latency distributions read as one
+        # table while the run is in flight
+        quants = ("p50", "p95", "p99", "max")
+        hwidth = max(len(k) for k in hists)
+        rows = {key: [_fmt_q(hists[key].get(q)) for q in quants]
+                for key in sorted(hists)}
+        cols = [max([len(q)] + [rows[k][i] and len(rows[k][i]) or 0
+                                for k in rows])
+                for i, q in enumerate(quants)]
+        head = "  ".join(q.rjust(w) for q, w in zip(quants, cols))
+        lines.append(f"  {'histogram':<{hwidth}}  {'n':>6}  {head}")
+        for key in sorted(hists):
+            cells = "  ".join(c.rjust(w) for c, w in zip(rows[key], cols))
+            lines.append(
+                f"  {key:<{hwidth}}  {hists[key].get('count', 0):>6}  "
+                f"{cells}")
     if not (counters or gauges or hists):
         lines.append("  (no instruments recorded yet)")
     return lines
+
+
+def _fmt_q(v: Any) -> str:
+    return f"{v:g}" if isinstance(v, (int, float)) else "-"
 
 
 def _cmd_status(args: argparse.Namespace) -> int:

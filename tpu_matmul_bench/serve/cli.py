@@ -6,10 +6,17 @@ request mix, and writes one schema-v2 ledger record whose extras carry
 the full serving headline set (p50/p95/p99/max latency, achieved QPS,
 shed rate, cache hit/miss/eviction counters, per-bucket breakdown).
 
+`ab` runs the same seeded offered load twice — once through the
+fixed-window admission queue, once through the continuous-batching
+multi-tenant scheduler — writes both records into one ledger, and exits
+nonzero when continuous batching regresses p99 or goodput beyond the
+noise-aware tolerance (the in-repo form of the scheduler's perf claim).
+
 `selftest` is the no-load CI hook: compile one executable, serve a
-handful of requests synchronously, and exit nonzero unless the ledger
-contract holds (percentile monotonicity, counter consistency, the
-extras["serve"] key set).
+handful of requests synchronously across two traffic classes, and exit
+nonzero unless the ledger contract holds (percentile monotonicity,
+counter consistency, the extras["serve"] key set, per-tenant SLO
+attainment rows).
 
 Both are campaign-able: the executor appends `--json-out <ledger>` after
 the subcommand's flags, so a `[[job]] program = "serve"` with
@@ -28,7 +35,13 @@ from tpu_matmul_bench.serve.queue import (
     DEFAULT_MAX_BATCH,
     DEFAULT_MAX_DEPTH,
 )
-from tpu_matmul_bench.serve.service import ServeConfig, run_bench, run_selftest
+from tpu_matmul_bench.serve.scheduler import DEFAULT_STARVATION_MS
+from tpu_matmul_bench.serve.service import (
+    ServeConfig,
+    run_ab,
+    run_bench,
+    run_selftest,
+)
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -41,9 +54,24 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--grid", default=None,
                    help="padding grid points, comma-separated (default "
                         f"{','.join(str(g) for g in DEFAULT_GRID)})")
+    p.add_argument("--scheduler", default="continuous",
+                   choices=["fixed", "continuous"],
+                   help="admission path: 'fixed' = single FIFO with a "
+                        "micro-batch window, 'continuous' = multi-tenant "
+                        "weighted-fair continuous batching (default "
+                        "%(default)s)")
+    p.add_argument("--tenants", default=None,
+                   help="traffic classes: a [tenants.*] TOML path, or "
+                        "inline 'id=weight[/priority[/slo_ms]],...' "
+                        "(default: one 'default' tenant)")
+    p.add_argument("--starvation-ms", type=float,
+                   default=DEFAULT_STARVATION_MS,
+                   help="continuous scheduler aging guard: a head request "
+                        "waiting longer jumps the priority-class order "
+                        "(default %(default)s ms)")
     p.add_argument("--window-ms", type=float, default=2.0,
-                   help="micro-batch window after the head request's "
-                        "enqueue (default %(default)s ms)")
+                   help="fixed scheduler micro-batch window after the head "
+                        "request's enqueue (default %(default)s ms)")
     p.add_argument("--max-depth", type=int, default=DEFAULT_MAX_DEPTH,
                    help="admission queue depth; submissions beyond it are "
                         "shed (default %(default)s)")
@@ -81,23 +109,31 @@ def build_parser() -> argparse.ArgumentParser:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     sub = p.add_subparsers(dest="command", required=True)
 
+    def _add_load(sp: argparse.ArgumentParser) -> None:
+        sp.add_argument("--qps", type=float, default=50.0,
+                        help="open-loop offered load, Poisson arrivals "
+                             "(default %(default)s)")
+        sp.add_argument("--duration", type=float, default=2.0,
+                        dest="duration_s",
+                        help="load window length in seconds "
+                             "(default %(default)s)")
+        sp.add_argument("--concurrency", type=int, default=None,
+                        help="closed loop with N clients instead of the "
+                             "open-loop Poisson process (--qps is then "
+                             "ignored: arrivals are completion-driven)")
+        sp.add_argument("--prewarm", action="store_true",
+                        help="compile every mix bucket before the load "
+                             "window, so latencies are steady-state (the "
+                             "gated configuration)")
+        _add_common(sp)
+
     bench = sub.add_parser("bench", help="one load window → one ledger")
-    bench.add_argument("--qps", type=float, default=50.0,
-                       help="open-loop offered load, Poisson arrivals "
-                            "(default %(default)s)")
-    bench.add_argument("--duration", type=float, default=2.0,
-                       dest="duration_s",
-                       help="load window length in seconds "
-                            "(default %(default)s)")
-    bench.add_argument("--concurrency", type=int, default=None,
-                       help="closed loop with N clients instead of the "
-                            "open-loop Poisson process (--qps is then "
-                            "ignored: arrivals are completion-driven)")
-    bench.add_argument("--prewarm", action="store_true",
-                       help="compile every mix bucket before the load "
-                            "window, so latencies are steady-state (the "
-                            "gated configuration)")
-    _add_common(bench)
+    _add_load(bench)
+
+    ab = sub.add_parser(
+        "ab", help="fixed-window vs continuous scheduler at identical "
+                   "seeded load → two records, nonzero exit on regression")
+    _add_load(ab)
 
     selftest = sub.add_parser(
         "selftest", help="no-load ledger-contract check (CI hook)")
@@ -123,6 +159,9 @@ def _config_from(args: argparse.Namespace) -> ServeConfig:
         mix=args.mix,
         dtype_name=args.dtype_name,
         grid=_parse_grid(args.grid),
+        scheduler=args.scheduler,
+        tenants=args.tenants,
+        starvation_ms=args.starvation_ms,
         window_ms=args.window_ms,
         max_depth=args.max_depth,
         max_batch=args.max_batch,
@@ -137,7 +176,7 @@ def _config_from(args: argparse.Namespace) -> ServeConfig:
     )
     if args.cache_capacity is not None:
         kwargs["cache_capacity"] = args.cache_capacity
-    if args.command == "bench":
+    if args.command in ("bench", "ab"):
         kwargs.update(qps=args.qps, duration_s=args.duration_s,
                       concurrency=args.concurrency, prewarm=args.prewarm)
     return ServeConfig(**kwargs)
@@ -148,10 +187,13 @@ def main(argv: Sequence[str] | None = None):
     try:
         config = _config_from(args)
         config.mix_entries  # validate the mix spec before touching devices
+        config.tenant_specs  # ... and the tenant definitions
     except ValueError as e:
         raise SystemExit(f"serve: {e}")
     if args.command == "selftest":
         return run_selftest(config)
+    if args.command == "ab":
+        return run_ab(config)
     return run_bench(config)
 
 

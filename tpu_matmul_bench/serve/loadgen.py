@@ -25,10 +25,12 @@ resume story lean on.
 from __future__ import annotations
 
 import dataclasses
+import math
 import random
 from typing import Iterator, Sequence
 
 from tpu_matmul_bench.serve.queue import Request
+from tpu_matmul_bench.serve.tenants import TenantSpec
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,6 +110,113 @@ def open_loop_schedule(
         rid += 1
         t += rng.expovariate(qps)
     return schedule
+
+
+def _tenant_rng(seed: int, tenant_id: str) -> random.Random:
+    """One RNG per tenant, derived from (seed, tenant id). String
+    seeding hashes through sha512 (stable across processes/platforms),
+    so each tenant's stream is byte-deterministic AND independent of
+    every other tenant — adding a tenant to a profile never perturbs
+    the existing tenants' schedules."""
+    return random.Random(f"{seed}:{tenant_id}")
+
+
+def _rate_factor(spec: TenantSpec, t: float, duration_s: float,
+                 burst_phase: float) -> float:
+    """The tenant's instantaneous rate multiplier at offset `t`: the
+    diurnal ramp (one sine cycle over the window — a day compressed to
+    the load window) times the burst multiplier when `t` falls inside a
+    seeded burst interval."""
+    f = 1.0
+    if spec.ramp > 0:
+        f *= 1.0 + spec.ramp * math.sin(2 * math.pi * t / duration_s)
+    if spec.burst_x > 1.0 and spec.burst_every_s > 0:
+        if ((t - burst_phase) % spec.burst_every_s) < spec.burst_for_s:
+            f *= spec.burst_x
+    return f
+
+
+def tenant_open_loop_schedule(
+    tenants: Sequence[TenantSpec],
+    *,
+    qps: float,
+    duration_s: float,
+    dtype: str,
+    seed: int = 0,
+    default_mix: str = DEFAULT_MIX,
+) -> list[Request]:
+    """Mixed-tenant Poisson arrivals: total offered load `qps` divides
+    by `load_share`; each tenant's stream is an independent seeded
+    inhomogeneous Poisson process (thinning against its ramp/burst
+    profile) over its own mix. The merged schedule is a pure function
+    of (tenants, qps, duration, seed) — per-tenant subsequences don't
+    change when other tenants are added or edited; only the merged
+    `rid` numbering does."""
+    if qps <= 0 or duration_s <= 0:
+        raise ValueError(f"need qps > 0 and duration > 0, got "
+                         f"qps={qps} duration={duration_s}")
+    if not tenants:
+        raise ValueError("need at least one tenant")
+    total_share = sum(t.load_share for t in tenants)
+    if total_share <= 0:
+        raise ValueError("tenant load shares sum to 0 — no traffic")
+    merged: list[tuple[float, str, int, MixEntry]] = []
+    for spec in tenants:
+        base = qps * spec.load_share / total_share
+        if base <= 0:
+            continue
+        rng = _tenant_rng(seed, spec.tenant_id)
+        mix = parse_mix(spec.mix or default_mix)
+        shapes = _shape_stream(mix, rng)
+        burst_phase = rng.uniform(0, spec.burst_every_s) \
+            if spec.burst_every_s > 0 else 0.0
+        # thinning: draw homogeneous arrivals at the profile's peak
+        # rate, keep each with probability factor(t)/peak — a standard
+        # exact simulation of the inhomogeneous process, deterministic
+        # under the tenant's rng
+        peak = (1.0 + spec.ramp) * max(spec.burst_x, 1.0)
+        t = rng.expovariate(base * peak)
+        seq = 0
+        while t < duration_s:
+            keep = rng.random() < _rate_factor(
+                spec, t, duration_s, burst_phase) / peak
+            e = next(shapes)  # drawn even when thinned: keeps the shape
+            if keep:          # stream aligned with the arrival stream
+                merged.append((t, spec.tenant_id, seq, e))
+                seq += 1
+            t += rng.expovariate(base * peak)
+    merged.sort(key=lambda item: (item[0], item[1], item[2]))
+    return [Request(rid=rid, m=e.m, k=e.k, n=e.n, dtype=dtype,
+                    arrival_s=t, tenant=tid)
+            for rid, (t, tid, _seq, e) in enumerate(merged)]
+
+
+def tenant_closed_loop_shapes(
+    tenants: Sequence[TenantSpec],
+    *,
+    dtype: str,
+    seed: int = 0,
+    default_mix: str = DEFAULT_MIX,
+) -> Iterator[Request]:
+    """Endless deterministic mixed-tenant stream for closed-loop
+    clients: each request's tenant is drawn by load share, its shape
+    from that tenant's mix (ramp/burst profiles don't apply — closed
+    loops have no clock)."""
+    specs = list(tenants)
+    shares = [t.load_share for t in specs]
+    if not specs or sum(shares) <= 0:
+        raise ValueError("need at least one tenant with load share > 0")
+    rng = random.Random(seed)
+    streams = {t.tenant_id: _shape_stream(parse_mix(t.mix or default_mix),
+                                          _tenant_rng(seed, t.tenant_id))
+               for t in specs}
+    rid = 0
+    while True:
+        spec = rng.choices(specs, weights=shares, k=1)[0]
+        e = next(streams[spec.tenant_id])
+        yield Request(rid=rid, m=e.m, k=e.k, n=e.n, dtype=dtype,
+                      tenant=spec.tenant_id)
+        rid += 1
 
 
 def closed_loop_shapes(

@@ -60,6 +60,8 @@ class Request:
     arrival_s: float = 0.0  # planned offset in the load schedule
     submitted_at: float = 0.0  # wall clock at successful submit
     bucket: tuple[int, int, int] | None = None  # stamped on admission
+    tenant: str = "default"  # traffic class (serve/tenants.py)
+    dispatched_at: float = 0.0  # wall clock when its batch was taken
 
 
 class ShapeGrid:
@@ -116,6 +118,11 @@ class AdmissionQueue:
         self._m_submitted = reg.counter("serve_queue_submitted_total")
         self._m_shed = reg.counter("serve_queue_shed_total")
         self._m_depth = reg.gauge("serve_queue_depth")
+        # shed attribution by traffic class: the fixed-window queue sheds
+        # whoever hits the full queue — recording WHO was shed is what
+        # lets the A/B harness show that indiscriminate shedding spills
+        # onto well-behaved tenants (scheduler.py sheds selectively)
+        self._shed_by_tenant: dict[str, int] = {}
 
     # -- compat view: pre-registry int attributes, reading the bus
     @property
@@ -131,6 +138,11 @@ class AdmissionQueue:
         with self._cond:
             return len(self._items)
 
+    @property
+    def offered(self) -> int:
+        """Distinct submission attempts (admitted + shed at the door)."""
+        return self.submitted + self.shed
+
     def submit(self, req: Request) -> Request:
         """Admit a request (stamping its bucket + submit time), or raise
         `QueueOverflowError` without blocking when the queue is full."""
@@ -140,6 +152,8 @@ class AdmissionQueue:
                 raise RuntimeError("queue is closed to new submissions")
             if len(self._items) >= self.max_depth:
                 self._m_shed.inc()
+                self._shed_by_tenant[req.tenant] = \
+                    self._shed_by_tenant.get(req.tenant, 0) + 1
                 raise QueueOverflowError(len(self._items), self.max_depth)
             req.submitted_at = time.perf_counter()
             self._items.append((req.submitted_at, req))
@@ -192,14 +206,22 @@ class AdmissionQueue:
                 self._items = [it for it in self._items
                                if id(it[1]) not in picked]
                 self._m_depth.set(len(self._items))
+                dispatch = time.perf_counter()
+                for r in batch:
+                    r.dispatched_at = dispatch
                 return batch
 
     def stats(self) -> dict[str, Any]:
         with self._cond:
-            return {
+            out: dict[str, Any] = {
+                "scheduler": "fixed",
                 "submitted": self.submitted,
                 "shed": self.shed,
                 "max_depth": self.max_depth,
                 "window_ms": round(self.window_s * 1e3, 3),
                 "max_batch": self.max_batch,
             }
+            if self._shed_by_tenant:
+                out["shed_by_tenant"] = dict(sorted(
+                    self._shed_by_tenant.items()))
+            return out

@@ -38,6 +38,8 @@ from tpu_matmul_bench.serve.loadgen import (
     closed_loop_shapes,
     open_loop_schedule,
     parse_mix,
+    tenant_closed_loop_shapes,
+    tenant_open_loop_schedule,
 )
 from tpu_matmul_bench.serve.queue import (
     DEFAULT_MAX_BATCH,
@@ -45,6 +47,15 @@ from tpu_matmul_bench.serve.queue import (
     AdmissionQueue,
     Request,
     ShapeGrid,
+)
+from tpu_matmul_bench.serve.scheduler import (
+    DEFAULT_STARVATION_MS,
+    ContinuousScheduler,
+)
+from tpu_matmul_bench.serve.tenants import (
+    DEFAULT_TENANTS,
+    TenantSpec,
+    parse_tenants_arg,
 )
 from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.errors import QueueOverflowError
@@ -73,6 +84,9 @@ class ServeConfig:
     qps: float = 50.0
     duration_s: float = 2.0
     concurrency: int | None = None  # None → open loop
+    scheduler: str = "continuous"  # "fixed" (AdmissionQueue) | "continuous"
+    tenants: str | None = None  # --tenants value (TOML path / inline / None)
+    starvation_ms: float = DEFAULT_STARVATION_MS
     window_ms: float = 2.0
     max_depth: int = DEFAULT_MAX_DEPTH
     max_batch: int = DEFAULT_MAX_BATCH
@@ -96,6 +110,10 @@ class ServeConfig:
     def load_mode(self) -> str:
         return "closed" if self.concurrency else "open"
 
+    @property
+    def tenant_specs(self) -> tuple[TenantSpec, ...]:
+        return parse_tenants_arg(self.tenants)
+
 
 @dataclasses.dataclass
 class Sample:
@@ -106,6 +124,8 @@ class Sample:
     latency_s: float  # admission → post-sync completion (client view)
     service_s: float  # dispatch → post-sync (executable alone)
     cold: bool  # this request triggered the bucket's compile
+    tenant: str = "default"  # traffic class the request belonged to
+    wait_s: float = 0.0  # admission → batch dispatch (pure queueing)
 
 
 class _OperandPool:
@@ -165,6 +185,10 @@ def _worker_drain(
     reg = get_registry()
     m_requests = reg.counter("serve_requests_total")
     latency_hists: dict[str, Any] = {}
+    wait_hists: dict[str, Any] = {}
+    # continuous scheduler only: measured service time feeds its EWMA
+    # estimate that prices per-tenant SLO shedding
+    note_service = getattr(q, "note_service", None)
     while (batch := q.take_batch()) is not None:
         m, k, n = batch[0].bucket
         key = ExecKey(m=m, k=k, n=n, dtype=batch[0].dtype, impl=impl,
@@ -175,6 +199,7 @@ def _worker_drain(
         if hist is None:
             hist = latency_hists[key.label] = reg.histogram(
                 "serve_latency_ms", bucket=key.label)
+        batch_t0 = time.perf_counter()
         for req in batch:
             t0 = time.perf_counter()
             # per-request get: the batch's first miss pays the cold
@@ -185,16 +210,26 @@ def _worker_drain(
             out = entry.compiled(a, b)
             sync(out)
             done = time.perf_counter()
+            wait_s = max(req.dispatched_at - req.submitted_at, 0.0)
             samples.append(Sample(
                 rid=req.rid, bucket=key.label,
                 latency_s=done - req.submitted_at,
                 service_s=done - t0,
-                cold=not was_cached))
+                cold=not was_cached,
+                tenant=req.tenant,
+                wait_s=wait_s))
             m_requests.inc()
             hist.observe((done - req.submitted_at) * 1e3)
+            whist = wait_hists.get(req.tenant)
+            if whist is None:
+                whist = wait_hists[req.tenant] = reg.histogram(
+                    "serve_wait_ms", tenant=req.tenant)
+            whist.observe(wait_s * 1e3)
             was_cached = True  # only the batch's first request was cold
             if on_complete is not None:
                 on_complete(req)
+        if note_service is not None:
+            note_service(time.perf_counter() - batch_t0, len(batch))
 
 
 def _open_loop_producer(q: AdmissionQueue, schedule: Sequence[Request],
@@ -251,6 +286,55 @@ def _p99_noise_pct(latencies_s: Sequence[float]) -> float:
     return round(min(100.0 * abs(a - b) / mid / 2, P99_NOISE_CAP_PCT), 2)
 
 
+def _tenant_rows(
+    samples: Sequence[Sample],
+    qstats: dict[str, Any],
+    tenants: Sequence[TenantSpec],
+) -> tuple[dict[str, Any], int]:
+    """Per-tenant ledger rows + the total count of SLO-attaining
+    completions (the goodput numerator; no-SLO tenants attain by
+    definition — every completion is good work)."""
+    if qstats.get("scheduler") == "continuous":
+        shed_by = {tid: t["shed"]
+                   for tid, t in qstats.get("tenants", {}).items()}
+    else:
+        shed_by = qstats.get("shed_by_tenant", {})
+    spec_by = {t.tenant_id: t for t in tenants}
+    by: dict[str, list[Sample]] = {}
+    for s in samples:
+        by.setdefault(s.tenant, []).append(s)
+    rows: dict[str, Any] = {}
+    good_total = 0
+    for tid in sorted(set(by) | set(spec_by)):
+        ss = by.get(tid, [])
+        spec = spec_by.get(tid)
+        slo = spec.slo_ms if spec else None
+        good = sum(1 for s in ss
+                   if slo is None or s.latency_s * 1e3 <= slo)
+        good_total += good
+        shed = int(shed_by.get(tid, 0))
+        done = len(ss)
+        row: dict[str, Any] = {
+            "requests": done,
+            "shed": shed,
+            "shed_rate_pct": round(100.0 * shed / (done + shed), 2)
+            if done + shed else 0.0,
+            **_percentiles_ms([s.latency_s for s in ss]),
+            "wait_p50_ms": _percentiles_ms(
+                [s.wait_s for s in ss])["p50_ms"],
+            "wait_p99_ms": _percentiles_ms(
+                [s.wait_s for s in ss])["p99_ms"],
+            "slo_ms": slo,
+            "slo_attainment_pct": round(100.0 * good / done, 2)
+            if done else 100.0,
+        }
+        if spec is not None:
+            row["weight"] = spec.weight
+            row["priority"] = spec.priority
+        rows[tid] = row
+    return rows, good_total
+
+
 def serve_stats(
     samples: Sequence[Sample],
     q: AdmissionQueue,
@@ -261,45 +345,71 @@ def serve_stats(
     wall_s: float,
     requested_flops: float,
     executed_flops: float,
+    tenants: Sequence[TenantSpec] = DEFAULT_TENANTS,
+    bucket_flops: dict[str, tuple[float, float]] | None = None,
 ) -> dict[str, Any]:
     """The ledger's `extras["serve"]` block — every serving headline in
     one self-describing dict (digest_jsonl renders it as the latency
-    table; campaign/store.py reads p99_ms + p99_noise_pct for the gate)."""
+    table; campaign/store.py reads p99_ms + p99_noise_pct for the gate,
+    goodput_qps + slo_attainment_pct for the SLO rows)."""
     lat = [s.latency_s for s in samples]
     submitted = q.submitted + q.shed  # offered = admitted + shed
+    qstats = q.stats()
+    tenant_rows, good = _tenant_rows(samples, qstats, tenants)
     stats: dict[str, Any] = {
         "load_mode": load_mode,
+        "scheduler": qstats.get("scheduler", "fixed"),
         "requests": len(samples),
         "shed": q.shed,
         "shed_rate_pct": round(100.0 * q.shed / submitted, 2)
         if submitted else 0.0,
         "achieved_qps": round(len(samples) / wall_s, 2) if wall_s > 0 else 0.0,
+        # goodput: completions WITHIN their tenant's SLO per second —
+        # the A/B's "≥ equal goodput" criterion; a scheduler that trades
+        # throughput for missed budgets loses here even if QPS holds
+        "goodput_qps": round(good / wall_s, 2) if wall_s > 0 else 0.0,
+        "slo_attainment_pct": round(100.0 * good / len(samples), 2)
+        if samples else 100.0,
         "wall_s": round(wall_s, 4),
         **_percentiles_ms(lat),
         "service_p50_ms": _percentiles_ms(
             [s.service_s for s in samples])["p50_ms"],
+        "wait_p99_ms": _percentiles_ms([s.wait_s for s in samples])["p99_ms"],
         "p99_noise_pct": _p99_noise_pct(lat),
         "cold_requests": sum(s.cold for s in samples),
         "padding_overhead_pct": round(
             100.0 * (executed_flops - requested_flops) / requested_flops, 2)
         if requested_flops else 0.0,
-        "queue": q.stats(),
+        "queue": qstats,
         "cache": cache.stats(),
-        "buckets": _bucket_breakdown(samples),
+        "buckets": _bucket_breakdown(samples, bucket_flops),
+        "tenants": tenant_rows,
     }
     if offered_qps is not None:
         stats["offered_qps"] = round(offered_qps, 2)
     return stats
 
 
-def _bucket_breakdown(samples: Sequence[Sample]) -> dict[str, Any]:
+def _bucket_breakdown(
+    samples: Sequence[Sample],
+    bucket_flops: dict[str, tuple[float, float]] | None = None,
+) -> dict[str, Any]:
     by: dict[str, list[float]] = {}
     for s in samples:
         by.setdefault(s.bucket, []).append(s.latency_s)
-    return {
-        label: {"count": len(lat), **_percentiles_ms(lat)}
-        for label, lat in sorted(by.items())
-    }
+    out: dict[str, Any] = {}
+    for label, lat in sorted(by.items()):
+        row = {"count": len(lat), **_percentiles_ms(lat)}
+        req_exe = (bucket_flops or {}).get(label)
+        if req_exe and req_exe[1] > 0:
+            # padded-vs-requested efficiency: the share of this bucket's
+            # executed FLOPs the clients actually asked for (100% = the
+            # grid point fit exactly; low % = the grid is too coarse for
+            # this traffic and the device burns time on padding)
+            row["flops_efficiency_pct"] = round(
+                100.0 * req_exe[0] / req_exe[1], 2)
+        out[label] = row
+    return out
 
 
 def _serve_record(config: ServeConfig, stats: dict[str, Any],
@@ -341,12 +451,15 @@ def _report_summary(stats: dict[str, Any]) -> None:
     cache = stats["cache"]
     lines = [
         "\nServing results:",
+        f"  - Scheduler: {stats['scheduler']}",
         f"  - Requests completed: {stats['requests']} "
         f"({stats['achieved_qps']} QPS achieved"
         + (f", {stats['offered_qps']} offered" if "offered_qps" in stats
            else "") + ")",
         f"  - Latency p50/p95/p99/max: {stats['p50_ms']} / "
         f"{stats['p95_ms']} / {stats['p99_ms']} / {stats['max_ms']} ms",
+        f"  - Goodput: {stats['goodput_qps']} QPS within SLO "
+        f"({stats['slo_attainment_pct']}% attainment)",
         f"  - Shed: {stats['shed']} ({stats['shed_rate_pct']}%)",
         f"  - Cache: {cache['hits']} hits / {cache['misses']} misses "
         f"({cache['hit_rate_pct']}% hit rate, "
@@ -360,6 +473,17 @@ def _report_summary(stats: dict[str, Any]) -> None:
         lines.append(
             f"      {label}: cold compile {e['cold_compile_ms']} ms, "
             f"warm dispatch {e['warm_dispatch_ms']} ms, {e['hits']} hits")
+    tenants = stats.get("tenants", {})
+    if len(tenants) > 1:
+        lines.append("  - Tenants:")
+        for tid, row in tenants.items():
+            slo = (f"slo {row['slo_ms']:g} ms, "
+                   f"{row['slo_attainment_pct']}% attained"
+                   if row["slo_ms"] is not None else "no slo")
+            lines.append(
+                f"      {tid}: {row['requests']} done / {row['shed']} "
+                f"shed, p99 {row['p99_ms']} ms (wait {row['wait_p99_ms']} "
+                f"ms), {slo}")
     report(*lines)
 
 
@@ -384,8 +508,29 @@ def _attach_cost_analysis(rec: BenchmarkRecord,
         rec.extras["cost_analysis"] = blocks
 
 
-def _setup(config: ServeConfig):
-    """Device + plumbing shared by bench and selftest."""
+def _make_admission(config: ServeConfig, grid: ShapeGrid,
+                    tenants: Sequence[TenantSpec],
+                    scheduler: str | None = None):
+    """The admission path behind the A/B flag: the fixed-window
+    `AdmissionQueue` or the continuous-batching `ContinuousScheduler`
+    (both share the submit/take_batch/stats contract)."""
+    which = scheduler or config.scheduler
+    if which == "fixed":
+        return AdmissionQueue(grid, max_depth=config.max_depth,
+                              window_s=config.window_ms / 1e3,
+                              max_batch=config.max_batch)
+    if which == "continuous":
+        return ContinuousScheduler(grid, tenants=tenants,
+                                   max_depth=config.max_depth,
+                                   max_batch=config.max_batch,
+                                   starvation_ms=config.starvation_ms)
+    raise ValueError(f"unknown scheduler {which!r} "
+                     "(want 'fixed' or 'continuous')")
+
+
+def _setup(config: ServeConfig,
+           tenants: Sequence[TenantSpec] | None = None):
+    """Device + plumbing shared by bench, ab, and selftest."""
     from tpu_matmul_bench.utils.device import (
         collect_device_info,
         device_banner,
@@ -398,41 +543,56 @@ def _setup(config: ServeConfig):
     pool = _OperandPool(config.seed)
     cache = _make_cache(config, info.device_kind, pool)
     grid = ShapeGrid(config.grid) if config.grid else ShapeGrid()
-    q = AdmissionQueue(grid, max_depth=config.max_depth,
-                       window_s=config.window_ms / 1e3,
-                       max_batch=config.max_batch)
-    return devices, info, pool, cache, q
+    if tenants is None:
+        tenants = config.tenant_specs
+    q = _make_admission(config, grid, tenants)
+    return devices, info, pool, cache, q, tenants
 
 
 def _prewarm(config: ServeConfig, grid: ShapeGrid, cache: ExecutableCache,
-             world: int) -> int:
+             world: int,
+             tenants: Sequence[TenantSpec] = DEFAULT_TENANTS) -> int:
     """Compile every mix bucket before load so the measured window is
     steady-state (the campaign gate's serve spec uses this — a p99 that
-    sometimes contains a cold compile gates nothing)."""
+    sometimes contains a cold compile gates nothing). Tenant-local mixes
+    contribute their buckets too."""
+    entries = list(config.mix_entries)
+    for t in tenants:
+        if t.mix:
+            entries.extend(parse_mix(t.mix))
     keys = {ExecKey(*grid.bucket(e.m, e.k, e.n), dtype=config.dtype_name,
                     impl=config.matmul_impl, mesh_shape=(world,))
-            for e in config.mix_entries}
+            for e in entries}
     with telemetry.span("prewarm", buckets=len(keys)):
         return cache.warm_start(keys)
 
 
-def _flops(samples: Sequence[Sample],
-           schedule_shapes: dict[int, tuple[int, int, int]]) -> tuple[float, float]:
-    """(requested, executed) FLOPs over the completed samples: requested
-    at the asked shape, executed at the padded bucket shape."""
+def _flops(
+    samples: Sequence[Sample],
+    schedule_shapes: dict[int, tuple[int, int, int]],
+) -> tuple[float, float, dict[str, tuple[float, float]]]:
+    """(requested, executed, per-bucket {label: (requested, executed)})
+    FLOPs over the completed samples: requested at the asked shape,
+    executed at the padded bucket shape. The per-bucket split is what
+    prices each bucket's padding efficiency in `extras["serve"]`."""
     requested = executed = 0.0
+    per_bucket: dict[str, list[float]] = {}
     for s in samples:
         bm, bk, bn = (int(d) for d in s.bucket.split("/")[0].split("x"))
-        executed += 2.0 * bm * bk * bn
+        exe = 2.0 * bm * bk * bn
         rm, rk, rn = schedule_shapes.get(s.rid, (bm, bk, bn))
-        requested += 2.0 * rm * rk * rn
-    return requested, executed
+        req = 2.0 * rm * rk * rn
+        requested += req
+        executed += exe
+        pb = per_bucket.setdefault(s.bucket, [0.0, 0.0])
+        pb[0] += req
+        pb[1] += exe
+    return requested, executed, {
+        label: (r, e) for label, (r, e) in per_bucket.items()}
 
 
-def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
-    """The `serve bench` program: one load run → one ledger."""
-    devices, info, pool, cache, q = _setup(config)
-    world = len(devices)
+def _bench_header(config: ServeConfig, scheduler: str,
+                  tenants: Sequence[TenantSpec]) -> None:
     report(header(
         "Matmul Serving Benchmark (latency under load)",
         {
@@ -442,55 +602,85 @@ def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
             "Duration": f"{config.duration_s} s",
             "Request mix": config.mix,
             "Data type": config.dtype_name,
-            "Micro-batch window": f"{config.window_ms} ms",
+            "Scheduler": scheduler
+            + (f" ({config.window_ms} ms window)" if scheduler == "fixed"
+               else f" ({config.starvation_ms:g} ms starvation guard)"),
+            "Tenants": ", ".join(t.tenant_id for t in tenants),
             "Queue depth": config.max_depth,
             "Matmul implementation": config.matmul_impl,
         },
     ))
 
+
+def _run_load(
+    config: ServeConfig,
+    pool: _OperandPool,
+    cache: ExecutableCache,
+    q,
+    tenants: Sequence[TenantSpec],
+    world: int,
+) -> tuple[list[Sample], float, dict[int, tuple[int, int, int]]]:
+    """One producer+worker load run against an already-built admission
+    path: (samples, wall_s, rid → requested shape)."""
     samples: list[Sample] = []
     schedule_shapes: dict[int, tuple[int, int, int]] = {}
-    with telemetry.session(config.trace_out), _exporter(config):
-        prewarmed = _prewarm(config, q.grid, cache, world) \
-            if config.prewarm else 0
-        with telemetry.span("load", mode=config.load_mode):
-            t0 = time.perf_counter()
-            if config.concurrency:
-                requests = closed_loop_shapes(
-                    config.mix_entries, dtype=config.dtype_name,
-                    seed=config.seed)
-                seen = _recording(requests, schedule_shapes)
-                sem = threading.Semaphore(config.concurrency)
-                producer = threading.Thread(
-                    target=_closed_loop_producer,
-                    args=(q, seen, t0 + config.duration_s, sem),
-                    daemon=True)
-                producer.start()
-                _worker_drain(q, cache, pool, samples,
-                              impl=config.matmul_impl, mesh_shape=(world,),
-                              on_complete=lambda _r: sem.release())
-            else:
-                schedule = open_loop_schedule(
-                    config.mix_entries, qps=config.qps,
-                    duration_s=config.duration_s,
-                    dtype=config.dtype_name, seed=config.seed)
-                schedule_shapes.update(
-                    {r.rid: (r.m, r.k, r.n) for r in schedule})
-                producer = threading.Thread(
-                    target=_open_loop_producer, args=(q, schedule, t0),
-                    daemon=True)
-                producer.start()
-                _worker_drain(q, cache, pool, samples,
-                              impl=config.matmul_impl, mesh_shape=(world,))
-            producer.join()
-            wall_s = time.perf_counter() - t0
+    multi = config.tenants is not None
+    with telemetry.span("load", mode=config.load_mode):
+        t0 = time.perf_counter()
+        if config.concurrency:
+            requests = tenant_closed_loop_shapes(
+                tenants, dtype=config.dtype_name, seed=config.seed,
+                default_mix=config.mix) if multi else closed_loop_shapes(
+                config.mix_entries, dtype=config.dtype_name,
+                seed=config.seed)
+            seen = _recording(requests, schedule_shapes)
+            sem = threading.Semaphore(config.concurrency)
+            producer = threading.Thread(
+                target=_closed_loop_producer,
+                args=(q, seen, t0 + config.duration_s, sem),
+                daemon=True)
+            producer.start()
+            _worker_drain(q, cache, pool, samples,
+                          impl=config.matmul_impl, mesh_shape=(world,),
+                          on_complete=lambda _r: sem.release())
+        else:
+            schedule = tenant_open_loop_schedule(
+                tenants, qps=config.qps, duration_s=config.duration_s,
+                dtype=config.dtype_name, seed=config.seed,
+                default_mix=config.mix) if multi else open_loop_schedule(
+                config.mix_entries, qps=config.qps,
+                duration_s=config.duration_s,
+                dtype=config.dtype_name, seed=config.seed)
+            schedule_shapes.update(
+                {r.rid: (r.m, r.k, r.n) for r in schedule})
+            producer = threading.Thread(
+                target=_open_loop_producer, args=(q, schedule, t0),
+                daemon=True)
+            producer.start()
+            _worker_drain(q, cache, pool, samples,
+                          impl=config.matmul_impl, mesh_shape=(world,))
+        producer.join()
+        wall_s = time.perf_counter() - t0
+    return samples, wall_s, schedule_shapes
 
-        requested_f, executed_f = _flops(samples, schedule_shapes)
+
+def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
+    """The `serve bench` program: one load run → one ledger."""
+    devices, info, pool, cache, q, tenants = _setup(config)
+    world = len(devices)
+    _bench_header(config, config.scheduler, tenants)
+    with telemetry.session(config.trace_out), _exporter(config):
+        prewarmed = _prewarm(config, q.grid, cache, world, tenants) \
+            if config.prewarm else 0
+        samples, wall_s, schedule_shapes = _run_load(
+            config, pool, cache, q, tenants, world)
+        requested_f, executed_f, bucket_f = _flops(samples, schedule_shapes)
         stats = serve_stats(
             samples, q, cache, load_mode=config.load_mode,
             offered_qps=None if config.concurrency else config.qps,
             wall_s=wall_s, requested_flops=requested_f,
-            executed_flops=executed_f)
+            executed_flops=executed_f, tenants=tenants,
+            bucket_flops=bucket_f)
         rec = _serve_record(config, stats, samples, info.device_kind, world,
                             mode=config.load_mode,
                             executed_flops=executed_f, wall_s=wall_s,
@@ -503,6 +693,106 @@ def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
                         append=config.append_ledger) as writer:
             writer.write(rec)
     return [rec]
+
+
+def run_ab(config: ServeConfig) -> list[BenchmarkRecord]:
+    """The `serve ab` program: the SAME seeded offered load through the
+    fixed-window queue, then through the continuous scheduler — two
+    records in one ledger, with the noise-aware verdict on the
+    continuous record's ``extras["ab"]``. Exits nonzero when continuous
+    batching regresses p99 or goodput beyond the widened tolerance: the
+    in-repo, CPU-verifiable form of the PR's perf claim."""
+    from tpu_matmul_bench.campaign.gate import tolerance_pct
+
+    from tpu_matmul_bench.utils.device import (
+        collect_device_info,
+        device_banner,
+        resolve_devices,
+    )
+
+    devices = resolve_devices(config.device, config.num_devices)
+    info = collect_device_info(devices)
+    report(device_banner(info))
+    world = len(devices)
+    tenants = config.tenant_specs
+    grid = ShapeGrid(config.grid) if config.grid else ShapeGrid()
+
+    records: list[BenchmarkRecord] = []
+    arm_stats: dict[str, dict[str, Any]] = {}
+    with telemetry.session(config.trace_out), _exporter(config):
+        for arm in ("fixed", "continuous"):
+            _bench_header(config, arm, tenants)
+            # fresh operand pool + cache + admission per arm: neither arm
+            # inherits the other's compiled executables, so cold-compile
+            # placement is identical and the comparison is pure policy
+            pool = _OperandPool(config.seed)
+            cache = _make_cache(config, info.device_kind, pool)
+            q = _make_admission(config, grid, tenants, scheduler=arm)
+            prewarmed = _prewarm(config, grid, cache, world, tenants) \
+                if config.prewarm else 0
+            samples, wall_s, shapes = _run_load(
+                config, pool, cache, q, tenants, world)
+            requested_f, executed_f, bucket_f = _flops(samples, shapes)
+            stats = serve_stats(
+                samples, q, cache, load_mode=config.load_mode,
+                offered_qps=None if config.concurrency else config.qps,
+                wall_s=wall_s, requested_flops=requested_f,
+                executed_flops=executed_f, tenants=tenants,
+                bucket_flops=bucket_f)
+            rec = _serve_record(config, stats, samples, info.device_kind,
+                                world, mode=config.load_mode,
+                                executed_flops=executed_f, wall_s=wall_s,
+                                prewarmed=prewarmed)
+            _attach_cost_analysis(rec, cache)
+            _report_summary(stats)
+            arm_stats[arm] = stats
+            records.append(rec)
+
+        fixed, cont = arm_stats["fixed"], arm_stats["continuous"]
+        tol = tolerance_pct(0.0,
+                            {"noise_pct": fixed["p99_noise_pct"]},
+                            {"noise_pct": cont["p99_noise_pct"]})
+        base_p99 = fixed["p99_ms"] or 1e-9
+        p99_delta = 100.0 * (cont["p99_ms"] - base_p99) / base_p99
+        base_good = fixed["goodput_qps"] or 1e-9
+        good_delta = 100.0 * (cont["goodput_qps"] - base_good) / base_good
+        regressed = p99_delta > tol or good_delta < -tol
+        verdict = {
+            "baseline": "fixed",
+            "candidate": "continuous",
+            "p99_fixed_ms": fixed["p99_ms"],
+            "p99_continuous_ms": cont["p99_ms"],
+            "p99_delta_pct": round(p99_delta, 2),
+            "goodput_fixed_qps": fixed["goodput_qps"],
+            "goodput_continuous_qps": cont["goodput_qps"],
+            "goodput_delta_pct": round(good_delta, 2),
+            "slo_attainment_fixed_pct": fixed["slo_attainment_pct"],
+            "slo_attainment_continuous_pct": cont["slo_attainment_pct"],
+            "tolerance_pct": tol,
+            "regressed": regressed,
+        }
+        records[-1].extras["ab"] = verdict
+        report(
+            "\nA/B verdict (fixed-window → continuous):",
+            f"  - p99: {fixed['p99_ms']} → {cont['p99_ms']} ms "
+            f"({p99_delta:+.1f}%)",
+            f"  - goodput: {fixed['goodput_qps']} → "
+            f"{cont['goodput_qps']} QPS ({good_delta:+.1f}%)",
+            f"  - SLO attainment: {fixed['slo_attainment_pct']} → "
+            f"{cont['slo_attainment_pct']} %",
+            f"  - tolerance ±{tol}% (noise-aware) → "
+            + ("REGRESSED" if regressed else "ok"),
+        )
+        with JsonWriter(config.json_out,
+                        manifest=telemetry.build_manifest(
+                            extra={"serve_config": _config_manifest(
+                                config, "ab")}),
+                        append=config.append_ledger) as writer:
+            for rec in records:
+                writer.write(rec)
+    if regressed:
+        raise SystemExit(1)
+    return records
 
 
 def _recording(requests: Iterator[Request],
@@ -521,6 +811,9 @@ def _config_manifest(config: ServeConfig,
         "qps": config.qps,
         "duration_s": config.duration_s,
         "concurrency": config.concurrency,
+        "scheduler": config.scheduler,
+        "tenants": config.tenants,
+        "starvation_ms": config.starvation_ms,
         "window_ms": config.window_ms,
         "max_depth": config.max_depth,
         "max_batch": config.max_batch,
@@ -532,20 +825,34 @@ def _config_manifest(config: ServeConfig,
 
 SELFTEST_REQUESTS = 10
 
+# Selftest traffic classes when --tenants is not given: two classes over
+# the run's global mix (one shape → one executable, preserving the
+# selftest's single-warm-start contract) with generous SLOs no sane CI
+# box misses, exercising the per-tenant SLO-attainment rows end to end.
+SELFTEST_TENANTS = (
+    TenantSpec("interactive", weight=2.0, priority=0, slo_ms=5000.0),
+    TenantSpec("bulk", weight=1.0, priority=1, slo_ms=5000.0),
+)
+
 
 def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
     """No-load sanity pass: warm-start one entry's executable, serve
-    SELFTEST_REQUESTS requests synchronously, validate the ledger
-    contract — including that the preloaded bucket recorded zero cold
-    requests (the warm-start guarantee the tuning DB's AOT path rests
-    on). Exits nonzero on any violated invariant — the CI hook that
-    keeps the serving path honest without a load run."""
-    devices, info, pool, cache, q = _setup(config)
+    SELFTEST_REQUESTS requests (round-robin over two traffic classes)
+    synchronously, validate the ledger contract — including that the
+    preloaded bucket recorded zero cold requests (the warm-start
+    guarantee the tuning DB's AOT path rests on) and that the per-tenant
+    SLO-attainment rows reconcile. Exits nonzero on any violated
+    invariant — the CI hook that keeps the serving path honest without a
+    load run."""
+    tenants = config.tenant_specs if config.tenants else SELFTEST_TENANTS
+    devices, info, pool, cache, q, tenants = _setup(config, tenants)
     world = len(devices)
     report(header("Serve selftest (no load)", {
         "Requests": SELFTEST_REQUESTS,
         "Request mix": config.mix,
         "Data type": config.dtype_name,
+        "Scheduler": config.scheduler,
+        "Tenants": ", ".join(t.tenant_id for t in tenants),
     }))
     e = config.mix_entries[0]
     key = ExecKey(*q.grid.bucket(e.m, e.k, e.n), dtype=config.dtype_name,
@@ -557,16 +864,18 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
         t0 = time.perf_counter()
         for rid in range(SELFTEST_REQUESTS):
             q.submit(Request(rid=rid, m=e.m, k=e.k, n=e.n,
-                             dtype=config.dtype_name))
+                             dtype=config.dtype_name,
+                             tenant=tenants[rid % len(tenants)].tenant_id))
         q.close()
         _worker_drain(q, cache, pool, samples, impl=config.matmul_impl,
                       mesh_shape=(world,))
         wall_s = time.perf_counter() - t0
-        requested_f, executed_f = _flops(samples, {})
+        requested_f, executed_f, bucket_f = _flops(samples, {})
         stats = serve_stats(samples, q, cache, load_mode="selftest",
                             offered_qps=None, wall_s=wall_s,
                             requested_flops=requested_f,
-                            executed_flops=executed_f)
+                            executed_flops=executed_f, tenants=tenants,
+                            bucket_flops=bucket_f)
         rec = _serve_record(config, stats, samples, info.device_kind, world,
                             mode="selftest", executed_flops=executed_f,
                             wall_s=wall_s, prewarmed=preloaded)
@@ -586,13 +895,31 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
         problems.append(
             f"warm-start failed: {s['cold_requests']} of {len(samples)} "
             "requests paid a cold compile after the preload phase")
+    # the scheduler's stats contract: whichever admission path ran must
+    # say which one it was, and the per-tenant SLO rows must cover every
+    # configured tenant with a live attainment figure
+    if s["queue"].get("scheduler") != config.scheduler:
+        problems.append(
+            f"queue stats claim scheduler "
+            f"{s['queue'].get('scheduler')!r}, config says "
+            f"{config.scheduler!r}")
+    for t in tenants:
+        row = s["tenants"].get(t.tenant_id)
+        if row is None:
+            problems.append(f"no ledger row for tenant {t.tenant_id!r}")
+        elif t.slo_ms is not None and row["slo_attainment_pct"] < 100.0:
+            problems.append(
+                f"tenant {t.tenant_id!r} missed its {t.slo_ms:g} ms "
+                f"selftest SLO ({row['slo_attainment_pct']}% attained) — "
+                "either the box is pathologically slow or wait "
+                "accounting broke")
     if problems:
         report(*[f"selftest FAILED: {p}" for p in problems],
                file=sys.stderr)
         raise SystemExit(1)
     report(f"selftest ok: {preloaded} executable warm-started, "
-           f"{len(samples)} requests served cold-free, "
-           "ledger contract holds")
+           f"{len(samples)} requests served cold-free across "
+           f"{len(tenants)} tenants, ledger contract holds")
     return [rec]
 
 
@@ -604,7 +931,8 @@ def validate_serve_record(rec: BenchmarkRecord) -> list[str]:
     if not isinstance(s, dict):
         return ["extras['serve'] block missing"]
     for key in ("p50_ms", "p95_ms", "p99_ms", "max_ms", "shed_rate_pct",
-                "achieved_qps", "requests", "cache", "queue"):
+                "achieved_qps", "requests", "cache", "queue", "scheduler",
+                "goodput_qps", "slo_attainment_pct", "tenants"):
         if key not in s:
             problems.append(f"extras['serve'] lacks {key!r}")
     if problems:
@@ -624,4 +952,22 @@ def validate_serve_record(rec: BenchmarkRecord) -> list[str]:
         problems.append(f"benchmark field is {rec.benchmark!r}, not 'serve'")
     if rec.iterations != s["requests"]:
         problems.append("iterations != completed requests")
+    # per-tenant rows must reconcile with the headline totals: every
+    # completion belongs to exactly one tenant, attainment is a
+    # percentage, and goodput can't exceed raw throughput
+    tenant_requests = sum(row.get("requests", 0)
+                          for row in s["tenants"].values())
+    if tenant_requests != s["requests"]:
+        problems.append(
+            f"tenant rows account for {tenant_requests} requests, "
+            f"headline says {s['requests']}")
+    for tid, row in s["tenants"].items():
+        att = row.get("slo_attainment_pct")
+        if att is None or not 0.0 <= att <= 100.0:
+            problems.append(
+                f"tenant {tid!r} slo_attainment_pct {att!r} not in [0, 100]")
+    if s["goodput_qps"] > s["achieved_qps"] + 1e-9:
+        problems.append(
+            f"goodput_qps {s['goodput_qps']} exceeds achieved_qps "
+            f"{s['achieved_qps']}")
     return problems

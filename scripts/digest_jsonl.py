@@ -103,6 +103,20 @@ def _serve_sublines(r) -> list[str]:
     return lines
 
 
+def _comm_quant_bits(r) -> str:
+    """Quantized-wire annotation (PR 10): the format label plus, when the
+    wire is live, the static byte prices from comms_model."""
+    cq = (r.get("extras") or {}).get("comm_quant")
+    if not isinstance(cq, dict):
+        return ""
+    bits = f" cq={cq.get('format')}"
+    if "wire_bytes" in cq:
+        bits += (f" wire={cq['wire_bytes']}B "
+                 f"({cq.get('payload_reduction_x')}x payload, "
+                 f"{cq.get('wire_reduction_x')}x wire)")
+    return bits
+
+
 def _row(r) -> str:
     ex = r.get("extras") or {}
     if r.get("benchmark") == "serve" and isinstance(ex.get("serve"), dict):
@@ -125,6 +139,9 @@ def _row(r) -> str:
     for k in ("grid_order", "ksplit"):  # r5 structural axes
         if k in ex:
             extra_bits += f" {k}={ex[k]}"
+    if "validation_max_rel_err" in ex:
+        extra_bits += f" relerr={ex['validation_max_rel_err']:g}"
+    extra_bits += _comm_quant_bits(r)
     if "superseded_by" in ex:
         # e.g. pallas_ring: kept for pedagogy/budget validation,
         # dominated at every size — never read it as a headline
@@ -261,6 +278,42 @@ def _digest_obs(recs: list[dict]) -> None:
                   f"p99={h.get('p99')} max={h.get('max')}")
 
 
+def _frontier_lines(rows: list[tuple[str, dict]]) -> list[str]:
+    """Accuracy-vs-bandwidth frontier table for quantized-collective
+    campaigns (specs/comm_quant.toml): one line per (mode, wire format)
+    pairing the static wire-byte price with the measured validation
+    rel-error, plus the exact baseline row per mode. Empty when no row
+    carries both axes."""
+    pts: list[tuple] = []
+    baseline: dict[str, int] = {}
+    exact: dict[str, float] = {}
+    for _job, r in rows:
+        ex = r.get("extras") or {}
+        cq, err = ex.get("comm_quant"), ex.get("validation_max_rel_err")
+        mode = str(r.get("mode"))
+        if err is None:
+            continue
+        if isinstance(cq, dict) and "wire_bytes" in cq:
+            pts.append((mode, cq["wire_bytes"], str(cq.get("format")),
+                        cq.get("wire_reduction_x"), err))
+            baseline.setdefault(mode, cq["baseline_bytes"])
+        elif not isinstance(cq, dict):
+            exact[mode] = err  # --comm-quant none → the frontier's anchor
+    if not pts:
+        return []
+    for mode, err in exact.items():
+        if mode in baseline:  # price the exact wire off a quantized sibling
+            pts.append((mode, baseline[mode], "none (exact)", 1.0, err))
+    lines = ["  accuracy-vs-bandwidth frontier (validation rel-err vs "
+             "static wire bytes):",
+             f"  {'mode':<18} {'format':<16} {'wire bytes':>10} "
+             f"{'reduction':>9} {'rel-err':>9}"]
+    for mode, wb, fmt, wr, err in sorted(pts):
+        lines.append(f"  {mode:<18} {fmt:<16} {wb:>10} {wr:>8.4g}x "
+                     f"{err:>9.4f}")
+    return lines
+
+
 def _is_campaign_dir(p: Path) -> bool:
     return (p / _JOURNAL).exists() or (p / _JOBS_SUBDIR).is_dir()
 
@@ -327,6 +380,8 @@ def _digest_campaign(d: Path) -> None:
         print(_row(r) + f" job={job_id}")
         for line in _serve_sublines(r):
             print(line)
+    for line in _frontier_lines(rows):
+        print(line)
 
 
 def main(paths: list[str]) -> None:

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Five layers, cheapest first:
+# Six layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -26,7 +26,12 @@
 #      whose counters reconcile with the ledger's extras["serve"] block
 #      and whose cost_analysis attribution agrees with the hand FLOPs
 #      model (the dynamic halves of lint's OBS-001/OBS-002).
-#   5. python -m tpu_matmul_bench serve selftest — drives the
+#   5. python -m tpu_matmul_bench collectives selftest — the dynamic
+#      half of lint's COLL-Q/DTYPE-Q wire-format rules: numeric error
+#      bounds per --comm-quant format on the 8-device virtual CPU mesh,
+#      the block→per-row degeneracy identity, the outlier-row fixture
+#      (block scales must beat per-row scales), and integer inertness.
+#   6. python -m tpu_matmul_bench serve selftest — drives the
 #      multi-tenant continuous-batching scheduler end-to-end on CPU and
 #      validates the serve ledger contract: scheduler identity, cache
 #      and queue reconciliation, per-tenant rows summing to the request
@@ -49,6 +54,10 @@ JAX_PLATFORMS=cpu python -m tpu_matmul_bench tune selftest
 
 echo "== obs selftest (metrics bus / ledger reconciliation) =="
 JAX_PLATFORMS=cpu python -m tpu_matmul_bench obs selftest
+
+echo "== collectives selftest (quantized wire formats, numeric bounds) =="
+JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m tpu_matmul_bench collectives selftest
 
 echo "== serve selftest (multi-tenant scheduler / ledger contract) =="
 JAX_PLATFORMS=cpu python -m tpu_matmul_bench serve selftest

@@ -1,0 +1,260 @@
+"""Seeded error bounds for the block-quantized wire formats, and the
+regression gate over the committed accuracy-vs-bandwidth frontier.
+
+Three layers, mirroring the PR-10 wire contract (DESIGN §16):
+
+1. **Seeded collective-level bounds** on the 8-virtual-device CPU mesh:
+   rel-error of `wire_psum` per format and block size against the exact
+   `lax.psum`, including the degenerate identity int8-block:cols ==
+   the per-row control tier, and the adversarial outlier-row fixture
+   where block scales must beat per-row scales (a single outlier only
+   poisons its own block).
+2. **Static payload floor**: `comms_model.wire_bytes_summary` must price
+   every distributed mode's 1-byte wire at >= 2x payload reduction over
+   bf16 at d=8 — the ISSUE's headline, asserted per mode, no benchmark
+   run required.
+3. **Committed-ledger gate** over `measurements/comm_quant/` (the
+   `specs/comm_quant.toml` campaign, PR-2-style): per-format rel-error
+   bounds, frontier monotonicity (exact < int8-block < fp8 on every
+   mode), and the scale-channel price ordering across block sizes.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_matmul_bench.analysis.comms_model import wire_bytes_summary
+from tpu_matmul_bench.parallel.collectives import parse_wire_format, wire_psum
+from tpu_matmul_bench.parallel.mesh import smap
+from tpu_matmul_bench.parallel.quantized import quantized_psum
+
+LEDGER_DIR = Path(__file__).resolve().parent.parent / "measurements" / "comm_quant"
+
+# ----------------------------------------------------------------------
+# seeded collective-level bounds (layer 1)
+
+
+def _all_reduce(mesh, x, fn):
+    """Run fn(local_shard, axis) under shard_map, rows sharded over the
+    8-device axis; all-reduce semantics → every device holds the sum."""
+    f = smap(lambda s: fn(s, "x"), mesh, in_specs=P("x"), out_specs=P(),
+             check_vma=False)
+    return np.asarray(f(x))
+
+
+def _rel(got, want):
+    return float(np.linalg.norm(got - want) / np.linalg.norm(want))
+
+
+@pytest.fixture(scope="module")
+def seeded(mesh):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 256)).astype(np.float32))
+    exact = _all_reduce(mesh, x, jax.lax.psum)
+    return mesh, x, exact
+
+
+def _wire_err(mesh, x, exact, spec):
+    fmt = parse_wire_format(spec)
+    got = _all_reduce(mesh, x, lambda s, a: wire_psum(s, a, fmt))
+    return _rel(got, exact)
+
+
+def test_int8_block_error_grows_with_block_size(seeded):
+    # finer blocks → smaller per-block amax → finer quantization steps;
+    # on the seeded Gaussian fixture the rel-error is monotone in B
+    mesh, x, exact = seeded
+    errs = [_wire_err(mesh, x, exact, f"int8-block:{b}")
+            for b in (8, 16, 32, 64, 128, 256)]
+    assert all(e < 0.02 for e in errs), errs
+    assert errs == sorted(errs), errs
+
+
+def test_block_cols_degenerates_to_the_per_row_control(seeded):
+    # int8-block:256 on a 256-wide payload is one scale per row — exactly
+    # the legacy per-row control tier's math; the two paths must agree
+    mesh, x, exact = seeded
+    legacy = _rel(_all_reduce(mesh, x, quantized_psum), exact)
+    assert legacy < 0.02
+    assert np.isclose(_wire_err(mesh, x, exact, "int8-block:256"), legacy,
+                      rtol=1e-6)
+
+
+def test_fp8_formats_bounded_and_blocks_help(seeded):
+    # fp8's 3-bit mantissa dominates its error (scales barely matter),
+    # but block scales must still not hurt
+    mesh, x, exact = seeded
+    fp8 = _wire_err(mesh, x, exact, "fp8")
+    fp8_b32 = _wire_err(mesh, x, exact, "fp8-block:32")
+    assert fp8 < 0.08 and fp8_b32 < 0.08
+    assert fp8_b32 < fp8
+
+
+def test_outlier_rows_block_beats_per_row(mesh):
+    # adversarial fixture: one huge column per row. A per-row scale is
+    # poisoned by it (every other element's quantization step blows up);
+    # a block scale confines the damage to the outlier's own block.
+    rng = np.random.default_rng(1)
+    xo = rng.normal(size=(64, 256)).astype(np.float32)
+    xo[:, 3] *= 1000.0
+    xo = jnp.asarray(xo)
+    exact = _all_reduce(mesh, xo, jax.lax.psum)
+    legacy = _all_reduce(mesh, xo, quantized_psum)
+    block = _all_reduce(mesh, xo, lambda s, a: wire_psum(
+        s, a, parse_wire_format("int8-block:32")))
+    # whole-tensor norm: int8-block strictly beats per-tensor/per-row int8
+    assert _rel(block, exact) < 0.5 * _rel(legacy, exact)
+    # and on the non-outlier columns the per-row tier is catastrophically
+    # wrong (its step size ~ outlier/127 zeroes typical elements) while
+    # the block tier stays usable
+    mask = np.ones(256, bool)
+    mask[3] = False
+    legacy_rest = _rel(legacy[:, mask], exact[:, mask])
+    block_rest = _rel(block[:, mask], exact[:, mask])
+    assert legacy_rest > 1.0        # per-row: worse than returning zeros
+    assert block_rest < 0.5 * legacy_rest
+
+
+# ----------------------------------------------------------------------
+# static payload floor (layer 2)
+
+_MODE_KWARGS = {
+    "batch_parallel": {},
+    "data_parallel": {},
+    "matrix_parallel": {},
+    "model_parallel": {},
+    "hybrid": {"dp": 2},
+    "summa": {"rows": 2},
+}
+
+
+@pytest.mark.parametrize("mode", sorted(_MODE_KWARGS))
+@pytest.mark.parametrize("spec", ["int8", "int8-block:32", "fp8-block:32"])
+def test_payload_reduction_floor_every_distributed_mode(mode, spec):
+    # the ISSUE's headline: every 1-byte wire format halves the bf16
+    # payload on every distributed mode at d=8 — a static fact of the
+    # comms model, independent of any benchmark run
+    s = wire_bytes_summary(mode, 8, 256, jnp.bfloat16, spec, batch=4,
+                           **_MODE_KWARGS[mode])
+    assert s["payload_reduction_x"] >= 2.0
+    # the fp32 scale side-channel is charged, so the all-in wire
+    # reduction is strictly below the payload headline but still a win
+    assert 1.0 < s["wire_reduction_x"] <= s["payload_reduction_x"]
+
+
+# ----------------------------------------------------------------------
+# committed-ledger gate (layer 3)
+
+_FMT_TAGS = {
+    "none": None,
+    "int8tensor": "int8-tensor",
+    "fp8": "fp8",
+    "int8b16": "int8-block:16",
+    "int8b32": "int8-block:32",
+    "fp8b32": "fp8-block:32",
+}
+
+# per-format rel-error ceilings for the committed size-256 d=8 frontier;
+# the campaign is seeded (--seed 0) so these are regression bounds, not
+# statistical ones
+_ERR_BOUND = {None: 0.01, "int8-tensor": 0.02, "int8-block:16": 0.02,
+              "int8-block:32": 0.02, "fp8": 0.12, "fp8-block:32": 0.12}
+
+
+def _job_ids():
+    for tag in _FMT_TAGS:
+        yield f"scaling-{tag}_batch_parallel", _FMT_TAGS[tag], "batch_parallel"
+        yield f"scaling-{tag}_matrix_parallel", _FMT_TAGS[tag], "matrix_parallel"
+        yield f"distributed-{tag}_data_parallel", _FMT_TAGS[tag], "data_parallel"
+        yield f"distributed-{tag}_model_parallel", _FMT_TAGS[tag], "model_parallel"
+        yield f"hybrid-{tag}", _FMT_TAGS[tag], "hybrid"
+        yield f"summa-{tag}", _FMT_TAGS[tag], "summa"
+
+
+@pytest.fixture(scope="module")
+def frontier():
+    """job_id → (spec, mode, validation_max_rel_err, comm_quant extras)."""
+    assert (LEDGER_DIR / "spec.json").exists(), (
+        "specs/comm_quant.toml campaign not committed under "
+        "measurements/comm_quant/")
+    rows = {}
+    for job_id, spec, mode in _job_ids():
+        path = LEDGER_DIR / "jobs" / f"{job_id}.jsonl"
+        assert path.exists(), f"missing committed ledger {path.name}"
+        recs = [json.loads(l) for l in path.read_text().splitlines()]
+        recs = [r for r in recs if r.get("mode")]
+        assert len(recs) == 1, f"{path.name}: expected one mode row"
+        r = recs[0]
+        assert r["mode"] == mode
+        rows[job_id] = (spec, mode, r["extras"]["validation_max_rel_err"],
+                        r["extras"].get("comm_quant"))
+    return rows
+
+
+def test_frontier_covers_every_mode_and_format(frontier):
+    assert len(frontier) == 36  # 6 modes x 6 format tiers
+
+
+def test_frontier_rel_error_bounds(frontier):
+    for job_id, (spec, _mode, err, _cq) in frontier.items():
+        assert err is not None, job_id
+        assert err < _ERR_BOUND[spec], (job_id, err)
+
+
+def test_frontier_prices_every_quantized_row(frontier):
+    for job_id, (spec, _mode, _err, cq) in frontier.items():
+        if spec is None:
+            # exact rows price nothing — no comm_quant record at all
+            assert cq is None, job_id
+            continue
+        assert cq["spec"] == spec and cq["format"] == spec, job_id
+        assert cq["payload_reduction_x"] == 2.0, job_id  # bf16 → 1-byte wire
+        assert 1.0 < cq["wire_reduction_x"] <= 2.0, job_id
+        assert cq["baseline_bytes"] > cq["wire_bytes"] > 0, job_id
+        assert cq["wire_bytes"] == (cq["wire_payload_bytes"]
+                                    + cq["wire_scale_bytes"]), job_id
+
+
+def _by_mode(frontier, spec):
+    return {mode: err for _job, (s, mode, err, _cq) in frontier.items()
+            if s == spec}
+
+
+def test_frontier_orders_accuracy_per_mode(frontier):
+    # on every mode the frontier is ordered: exact < int8-block:32 < fp8
+    exact = _by_mode(frontier, None)
+    int8b = _by_mode(frontier, "int8-block:32")
+    fp8 = _by_mode(frontier, "fp8")
+    for mode in _MODE_KWARGS:
+        assert exact[mode] < int8b[mode] < fp8[mode], mode
+
+
+def test_frontier_orders_bandwidth_by_block_size(frontier):
+    # finer blocks buy accuracy with scale bytes: at fixed mode the
+    # all-in wire reduction is ordered  B=16 < B=32 <= per-row (equality
+    # only where the payload shard is itself 32 wide — matrix_parallel
+    # gathers [256, 256/8] panels, so one scale per row IS block:32)
+    wr = {spec: {mode: cq["wire_reduction_x"]
+                 for _job, (s, mode, _e, cq) in frontier.items() if s == spec}
+          for spec in ("int8-block:16", "int8-block:32", "int8-tensor")}
+    for mode in _MODE_KWARGS:
+        assert (wr["int8-block:16"][mode] < wr["int8-block:32"][mode]
+                <= wr["int8-tensor"][mode]), mode
+
+
+def test_frontier_outlier_control_comparison(frontier):
+    # the committed campaign's Gaussian operands already show the block
+    # tier at or under the per-row control on most modes; the decisive
+    # outlier-fixture comparison is the seeded collective-level test
+    # above (test_outlier_rows_block_beats_per_row). Here we just pin
+    # that the control tier never beats int8-block:32 by more than the
+    # rounding noise of a single step.
+    int8b = _by_mode(frontier, "int8-block:32")
+    legacy = _by_mode(frontier, "int8-tensor")
+    for mode in _MODE_KWARGS:
+        assert int8b[mode] < legacy[mode] + 2e-3, mode

@@ -1,35 +1,56 @@
-"""The comm_quant record field must flag the world-1 short-circuit.
+"""The comm_quant record field must flag every inert short-circuit.
 
 At world=1 the quantized collectives are exact no-ops (the d==1
-short-circuits in parallel/quantized.py, r3 advisor finding), so a
-single-device "quantized" record would otherwise read as an int8-wire
-measurement when nothing was quantized (the r4 16k/8k compares omit
-quantized rows for exactly this reason — RESULTS_TPU.md)."""
+short-circuits in parallel/quantized.py and parallel/collectives.py, r3
+advisor finding), so a single-device "quantized" record would otherwise
+read as a quantized-wire measurement when nothing was quantized (the r4
+16k/8k compares omit quantized rows for exactly this reason —
+RESULTS_TPU.md). Hybrid meshes short-circuit per axis (dp=1 → the psum
+is inert, tp=1 → the gather is). Since PR 10 the ledger value is a
+record: ``{"spec", "format"}`` plus the static wire-byte frontier keys
+from `comms_model.wire_bytes_summary` whenever the wire is live.
+"""
 
 import jax
+import pytest
 
 from tpu_matmul_bench.parallel.quantized import comm_quant_extra
 from tpu_matmul_bench.utils.config import parse_config
 
 
-def _cfg(extra=()):
+def _cfg(extra=(), quant="int8"):
     return parse_config(
         ["--sizes", "64", "--iterations", "1", "--warmup", "0",
-         "--comm-quant", "int8", *extra], "t", extra_dtypes=("int8",))
+         "--comm-quant", quant, *extra], "t", extra_dtypes=("int8",))
 
 
-def test_comm_quant_extra_flags_world_1():
-    cfg = _cfg()
-    assert comm_quant_extra(cfg, 1) == "int8 (inert at world=1)"
-    assert comm_quant_extra(cfg, 8) == "int8"
+@pytest.mark.parametrize("quant", ["int8", "int8-tensor", "fp8",
+                                   "int8-block:16", "fp8-block:16"])
+def test_comm_quant_extra_flags_world_1(quant):
+    cfg = _cfg(quant=quant)
+    assert comm_quant_extra(cfg, 1) == f"{quant} (inert at world=1)"
+    assert comm_quant_extra(cfg, 8) == quant
 
 
-def test_comm_quant_extra_flags_integer_operands():
+@pytest.mark.parametrize("quant", ["int8", "fp8", "int8-block:16"])
+def test_comm_quant_extra_flags_integer_operands(quant):
     # integer inputs → integer matmul outputs → the quantized collectives
     # take the exact integer early-return at EVERY world size
-    cfg = _cfg(["--dtype", "int8"])
+    cfg = _cfg(["--dtype", "int8"], quant=quant)
     assert "inert" in comm_quant_extra(cfg, 8)
     assert "integer" in comm_quant_extra(cfg, 8)
+
+
+@pytest.mark.parametrize("quant", ["int8", "fp8-block:16"])
+def test_comm_quant_extra_flags_degenerate_axes(quant):
+    # the per-axis short-circuits of a hybrid mesh, straight from the
+    # string API: dp=1 → the gradient psum is a no-op, tp=1 → the gather
+    cfg = _cfg(quant=quant)
+    assert comm_quant_extra(cfg, 8, dp=1, tp=8) \
+        == f"{quant} (psum inert at dp=1)"
+    assert comm_quant_extra(cfg, 8, dp=8, tp=1) \
+        == f"{quant} (gather inert at tp=1)"
+    assert comm_quant_extra(cfg, 8, dp=2, tp=4) == quant
 
 
 def test_hybrid_degenerate_axis_flagged(devices):
@@ -39,7 +60,11 @@ def test_hybrid_degenerate_axis_flagged(devices):
 
     m = make_hybrid_mesh(devices, dp=8)
     rec = hybrid_mode(_cfg(), m, 64).build_record(_dummy_timing(), None, 0.0)
-    assert rec.extras["comm_quant"] == "int8 (gather inert at tp=1)"
+    cq = rec.extras["comm_quant"]
+    assert cq["spec"] == "int8"
+    assert cq["format"] == "int8 (gather inert at tp=1)"
+    # the dp psum is still live, so the wire-byte frontier keys ride along
+    assert cq["wire_payload_bytes"] > 0
 
 
 def test_matrix_parallel_world1_fallback_keeps_the_key(mesh):
@@ -52,7 +77,10 @@ def test_matrix_parallel_world1_fallback_keeps_the_key(mesh):
 
     mesh1 = make_mesh(jax.devices()[:1])
     rec = run_mode_benchmark(matrix_parallel(_cfg(), mesh1, 64), _cfg())
-    assert rec.extras["comm_quant"] == "int8 (inert at world=1)"
+    cq = rec.extras["comm_quant"]
+    assert cq["format"] == "int8 (inert at world=1)"
+    # an inert wire prices nothing — no frontier keys on the record
+    assert "wire_payload_bytes" not in cq
 
 
 def _dummy_timing():
@@ -72,7 +100,28 @@ def test_world1_batch_parallel_record_carries_the_flag(mesh):
 
     mesh1 = make_mesh(jax.devices()[:1])
     rec = run_mode_benchmark(batch_parallel(_cfg(), mesh1, 64), _cfg())
-    assert rec.extras["comm_quant"] == "int8 (inert at world=1)"
+    assert rec.extras["comm_quant"]["format"] == "int8 (inert at world=1)"
 
     rec8 = run_mode_benchmark(batch_parallel(_cfg(), mesh, 64), _cfg())
-    assert rec8.extras["comm_quant"] == "int8"
+    cq = rec8.extras["comm_quant"]
+    assert cq["format"] == "int8"
+    assert cq["wire_format"] == "int8"
+    assert cq["baseline_bytes"] > cq["wire_payload_bytes"] > 0
+
+
+def test_block_record_prices_the_scale_channel(mesh):
+    # a block format's record must carry both frontier prices: payload
+    # reduction exactly 2x (bf16 → 1-byte wire) and the wire reduction
+    # strictly below it (the fp32 scale side-channel is not free)
+    from tpu_matmul_bench.parallel.modes import (
+        model_parallel,
+        run_mode_benchmark,
+    )
+
+    cfg = _cfg(quant="int8-block:16")
+    rec = run_mode_benchmark(model_parallel(cfg, mesh, 64), cfg)
+    cq = rec.extras["comm_quant"]
+    assert cq["block"] == 16
+    assert cq["payload_reduction_x"] == 2.0
+    assert 1.0 < cq["wire_reduction_x"] < cq["payload_reduction_x"]
+    assert cq["wire_scale_bytes"] > 0

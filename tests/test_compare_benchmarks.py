@@ -222,8 +222,8 @@ def test_compare_comm_quant_threads_to_rows(tmp_path):
         ["--size", "64", "--iterations", "2", "--warmup", "1",
          "--dtype", "float32", "--comm-quant", "int8",
          "--only", "batch_parallel,matrix_parallel,single"])
-    assert results["batch_parallel"].extras.get("comm_quant") == "int8"
-    assert results["matrix_parallel"].extras.get("comm_quant") == "int8"
+    assert results["batch_parallel"].extras["comm_quant"]["format"] == "int8"
+    assert results["matrix_parallel"].extras["comm_quant"]["format"] == "int8"
     # rows without a quantizable collective are unaffected
     assert "comm_quant" not in results["single"].extras
 

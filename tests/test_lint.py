@@ -37,7 +37,14 @@ def _rule_sevs(findings):
 
 
 def _mode_jaxpr(mode, world, devices):
+    """Trace one golden key's full program; a `mode+format` key traces
+    the mode under that --comm-quant wire format."""
+    import dataclasses
+
+    mode, _, fmt = mode.partition("+")
     cfg = auditor._audit_config()
+    if fmt:
+        cfg = dataclasses.replace(cfg, comm_quant=fmt)
     mesh = make_mesh(devices[:world])
     setup = auditor._all_modes()[mode](cfg, mesh, SIZE)
     fn = setup.full if setup.full is not None else setup.compute
@@ -63,7 +70,14 @@ def test_traced_inventory_matches_golden_fixture(world, devices):
     the model — a refactor that changes both in lockstep (e.g. silently
     doubling a payload and 'fixing' the model to match) still trips."""
     golden = json.loads(GOLDEN.read_text())
-    assert set(golden) == set(auditor._all_modes())
+    base = {k for k in golden if "+" not in k}
+    assert base == set(auditor._all_modes())
+    # quantized-wire keys pin the ppermute-ring + scale-channel layout
+    assert {k for k in golden if "+" in k} == {
+        f"{m}+{f}"
+        for m in ("batch_parallel", "data_parallel", "matrix_parallel",
+                  "model_parallel")
+        for f in ("int8", "int8-block:32")}
     for mode, per_world in golden.items():
         jx = _mode_jaxpr(mode, world, devices)
         observed = sorted(
@@ -72,15 +86,21 @@ def test_traced_inventory_matches_golden_fixture(world, devices):
 
 
 def test_golden_fixture_agrees_with_model():
+    from tpu_matmul_bench.analysis.comms_model import wire_collectives
+
     golden = json.loads(GOLDEN.read_text())
-    for mode, per_world in golden.items():
+    for key, per_world in golden.items():
+        mode, _, fmt = key.partition("+")
         for dkey, inv in per_world.items():
             world = int(dkey[1:])
-            expected = sorted(
-                [e.kind, e.payload_bytes]
-                for e in expected_collectives(mode, world, SIZE, jnp.bfloat16,
-                                              batch=auditor.AUDIT_BATCH))
-            assert [list(x) for x in expected] == inv, (mode, dkey)
+            if fmt:
+                model = wire_collectives(mode, world, SIZE, jnp.bfloat16,
+                                         fmt, batch=auditor.AUDIT_BATCH)
+            else:
+                model = expected_collectives(mode, world, SIZE, jnp.bfloat16,
+                                             batch=auditor.AUDIT_BATCH)
+            expected = sorted([e.kind, e.payload_bytes] for e in model)
+            assert [list(x) for x in expected] == inv, (key, dkey)
 
 
 def test_shipped_tree_audits_clean():
@@ -196,6 +216,119 @@ def test_seeded_oversized_pallas_blocks():
         "seed:oversized", 4096, 4096, 4096, 4096, 4096, 4096,
         in_dtype=jnp.float32)
     assert ("PALLAS-003", "error") in _rule_sevs(findings)
+
+
+def _quant_mode_jaxpr(mode, fmt, world, devices):
+    return _mode_jaxpr(f"{mode}+{fmt}", world, devices)
+
+
+def test_seeded_unpaired_scale_flags_collq001(devices):
+    # strip the jaxpr down to a lie: audit a program that ships int8
+    # payloads over a psum with NO scale side-channel
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_matmul_bench.parallel.mesh import smap
+
+    mesh = make_mesh(devices[:4])
+    prog = smap(lambda x: jax.lax.psum(x.astype(jnp.int8).astype(jnp.int32),
+                                       "x"),
+                mesh, in_specs=P("x"), out_specs=P(), check_vma=False)
+    jx = jax.make_jaxpr(prog)(
+        jax.ShapeDtypeStruct((4, 64), jnp.bfloat16))
+    findings = auditor._scale_pairing_findings(jx, "seed:scaleless")
+    assert ("COLL-Q-001", "error") in _rule_sevs(findings)
+
+
+def test_seeded_stray_fullprec_collective_flags_collq001(devices):
+    # a bf16 collective inside a "quantized" program is a silent fp32
+    # round-trip on the wire — the stray branch of COLL-Q-001
+    jx = _mode_jaxpr("model_parallel", 4, devices)  # exact program
+    findings = auditor._scale_pairing_findings(jx, "seed:stray")
+    assert _rule_sevs(findings) == [("COLL-Q-001", "error")]
+
+
+def test_seeded_wire_inventory_mismatch_flags_collq002(devices):
+    # quantized trace audited against the wrong mode's wire model
+    jx = _quant_mode_jaxpr("model_parallel", "int8-block:32", 4, devices)
+    findings = auditor._wire_inventory_findings(
+        jx, "matrix_parallel", 4, "xla", "int8-block:32", "seed:wrong-mode")
+    assert ("COLL-Q-002", "error") in _rule_sevs(findings)
+
+
+def test_seeded_reduction_floor_flags_collq003(devices, monkeypatch):
+    # price the wire as if payloads stayed 2 bytes wide: the predicted
+    # reduction collapses below the 2x floor and COLL-Q-003 must fire
+    from tpu_matmul_bench.analysis import comms_model
+
+    monkeypatch.setattr(comms_model, "_WIRE_ITEMSIZE", 2)
+    jx = _quant_mode_jaxpr("model_parallel", "int8-block:32", 4, devices)
+    findings = auditor._wire_inventory_findings(
+        jx, "model_parallel", 4, "xla", "int8-block:32", "seed:wide-wire")
+    assert ("COLL-Q-003", "error") in _rule_sevs(findings)
+
+
+def test_seeded_double_downcast_wire_counts():
+    # a wire-layer consumer that downcasts twice (the scar DTYPE-Q-001
+    # exists to catch): _nonwire_downs must see both, and must NOT count
+    # the fp8 wire casts
+    def sloppy(a, scales):
+        q = (a.astype(jnp.float32) / scales).astype(jnp.float8_e4m3fn)
+        deq = q.astype(jnp.float32) * scales
+        return deq.astype(jnp.bfloat16).astype(jnp.float32).astype(
+            jnp.bfloat16)
+
+    jx = jax.make_jaxpr(sloppy)(
+        jax.ShapeDtypeStruct((8, 64), jnp.bfloat16),
+        jax.ShapeDtypeStruct((8, 1), jnp.float32))
+    downs = auditor._nonwire_downs(jx)
+    assert downs == [("float32", "bfloat16"), ("float32", "bfloat16")]
+
+
+def test_seeded_world1_artifact_flags_dtypeq002(devices):
+    # a "short-circuit" that still quantizes at d=1 must trip the
+    # world-1 leg of DTYPE-Q-002
+    import dataclasses as dc
+
+    from tpu_matmul_bench.parallel import collectives
+
+    real = collectives.wire_psum
+
+    def leaky(x, axis_name, fmt, out_dtype=None):
+        q, s = collectives._wire_quantize(x.reshape(-1, x.shape[-1]), fmt)
+        return collectives._wire_dequantize(q, s).reshape(x.shape).astype(
+            x.dtype)
+
+    try:
+        collectives.wire_psum = leaky
+        findings = auditor._world1_inert_findings(devices)
+    finally:
+        collectives.wire_psum = real
+    assert ("DTYPE-Q-002", "error") in _rule_sevs(findings)
+
+
+def test_comm_quant_audit_clean_on_shipped_tree():
+    findings = auditor.audit_comm_quant(worlds=(4,))
+    assert findings == [], [(f.rule, f.where, f.message) for f in findings]
+
+
+def test_seeded_bad_comm_quant_spec(tmp_path):
+    # grammar violation and block-indivisibility both land on SPEC-007
+    spec = tmp_path / "cq.toml"
+    spec.write_text(
+        '[campaign]\nname = "seeded"\n\n'
+        '[[job]]\nid = "bad-grammar"\nprogram = "compare"\n'
+        'flags = ["--mode", "data_parallel", "--sizes", "256",'
+        ' "--num-devices", "8", "--comm-quant", "int7"]\n\n'
+        '[[job]]\nid = "bad-block"\nprogram = "compare"\n'
+        'flags = ["--mode", "matrix_parallel", "--sizes", "256",'
+        ' "--num-devices", "8", "--comm-quant", "int8-block:48"]\n\n'
+        '[[job]]\nid = "ok"\nprogram = "compare"\n'
+        'flags = ["--mode", "model_parallel", "--sizes", "256",'
+        ' "--num-devices", "8", "--comm-quant", "int8-block:32"]\n')
+    findings = spec_lint.lint_spec_file(spec)
+    assert _rule_sevs(findings) == [("SPEC-007", "error")] * 2
+    wheres = sorted(f.where.rsplit(":", 1)[-1] for f in findings)
+    assert wheres == ["bad-block", "bad-grammar"]
 
 
 def test_seeded_unknown_spec_key(tmp_path):
@@ -372,8 +505,10 @@ def test_rule_catalog_is_stable():
     assert set(RULES) >= {
         "DTYPE-001", "DTYPE-002", "COLL-001", "COLL-002", "COLL-003",
         "PURE-001", "DONATE-001", "PALLAS-001", "PALLAS-002", "PALLAS-003",
-        "SPEC-001", "SPEC-002", "SPEC-003", "SPEC-004",
-        "REG-001", "REG-002"}
+        "SPEC-001", "SPEC-002", "SPEC-003", "SPEC-004", "SPEC-007",
+        "REG-001", "REG-002",
+        "COLL-Q-001", "COLL-Q-002", "COLL-Q-003",
+        "DTYPE-Q-001", "DTYPE-Q-002"}
     for rule, (sev, blurb) in RULES.items():
         assert sev in ("info", "warn", "error"), rule
         assert blurb, rule

@@ -107,7 +107,7 @@ def test_quantized_broadcasts_validate(mesh):
     res = setup.validate()
     assert res["validation"] == "ok", res
     rec = run_mode_benchmark(setup, cfg)
-    assert rec.extras["comm_quant"] == "int8"
+    assert rec.extras["comm_quant"]["format"] == "int8"  # PR 10: a record
 
 
 def test_indivisible_size_rejected(mesh):

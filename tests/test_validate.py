@@ -91,7 +91,7 @@ def test_quantized_comm_validates_and_tolerance_scales(mesh, table, mode):
     cfg = _cfg(extra=["--comm-quant", "int8"])
     rec = run_mode_benchmark(modes[mode](cfg, mesh, SIZE), cfg)
     assert rec.extras["validation"] == "ok", rec.extras
-    assert rec.extras["comm_quant"] == "int8"
+    assert rec.extras["comm_quant"]["format"] == "int8"  # PR 10: a record
     d = mesh.shape["x"]
     assert rec.extras["validation_tolerance"] >= 2 * d / 254
 
@@ -104,7 +104,7 @@ def test_quantized_allgather_matrix_parallel_validates(mesh):
     rec = run_mode_benchmark(SCALING_MODES["matrix_parallel"](cfg, mesh,
                                                               SIZE), cfg)
     assert rec.extras["validation"] == "ok", rec.extras
-    assert rec.extras["comm_quant"] == "int8"
+    assert rec.extras["comm_quant"]["format"] == "int8"  # PR 10: a record
 
 
 def test_quantized_allgather_semantics(mesh):

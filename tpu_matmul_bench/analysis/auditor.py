@@ -170,6 +170,292 @@ def audit_modes(worlds: Iterable[int] = AUDIT_WORLDS,
     return findings
 
 
+# ---------------------------------------------------------------------------
+# COLL-Q-* / DTYPE-Q-*: the quantized-wire collective contract (PR 10)
+# ---------------------------------------------------------------------------
+
+# every wire-format family: legacy per-row control tier, per-row fp8, and
+# one block size of each block format (32 divides every audit payload
+# width: n/d=32 at d=8 for matrix_parallel, n/tp=64 for hybrid, n/s=64
+# for summa panels)
+_COMM_QUANT_FORMATS = ("int8", "fp8", "int8-block:32", "fp8-block:32")
+# which impls to certify per mode family: the fused-dequant contract must
+# hold around either matmul impl where the mode can trace it — the
+# batch-sync modes run shard_map with replication checking on, which has
+# no rule for pallas_call (pre-existing, impl-independent of the wire
+# layer), so they certify on xla only
+_COMM_QUANT_IMPLS = {
+    "batch_parallel": ("xla",),
+    "data_parallel": ("xla",),
+    "matrix_parallel": ("xla", "pallas"),
+    "model_parallel": ("xla", "pallas"),
+}
+
+
+def _comm_quant_cases(world: int, devices) -> list[tuple[str, str, dict,
+                                                         Callable[..., Any]]]:
+    """(mode, impl, model_kwargs, build(config) -> ModeSetup) for every
+    quantizable program family at one world size."""
+    from tpu_matmul_bench.parallel.hybrid import hybrid_mode, make_hybrid_mesh
+    from tpu_matmul_bench.parallel.mesh import make_mesh
+    from tpu_matmul_bench.parallel.summa import make_summa_mesh, summa_grid, summa_mode
+
+    mesh_1d = make_mesh(devices[:world])
+    cases: list[tuple[str, str, dict, Callable[..., Any]]] = []
+    for mode, impls in _COMM_QUANT_IMPLS.items():
+        builder = _all_modes()[mode]
+        for impl in impls:
+            cases.append((mode, impl, {},
+                          lambda cfg, b=builder, m=mesh_1d: b(cfg, m,
+                                                              AUDIT_SIZE)))
+    dp = 2
+    hmesh = make_hybrid_mesh(devices[:world], dp=dp)
+    cases.append(("hybrid", "xla", {"dp": dp},
+                  lambda cfg, m=hmesh: hybrid_mode(cfg, m, AUDIT_SIZE)))
+    smesh = make_summa_mesh(devices[:world])
+    cases.append(("summa", "xla", {"rows": summa_grid(world)[0]},
+                  lambda cfg, m=smesh: summa_mode(cfg, m, AUDIT_SIZE)))
+    return cases
+
+
+def _nonwire_downs(jaxpr: Any) -> list[tuple[str, str]]:
+    """Float downcasts excluding wire-dtype casts (float8 payloads count as
+    float converts in jax's lattice; they are wire mechanics, not the
+    mode's accumulation discipline) and excluding converts inside
+    pallas_call kernels (the kernel's own accumulate-high downcast is
+    certified by audit_impls, not the wire contract)."""
+    from tpu_matmul_bench.parallel.collectives import WIRE_DTYPES
+
+    return [(c.src, c.dst)
+            for c in jt.float_converts(jaxpr, skip_prims=("pallas_call",))
+            if c.direction == "down"
+            and c.src not in WIRE_DTYPES and c.dst not in WIRE_DTYPES]
+
+
+def _nonwire_roundtrips(jaxpr: Any) -> list[tuple[str, str]]:
+    from tpu_matmul_bench.parallel.collectives import WIRE_DTYPES
+
+    return [p for p in jt.roundtrip_converts(jaxpr)
+            if p[0] not in WIRE_DTYPES and p[1] not in WIRE_DTYPES]
+
+
+def _scale_pairing_findings(jaxpr: Any, where: str) -> list[Finding]:
+    """COLL-Q-001: every wire-dtype collective must be paired 1:1 (per
+    primitive) with an fp32 scale collective, and no collective may carry
+    any other dtype — a quantized program's wire is payloads + scales,
+    nothing else."""
+    import collections
+
+    from tpu_matmul_bench.parallel.collectives import WIRE_DTYPES
+
+    colls = jt.collective_inventory(jaxpr)
+    wire = collections.Counter()
+    scale = collections.Counter()
+    stray: list[str] = []
+    for u in colls:
+        if any(dt in WIRE_DTYPES for dt in u.operand_dtypes):
+            wire[u.prim] += 1
+        elif all(dt == "float32" for dt in u.operand_dtypes):
+            scale[u.prim] += 1
+        else:
+            stray.append(f"{u.prim}({','.join(u.operand_dtypes)})")
+    findings: list[Finding] = []
+    if wire != scale:
+        findings.append(Finding(
+            "COLL-Q-001", where,
+            f"wire payload collectives {dict(wire)} are not 1:1 paired "
+            f"with fp32 scale collectives {dict(scale)} — scales must "
+            "travel with every quantized payload on the same lane",
+            details={"wire": dict(wire), "scale": dict(scale)}))
+    if stray:
+        findings.append(Finding(
+            "COLL-Q-001", where,
+            f"collectives carrying non-wire, non-scale dtypes in a "
+            f"quantized program: {stray} (a silent full-precision "
+            "round-trip on the wire)",
+            details={"stray": stray}))
+    return findings
+
+
+def _wire_inventory_findings(jaxpr: Any, mode: str, world: int, impl: str,
+                             comm_quant: str, where: str,
+                             **model_kw: Any) -> list[Finding]:
+    """COLL-Q-002/COLL-Q-003: traced quantized collectives vs the wire
+    model, and the predicted payload reduction vs the 2x floor."""
+    from tpu_matmul_bench.analysis.comms_model import (
+        wire_bytes_summary,
+        wire_collectives,
+    )
+
+    observed = sorted((u.kind, u.payload_bytes)
+                      for u in jt.collective_inventory(jaxpr))
+    expected = sorted((e.kind, e.payload_bytes)
+                      for e in wire_collectives(
+                          mode, world, AUDIT_SIZE, jnp.bfloat16, comm_quant,
+                          batch=AUDIT_BATCH, **model_kw))
+    findings: list[Finding] = []
+    if observed != expected:
+        findings.append(Finding(
+            "COLL-Q-002", where,
+            f"quantized collective inventory differs from the wire model "
+            f"({len(observed)} traced vs {len(expected)} modeled)",
+            details={"observed": observed, "expected": expected}))
+    summary = wire_bytes_summary(mode, world, AUDIT_SIZE, jnp.bfloat16,
+                                 comm_quant, batch=AUDIT_BATCH, **model_kw)
+    if summary.get("payload_reduction_x", 0.0) < 2.0:
+        findings.append(Finding(
+            "COLL-Q-003", where,
+            f"predicted payload-byte reduction "
+            f"{summary.get('payload_reduction_x')}x is below the 2x floor "
+            "for a 1-byte wire format vs bf16",
+            details=summary))
+    return findings
+
+
+def audit_comm_quant(worlds: Iterable[int] = AUDIT_WORLDS) -> list[Finding]:
+    """Certify the quantized-wire collective contract statically: for every
+    quantizable mode × wire format × impl × audit world, trace the FULL
+    program and check
+
+    - COLL-Q-001: fp32 scales ride the same lane as every wire payload;
+    - COLL-Q-002: the collective inventory matches
+      `comms_model.wire_collectives` exactly (kinds, counts, bytes);
+    - COLL-Q-003: the modeled payload reduction meets the 2x floor;
+    - DTYPE-Q-001: exactly one extra non-wire downcast vs the exact
+      program for the fused block formats (the legacy control tier gets
+      one per quantized collective), and no new non-wire round-trips;
+    - DTYPE-Q-002: integer operands and world-1 meshes short-circuit —
+      integer programs are traced-identical to exact, world-1 programs
+      carry no wire dtypes and no ring hops.
+    """
+    findings: list[Finding] = []
+    devices = jax.devices()
+    for world in worlds:
+        if world > len(devices):
+            continue  # audit_modes already reports the capacity warning
+        for mode, impl, model_kw, build in _comm_quant_cases(world, devices):
+            exact_cfg = _audit_config("bfloat16", impl)
+            exact_jx = jax.make_jaxpr(
+                (s := build(exact_cfg)).full)(*s.operands)
+            exact_downs = len(_nonwire_downs(exact_jx))
+            exact_rts = len(_nonwire_roundtrips(exact_jx))
+            n_colls = len(jt.collective_inventory(exact_jx))
+            for fmt in _COMM_QUANT_FORMATS:
+                import dataclasses as _dc
+
+                from tpu_matmul_bench.parallel.collectives import (
+                    parse_wire_format,
+                )
+
+                where = f"comm_quant:{mode}+{fmt}/{impl}@d{world}"
+                cfg = _dc.replace(exact_cfg, comm_quant=fmt)
+                setup = build(cfg)
+                jaxpr = jax.make_jaxpr(setup.full)(*setup.operands)
+                findings.extend(_scale_pairing_findings(jaxpr, where))
+                findings.extend(_wire_inventory_findings(
+                    jaxpr, mode, world, impl, fmt, where, **model_kw))
+                # DTYPE-Q-001, the one-downcast contract. Fused formats
+                # get an ABSOLUTE budget: exactly one non-wire downcast in
+                # the whole program — fusing also absorbs the exact
+                # program's own narrow-accumulate round-trips (jnp.sum of
+                # bf16 upcasts internally; summed in f32 that pair
+                # vanishes), so a diff would under-count. The unfused
+                # legacy control tier downcasts at every collective, so
+                # its budget is a diff: exact + one per collective.
+                downs = _nonwire_downs(jaxpr)
+                if parse_wire_format(fmt).legacy:
+                    ok = len(downs) - exact_downs == n_colls
+                    budget_doc = f"exact+{n_colls} (one per collective)"
+                else:
+                    ok = len(downs) == 1
+                    budget_doc = "exactly 1 in the whole program"
+                if not ok:
+                    findings.append(Finding(
+                        "DTYPE-Q-001", where,
+                        f"{len(downs)} non-wire downcasts (budget "
+                        f"{budget_doc}; exact program has {exact_downs}) "
+                        "— accumulate high, downcast once",
+                        details={"downcasts": downs,
+                                 "exact_count": exact_downs}))
+                rts = _nonwire_roundtrips(jaxpr)
+                if len(rts) != exact_rts:
+                    findings.append(Finding(
+                        "DTYPE-Q-001", where,
+                        f"{len(rts)} non-wire float round-trips vs "
+                        f"{exact_rts} in the exact program — dequantized "
+                        "values must stay in the fp32 accumulator",
+                        details={"roundtrips": rts}))
+        # DTYPE-Q-002a: integer operands take the exact collective —
+        # program-identical, not merely close
+        for fmt in ("int8", "int8-block:32", "fp8-block:32"):
+            import dataclasses as _dc
+
+            for mode, impl, model_kw, build in _comm_quant_cases(
+                    world, devices):
+                if impl != "xla":
+                    continue
+                where = f"comm_quant:{mode}+{fmt}/int8-operands@d{world}"
+                int_exact = _audit_config("int8", impl)
+                int_quant = _dc.replace(int_exact, comm_quant=fmt)
+                jx_e = jax.make_jaxpr((s := build(int_exact)).full)(*s.operands)
+                jx_q = jax.make_jaxpr((s := build(int_quant)).full)(*s.operands)
+                if str(jx_e) != str(jx_q):
+                    findings.append(Finding(
+                        "DTYPE-Q-002", where,
+                        "integer-operand program under --comm-quant is not "
+                        "identical to the exact program — the integer "
+                        "inert short-circuit is broken",
+                        details={"exact_eqns": len(jx_e.jaxpr.eqns),
+                                 "quant_eqns": len(jx_q.jaxpr.eqns)}))
+    findings.extend(_world1_inert_findings(devices))
+    return findings
+
+
+def _world1_inert_findings(devices) -> list[Finding]:
+    """DTYPE-Q-002b: on a 1-device mesh the quantized modes must emit no
+    wire dtypes and no ring hops (the d==1 short-circuit)."""
+    import dataclasses as _dc
+
+    from tpu_matmul_bench.parallel.collectives import WIRE_DTYPES
+    from tpu_matmul_bench.parallel.mesh import make_mesh
+
+    findings: list[Finding] = []
+    mesh1 = make_mesh(devices[:1])
+    for mode in ("batch_parallel", "data_parallel", "model_parallel",
+                 "matrix_parallel"):
+        builder = _all_modes()[mode]
+        for fmt in _COMM_QUANT_FORMATS:
+            where = f"comm_quant:{mode}+{fmt}@d1"
+            cfg = _dc.replace(_audit_config("bfloat16"), comm_quant=fmt)
+            setup = builder(cfg, mesh1, AUDIT_SIZE)
+            program = setup.full or setup.compute  # matrix_parallel falls back
+            jaxpr = jax.make_jaxpr(program)(*setup.operands)
+            wire_ops = [
+                u.prim for u in jt.collective_inventory(jaxpr)
+                if u.kind == "ppermute"
+                or any(dt in WIRE_DTYPES for dt in u.operand_dtypes)]
+            # raw convert scan, not float_converts: an int8 wire cast is
+            # not a float→float convert, but on a bf16 world-1 program it
+            # is every bit as much a broken short-circuit
+            wire_casts = []
+            for eqn in jt.iter_eqns(jaxpr):
+                if eqn.primitive.name != "convert_element_type":
+                    continue
+                src = str(eqn.invars[0].aval.dtype)
+                dst = str(eqn.outvars[0].aval.dtype)
+                if src in WIRE_DTYPES or dst in WIRE_DTYPES:
+                    wire_casts.append((src, dst))
+            if wire_ops or wire_casts:
+                findings.append(Finding(
+                    "DTYPE-Q-002", where,
+                    "world-1 program still carries quantization artifacts "
+                    f"(collectives {wire_ops}, casts {wire_casts}) — the "
+                    "d==1 short-circuit is broken",
+                    details={"wire_ops": wire_ops,
+                             "wire_casts": wire_casts}))
+    return findings
+
+
 # (impl, dtype) pairs every build must keep clean; ksplit rides along as
 # the structurally distinct Pallas path (multi-pass accumulation)
 _IMPL_MATRIX = (
@@ -552,6 +838,7 @@ AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "registry": audit_registry,
     "tune": audit_tune,
     "obs": audit_obs,
+    "comm_quant": audit_comm_quant,
     "sched": _audit_sched,
     "memory": _audit_memory,
     "fingerprint": _audit_fingerprint,

@@ -58,8 +58,8 @@ def build_parser() -> argparse.ArgumentParser:
                              "under the repo root)")
     parser.add_argument("--skip", nargs="*", default=(),
                         choices=("modes", "impls", "donation", "pallas",
-                                 "registry", "tune", "obs", "specs", "sched",
-                                 "memory", "fingerprint"),
+                                 "registry", "tune", "obs", "comm_quant",
+                                 "specs", "sched", "memory", "fingerprint"),
                         help="audit groups to skip")
     parser.add_argument("--no-hlo", action="store_true",
                         help="skip the HLO pass family (sched + memory + "

@@ -22,11 +22,30 @@ quantity `jaxpr_tools.collective_inventory` measures — for a square
   (modes.py falls back before building the program).
 - model_parallel: row×col contraction shards; one all_reduce of the
   full [n, n] partial product.
+- hybrid (2-D dp×tp mesh): one all_gather of the [lb, n, n/tp] output
+  columns over 'tp', then one all_reduce of the batch-summed [n, n] over
+  'dp'.
+- summa (2-D r×c grid): per scan step, one masked-psum broadcast of the
+  [n/r, n/s] A panel over 'j' and one of the [n/s, n/c] B panel over 'i'
+  (statically: the scan body's two all_reduce eqns, counted once).
+
+**Wire-format term (PR 10):** when `--comm-quant` selects a quantized
+wire format, every float collective above is rewritten on the wire — an
+all_reduce becomes the quantized ring ((d−1) ppermute hops of the
+1-byte payload chunk, (d−1) ppermute hops of the fp32 scale side-channel,
+then one all_gather of each) and an all_gather carries the 1-byte payload
+plus the scale gather. `wire_collectives` predicts that inventory
+statically (COLL-Q-002 diffs the traced programs against it) and
+`wire_bytes_summary` prices it: payload bytes and scale side-channel
+bytes are reported separately, because the headline ≥2× reduction vs
+bf16 is a *payload* property — the scale channel adds 4/B bytes per
+payload byte for block size B (4/cols for the per-row formats).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import numpy as np
 
@@ -62,23 +81,180 @@ def matmul_out_itemsize(dtype) -> int:
     return dt.itemsize
 
 
-def expected_collectives(mode: str, world: int, size: int, dtype,
-                         batch: int = 4) -> list[ExpectedCollective]:
-    """Expected collective inventory for one mode's FULL (compute+comm)
-    program. Compute-only programs expect [] for every mode."""
-    item = matmul_out_itemsize(dtype)
+def mode_collective_shapes(
+        mode: str, world: int, size: int, batch: int = 4,
+        dp: int | None = None, rows: int | None = None,
+) -> list[tuple[str, int, tuple[int, ...]]]:
+    """The float collectives of one mode's FULL program as
+    ``(kind, axis_size, per_device_operand_shape)`` triples — the common
+    base of the exact inventory model (`expected_collectives`) and the
+    wire-format term (`wire_collectives` / `wire_bytes_summary`).
+
+    For the scanned summa mode the scan body is counted ONCE (the static
+    inventory semantics of `jaxpr_tools.collective_inventory`); physical
+    per-run traffic multiplies by `mode_steps`.
+    """
     n = size
     if mode == "independent":
         return []
     if mode == "batch_parallel":
         lb = max(batch // world, 1)
-        return [ExpectedCollective("all_reduce", lb * n * n * item)]
+        return [("all_reduce", world, (lb, n, n))]
     if mode == "data_parallel":
-        return [ExpectedCollective("all_reduce", 1 * n * n * item)]
+        return [("all_reduce", world, (1, n, n))]
     if mode == "matrix_parallel":
         if world == 1:
             return []  # modes.py falls back to independent
-        return [ExpectedCollective("all_gather", n * (n // world) * item)]
+        return [("all_gather", world, (n, n // world))]
     if mode == "model_parallel":
-        return [ExpectedCollective("all_reduce", n * n * item)]
+        return [("all_reduce", world, (n, n))]
+    if mode == "hybrid":
+        if not dp or world % dp:
+            raise ValueError(f"hybrid mode needs dp dividing world={world}")
+        tp = world // dp
+        lb = max(batch // dp, 1)
+        return [("all_gather", tp, (lb, n, n // tp)),
+                ("all_reduce", dp, (n, n))]
+    if mode == "summa":
+        r = rows or max(d for d in range(1, int(math.isqrt(world)) + 1)
+                        if world % d == 0)
+        c = world // r
+        s = math.lcm(r, c)
+        return [("all_reduce", c, (n // r, n // s)),   # A panel over 'j'
+                ("all_reduce", r, (n // s, n // c))]   # B panel over 'i'
     raise ValueError(f"no comms model for mode {mode!r}")
+
+
+def mode_steps(mode: str, world: int, rows: int | None = None) -> int:
+    """Collective-emitting steps one program run performs (1 except for
+    summa's k-panel scan)."""
+    if mode != "summa":
+        return 1
+    r = rows or max(d for d in range(1, int(math.isqrt(world)) + 1)
+                    if world % d == 0)
+    return math.lcm(r, world // r)
+
+
+def expected_collectives(mode: str, world: int, size: int, dtype,
+                         batch: int = 4, dp: int | None = None,
+                         rows: int | None = None) -> list[ExpectedCollective]:
+    """Expected collective inventory for one mode's FULL (compute+comm)
+    program with exact (full-precision) collectives. Compute-only
+    programs expect [] for every mode."""
+    item = matmul_out_itemsize(dtype)
+    return [
+        ExpectedCollective(kind, int(np.prod(shape)) * item)
+        for kind, _, shape in mode_collective_shapes(
+            mode, world, size, batch=batch, dp=dp, rows=rows)
+    ]
+
+
+_SCALE_ITEMSIZE = 4  # scales are always fp32
+_WIRE_ITEMSIZE = 1   # int8 and float8_e4m3fn payloads are both 1 byte
+
+
+def _wire_entries(mode: str, world: int, size: int, dtype, comm_quant,
+                  batch: int = 4, dp: int | None = None,
+                  rows: int | None = None,
+                  ) -> list[tuple[str, int, int, str]]:
+    """The quantized FULL program's collectives as
+    ``(kind, axis_size, payload_bytes, role)`` with role ∈ {payload,
+    scale}. Mirrors `wire_psum`/`wire_all_gather` exactly: an all_reduce
+    becomes the (d−1)-hop ppermute ring + final all_gather, each hop
+    carrying a payload chunk and its scale chunk; an all_gather carries
+    the whole shard + scales; size-1 axes and integer operands
+    short-circuit to the exact collective.
+    """
+    from tpu_matmul_bench.parallel.collectives import parse_wire_format
+
+    fmt = parse_wire_format(comm_quant)
+    base = mode_collective_shapes(mode, world, size, batch=batch, dp=dp,
+                                  rows=rows)
+    if fmt is None or np.issubdtype(np.dtype(dtype), np.integer):
+        item = matmul_out_itemsize(dtype)
+        return [(kind, axis, int(np.prod(shape)) * item, "payload")
+                for kind, axis, shape in base]
+    out: list[tuple[str, int, int, str]] = []
+    for kind, axis, shape in base:
+        if axis == 1:
+            continue  # the d==1 short-circuit emits no collective at all
+        n_rows = int(np.prod(shape[:-1]))
+        cols = int(shape[-1])
+        nb = fmt.scale_blocks(cols)
+        if kind == "all_reduce":
+            if n_rows % axis:
+                raise ValueError(
+                    f"{mode}: flattened rows {n_rows} must divide the "
+                    f"{axis}-device axis for the quantized ring")
+            chunk = n_rows // axis
+            for _ in range(axis - 1):  # reduce-scatter phase, per hop
+                out.append(("ppermute", axis,
+                            chunk * cols * _WIRE_ITEMSIZE, "payload"))
+                out.append(("ppermute", axis,
+                            chunk * nb * _SCALE_ITEMSIZE, "scale"))
+            out.append(("all_gather", axis,
+                        chunk * cols * _WIRE_ITEMSIZE, "payload"))
+            out.append(("all_gather", axis,
+                        chunk * nb * _SCALE_ITEMSIZE, "scale"))
+        elif kind == "all_gather":
+            out.append(("all_gather", axis,
+                        n_rows * cols * _WIRE_ITEMSIZE, "payload"))
+            out.append(("all_gather", axis,
+                        n_rows * nb * _SCALE_ITEMSIZE, "scale"))
+        else:
+            raise ValueError(f"no wire model for collective kind {kind!r}")
+    return out
+
+
+def wire_collectives(mode: str, world: int, size: int, dtype, comm_quant,
+                     batch: int = 4, dp: int | None = None,
+                     rows: int | None = None) -> list[ExpectedCollective]:
+    """Expected collective inventory of the FULL program under
+    `--comm-quant` — what COLL-Q-002 diffs the traced quantized programs
+    against (the quantized analogue of `expected_collectives`)."""
+    return [ExpectedCollective(kind, payload)
+            for kind, _, payload, _ in _wire_entries(
+                mode, world, size, dtype, comm_quant, batch=batch, dp=dp,
+                rows=rows)]
+
+
+def wire_bytes_summary(mode: str, world: int, size: int, dtype, comm_quant,
+                       batch: int = 4, dp: int | None = None,
+                       rows: int | None = None) -> dict:
+    """Static wire-byte prices for one (mode, world, size, format) cell —
+    the bandwidth axis of the accuracy-vs-bandwidth frontier.
+
+    All byte totals are physical ring-wire bytes per program run
+    (payload_bytes × RING_WIRE_FACTOR[kind], × the scan steps for summa).
+    `payload_reduction_x` is baseline ÷ quantized-payload — the ISSUE's
+    ≥2× headline (exactly 2.0 for bf16 → any 1-byte wire format, 4.0 for
+    fp32) — while `wire_reduction_x` also charges the fp32 scale
+    side-channel (→ 2/(1 + 4/B) for bf16 at block size B).
+    """
+    from tpu_matmul_bench.parallel.collectives import parse_wire_format
+
+    fmt = parse_wire_format(comm_quant)
+    steps = mode_steps(mode, world, rows=rows)
+    item = matmul_out_itemsize(dtype)
+    baseline = steps * sum(
+        int(np.prod(shape)) * item * RING_WIRE_FACTOR[kind](axis)
+        for kind, axis, shape in mode_collective_shapes(
+            mode, world, size, batch=batch, dp=dp, rows=rows))
+    totals = {"payload": 0.0, "scale": 0.0}
+    for kind, axis, payload, role in _wire_entries(
+            mode, world, size, dtype, comm_quant, batch=batch, dp=dp,
+            rows=rows):
+        totals[role] += steps * payload * RING_WIRE_FACTOR[kind](axis)
+    payload_b, scale_b = totals["payload"], totals["scale"]
+    out = {
+        "wire_format": comm_quant,
+        "block": fmt.block if fmt else None,
+        "baseline_bytes": int(round(baseline)),
+        "wire_payload_bytes": int(round(payload_b)),
+        "wire_scale_bytes": int(round(scale_b)),
+        "wire_bytes": int(round(payload_b + scale_b)),
+    }
+    if payload_b:
+        out["payload_reduction_x"] = round(baseline / payload_b, 4)
+        out["wire_reduction_x"] = round(baseline / (payload_b + scale_b), 4)
+    return out

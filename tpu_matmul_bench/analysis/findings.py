@@ -99,6 +99,35 @@ RULES: dict[str, tuple[Severity, str]] = {
                          "cell (jax version moved or the routed program's "
                          "digest drifted) — re-measure or re-promote the "
                          "cell"),
+    "COLL-Q-001": ("error", "quantized payload travels without its scale "
+                            "side-channel: a wire-dtype collective is not "
+                            "paired with a matching fp32 scale collective "
+                            "(dequantization downstream is impossible or "
+                            "wrong)"),
+    "COLL-Q-002": ("error", "quantized collective inventory mismatch: the "
+                            "traced wire-format program's collectives "
+                            "differ in kind, count, or payload bytes from "
+                            "the analytic wire model "
+                            "(comms_model.wire_collectives)"),
+    "COLL-Q-003": ("error", "predicted payload-byte reduction below the "
+                            "2x floor for a 1-byte wire format vs the "
+                            "bf16 baseline (the wire format fails its "
+                            "reason to exist)"),
+    "DTYPE-Q-001": ("error", "quantized program breaks the one-downcast "
+                             "contract: non-wire float downcasts exceed "
+                             "the exact program's count by more than the "
+                             "format's budget, or a new fp32 round-trip "
+                             "appeared (dequant must stay in the fp32 "
+                             "accumulator until the single final "
+                             "downcast)"),
+    "DTYPE-Q-002": ("error", "inert short-circuit broken: a world-1 or "
+                             "integer-operand program under --comm-quant "
+                             "is not identical to the exact program "
+                             "(quantization must vanish, not degrade)"),
+    "SPEC-007": ("error", "invalid --comm-quant value in a spec's job "
+                          "flags: not in the wire-format grammar, or a "
+                          "block size that does not divide the payload "
+                          "width implied by --sizes/--num-devices"),
     "OBS-001": ("error", "XLA cost_analysis attribution disagrees with the "
                          "hand FLOPs model (utils.metrics.calculate_tflops) "
                          "beyond tolerance — reported TFLOP/s are computed "

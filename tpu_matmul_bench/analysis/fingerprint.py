@@ -111,9 +111,19 @@ def program_inventory(worlds=FINGERPRINT_WORLDS) -> dict[str, dict]:
     from tpu_matmul_bench.parallel.mesh import make_mesh
     from tpu_matmul_bench.parallel.overlap import overlap_mode
 
+    import dataclasses
+
     records: dict[str, dict] = {}
     avail = len(jax.devices())
     config = _audit_config("bfloat16", "xla")
+    # quantized-wire variants are distinct compiled structures (ppermute
+    # ring + wire/scale payloads); pinning them separately means a DRIFT
+    # golden can never alias a quantized program with its full-precision
+    # sibling — one format per wire family (legacy per-row, int8 block,
+    # fp8 block)
+    quant_formats = ("int8", "int8-block:32", "fp8-block:32")
+    quantizable = ("batch_parallel", "data_parallel", "matrix_parallel",
+                   "model_parallel")
 
     for world in worlds:
         if world > avail:
@@ -123,6 +133,15 @@ def program_inventory(worlds=FINGERPRINT_WORLDS) -> dict[str, dict]:
             setup = builder(config, mesh, AUDIT_SIZE)
             fn = setup.full if setup.full is not None else setup.compute
             records[f"mode:{mode}@d{world}"] = _record_of(fn, setup.operands)
+            if mode not in quantizable:
+                continue
+            for fmt in quant_formats:
+                qconfig = dataclasses.replace(config, comm_quant=fmt)
+                qsetup = builder(qconfig, mesh, AUDIT_SIZE)
+                qfn = qsetup.full if qsetup.full is not None \
+                    else qsetup.compute
+                records[f"mode:{mode}+{fmt}@d{world}"] = _record_of(
+                    qfn, qsetup.operands)
         for variant in hlo_sched.SCAN_VARIANTS:
             setup = overlap_mode(config, mesh, hlo_sched.SCHED_SIZE, variant)
             records[f"overlap:{variant}@d{world}"] = _record_of(

@@ -50,16 +50,22 @@ def _sub_jaxprs(value: Any) -> Iterator[Any]:
             yield from _sub_jaxprs(v)
 
 
-def iter_eqns(jaxpr: Any) -> Iterator[Any]:
+def iter_eqns(jaxpr: Any, skip_prims: tuple[str, ...] = ()) -> Iterator[Any]:
     """Depth-first over every equation in a jaxpr, including all nested
-    sub-jaxprs (pjit / shard_map / pallas_call / scan / cond bodies)."""
+    sub-jaxprs (pjit / shard_map / pallas_call / scan / cond bodies).
+    Primitives named in `skip_prims` are yielded but not descended into —
+    e.g. ``("pallas_call",)`` scopes a dtype audit to the program outside
+    the hand-written kernels, whose internal accumulation discipline is
+    certified separately."""
     if hasattr(jaxpr, "jaxpr"):  # ClosedJaxpr
         jaxpr = jaxpr.jaxpr
     for eqn in jaxpr.eqns:
         yield eqn
+        if eqn.primitive.name in skip_prims:
+            continue
         for v in eqn.params.values():
             for sub in _sub_jaxprs(v):
-                yield from iter_eqns(sub)
+                yield from iter_eqns(sub, skip_prims)
 
 
 def trace(fn: Callable[..., Any], *avals: jax.ShapeDtypeStruct) -> Any:
@@ -116,12 +122,13 @@ class ConvertUse:
     direction: str  # "down" | "up" | "same"
 
 
-def float_converts(jaxpr: Any) -> list[ConvertUse]:
+def float_converts(jaxpr: Any,
+                   skip_prims: tuple[str, ...] = ()) -> list[ConvertUse]:
     """All float->float convert_element_type eqns, classified by width.
     Non-float converts (e.g. the bool->int32 masks pl.when emits) are not
     dtype-discipline events and are skipped."""
     out = []
-    for eqn in iter_eqns(jaxpr):
+    for eqn in iter_eqns(jaxpr, skip_prims):
         if eqn.primitive.name != "convert_element_type":
             continue
         src = np.dtype(eqn.invars[0].aval.dtype)

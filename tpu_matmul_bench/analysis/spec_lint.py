@@ -271,6 +271,67 @@ def _lint_tenants_value(value: str | None, where: str,
     return []
 
 
+# modes whose collectives --comm-quant rewrites; other modes (independent,
+# the overlap family) carry no quantizable float collective, so a block
+# size cannot be statically wrong there
+_QUANTIZABLE_MODES = {"batch_parallel", "data_parallel", "matrix_parallel",
+                      "model_parallel", "hybrid", "summa"}
+
+
+def _comm_quant_findings(job: Any, label: str) -> list[Finding]:
+    """SPEC-007 for one job: parse every --comm-quant value against the
+    wire-format grammar, then dry-run the wire model over the job's
+    (mode, size, num_devices) grid so block/ring divisibility errors
+    surface at lint time instead of mid-campaign."""
+    import numpy as np
+
+    from tpu_matmul_bench.analysis.comms_model import wire_collectives
+    from tpu_matmul_bench.parallel.collectives import parse_wire_format
+
+    argv = list(job.argv)
+    quants = _flag_values(argv, "--comm-quant")
+    if not quants:
+        return []
+    findings: list[Finding] = []
+    dtypes = _flag_values(argv, "--dtype") or ["bfloat16"]
+    modes = _QUANTIZABLE_MODES & set(_flag_values(argv, "--mode"))
+    devs = [int(x) for x in _flag_values(argv, "--num-devices")
+            if x.isdigit()]
+    sizes = [int(x) for x in _flag_values(argv, "--sizes") if x.isdigit()]
+    dps = [int(x) for x in _flag_values(argv, "--dp") if x.isdigit()]
+    for q in quants:
+        try:
+            fmt = parse_wire_format(q)
+        except ValueError as e:
+            findings.append(Finding(
+                "SPEC-007", label, f"bad --comm-quant value: {e}",
+                details={"comm_quant": q}))
+            continue
+        if fmt is None:
+            continue
+        if all(dt.startswith(("int", "uint")) for dt in dtypes):
+            continue  # integer operands keep the exact collective
+        for mode in sorted(modes):
+            for d in devs or [1]:
+                if d <= 1:
+                    continue  # the d==1 short-circuit is always valid
+                kw = {"dp": dps[0]} if mode == "hybrid" and dps else (
+                    {"dp": 2 if d % 2 == 0 else 1} if mode == "hybrid"
+                    else {})
+                for s in sizes:
+                    try:
+                        wire_collectives(mode, d, s, np.float32, q, **kw)
+                    except ValueError as e:
+                        findings.append(Finding(
+                            "SPEC-007", label,
+                            f"--comm-quant {q} cannot run "
+                            f"--mode {mode} --sizes {s} "
+                            f"--num-devices {d}: {e}",
+                            details={"comm_quant": q, "mode": mode,
+                                     "size": s, "num_devices": d}))
+    return findings
+
+
 def _unknown_key_findings(data: dict[str, Any], where: str) -> list[Finding]:
     findings = []
 
@@ -351,6 +412,14 @@ def lint_spec_file(path: str | Path) -> list[Finding]:
         if job.program == "serve":
             findings.extend(_lint_serve_job(job, f"{where}:{job.job_id}",
                                             spec_dir=p.parent))
+
+    # SPEC-007: --comm-quant wire-format validity, statically — the value
+    # must parse against the wire-format grammar, and for block formats
+    # the block (and the quantized ring's chunking) must divide every
+    # payload the job's (mode, size, num_devices) cells imply; at run
+    # time that ValueError fires an hour into the sweep
+    for job in spec.jobs:
+        findings.extend(_comm_quant_findings(job, f"{where}:{job.job_id}"))
 
     # mesh divisibility: sharding modes need size % num_devices == 0
     for job in spec.jobs:

@@ -9,6 +9,13 @@ N×N-per-device payload of the benchmark dtype.
 
 Run: python -m tpu_matmul_bench.benchmarks.collective_benchmark \
         --mode psum --num-devices 8 --sizes 4096 ...
+
+`... collectives selftest` instead runs the quantized-wire-format
+selftest: the dynamic half of lint's COLL-Q/DTYPE-Q rules (which only
+certify program *structure*) — numeric error bounds per wire format,
+the block→per-row degeneracy identity, the outlier-row fixture where
+block scales must beat per-row scales, and integer-operand inertness.
+CI runs it as a lint_ci.sh layer on the 8-device virtual CPU mesh.
 """
 
 from __future__ import annotations
@@ -94,7 +101,111 @@ def run(config: BenchConfig) -> list[BenchmarkRecord]:
     return records
 
 
+def comm_quant_selftest() -> list[BenchmarkRecord]:
+    """Numeric selftest of the quantized wire formats (PR 10) — the
+    dynamic complement of lint's static COLL-Q/DTYPE-Q certification.
+
+    Seeded, CPU-friendly, seconds: runs `wire_psum`/`wire_all_gather`
+    against the exact collectives on the available mesh and checks the
+    per-format error bounds the accuracy-vs-bandwidth frontier
+    (measurements/comm_quant/) is predicated on. Exits 1 on any failure.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_matmul_bench.parallel.collectives import (
+        parse_wire_format,
+        wire_all_gather,
+        wire_psum,
+    )
+    from tpu_matmul_bench.parallel.mesh import smap
+    from tpu_matmul_bench.parallel.quantized import quantized_psum
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        report("ERROR: comm-quant selftest needs >= 2 devices (CI uses "
+               "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+        sys.exit(1)
+    mesh = make_mesh(devices)
+    report(f"Comm-quant selftest on {len(devices)}x{devices[0].platform}:")
+
+    def all_reduce(x, fn):
+        f = smap(lambda s: fn(s, "x"), mesh, in_specs=P("x"), out_specs=P(),
+                 check_vma=False)
+        return np.asarray(f(x))
+
+    def rel(got, want):
+        return float(np.linalg.norm(got - want) / np.linalg.norm(want))
+
+    ok = True
+
+    def check(name: str, good: bool, detail: str = "") -> None:
+        nonlocal ok
+        ok &= good
+        report(f"  - {name}: {'PASSED' if good else 'FAILED'}"
+               + (f" ({detail})" if detail else ""))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 256)).astype(np.float32))
+    exact = all_reduce(x, jax.lax.psum)
+    errs = {}
+    for spec, bound in (("int8", 0.02), ("int8-block:32", 0.02),
+                        ("fp8", 0.08), ("fp8-block:32", 0.08)):
+        fmt = parse_wire_format(spec)
+        if fmt.legacy:
+            errs[spec] = rel(all_reduce(x, quantized_psum), exact)
+        else:
+            errs[spec] = rel(all_reduce(
+                x, lambda s, a, fmt=fmt: wire_psum(s, a, fmt)), exact)
+        check(f"wire_psum {spec} rel-err < {bound}", errs[spec] < bound,
+              f"{errs[spec]:.4f}")
+
+    # block size == payload width degenerates to the per-row control tier
+    deg = rel(all_reduce(x, lambda s, a: wire_psum(
+        s, a, parse_wire_format("int8-block:256"))), exact)
+    check("int8-block:cols == per-row control", np.isclose(deg, errs["int8"],
+                                                           rtol=1e-6),
+          f"{deg:.6f} vs {errs['int8']:.6f}")
+
+    # adversarial outlier column: block scales confine the damage
+    xo = rng.normal(size=(64, 256)).astype(np.float32)
+    xo[:, 3] *= 1000.0
+    xo = jnp.asarray(xo)
+    exact_o = all_reduce(xo, jax.lax.psum)
+    e_row = rel(all_reduce(xo, quantized_psum), exact_o)
+    e_blk = rel(all_reduce(xo, lambda s, a: wire_psum(
+        s, a, parse_wire_format("int8-block:32"))), exact_o)
+    check("outlier rows: int8-block beats per-row", e_blk < e_row,
+          f"{e_blk:.4f} < {e_row:.4f}")
+
+    # integer operands must take the exact path bit-for-bit
+    xi = jnp.asarray(rng.integers(-8, 8, size=(64, 256)).astype(np.int32))
+    qi = all_reduce(xi, lambda s, a: wire_psum(
+        s, a, parse_wire_format("int8-block:32")))
+    check("integer operands inert", bool((qi == all_reduce(
+        xi, jax.lax.psum)).all()))
+
+    # the gather leg quantizes once (no per-hop accumulation) — tighter
+    fmt = parse_wire_format("int8-block:32")
+    g = smap(lambda s: wire_all_gather(s, "x", fmt, axis=0), mesh,
+             in_specs=P("x"), out_specs=P(), check_vma=False)
+    ge = rel(np.asarray(g(x)), np.asarray(x))
+    check("wire_all_gather int8-block:32 rel-err < 0.01", ge < 0.01,
+          f"{ge:.4f}")
+
+    if not ok:
+        report("\nERROR: comm-quant selftest failed")
+        sys.exit(1)
+    report("Comm-quant selftest passed.")
+    return []
+
+
 def main(argv: Sequence[str] | None = None) -> list[BenchmarkRecord]:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if args[:1] == ["selftest"]:
+        return comm_quant_selftest()
     config = parse_config(
         argv,
         description=__doc__ or "collective benchmark",

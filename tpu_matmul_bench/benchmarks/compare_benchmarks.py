@@ -23,6 +23,7 @@ import json
 from typing import Sequence
 
 from tpu_matmul_bench.utils import telemetry
+from tpu_matmul_bench.utils.config import comm_quant_arg
 from tpu_matmul_bench.utils.reporting import BenchmarkRecord, report
 
 
@@ -537,10 +538,12 @@ def main(argv: Sequence[str] | None = None) -> dict[str, BenchmarkRecord]:
                    help="matmul precision for every row incl. the dtype "
                         "sweep — 'highest' makes the fp32 rows strict-fp32 "
                         "so the bf16-vs-fp32 line shows the real gap")
-    p.add_argument("--comm-quant", type=str, default=None,
-                   choices=["none", "int8"],
-                   help="int8-wire collectives for every row that has a "
-                        "quantizable psum/all_gather leg")
+    p.add_argument("--comm-quant", type=comm_quant_arg, default=None,
+                   metavar="{none,int8,int8-tensor,fp8,int8-block:<B>,"
+                           "fp8-block:<B>}",
+                   help="quantized-wire collectives for every row that has "
+                        "a quantizable psum/all_gather leg "
+                        "(parallel/collectives.py wire-format grammar)")
     p.add_argument("--timing", type=str, default="dispatch",
                    choices=["dispatch", "fused"],
                    help="timed-loop protocol for every row (fused: all "

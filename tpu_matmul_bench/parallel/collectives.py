@@ -1,23 +1,346 @@
-"""XLA collective wrappers + startup collective verification (SURVEY I2).
+"""Wire-format-aware collectives: the layer every distributed mode routes
+its psum/all_gather traffic through, plus startup collective verification.
 
-The reference gates every scaling run on a pre-flight smoke test of its NCCL
-collectives — all_reduce of rank+1 against the closed-form sum, an element-wise
-all_gather check, and a barrier (reference `matmul_scaling_benchmark.py:26-57`,
-invoked at `:388-394`). `verify_collectives` is the same gate re-expressed
-over a JAX mesh: `psum` / `pmean` / `all_gather` / `ppermute` inside
-`shard_map`, checked on the controller against closed forms.
+Two halves:
+
+1. **Wire formats** (EQuARX-flavored, PAPERS.md arxiv 2506.17615): opt-in
+   block-quantized payloads for the comm-bound modes. `--comm-quant`
+   selects a `WireFormat`:
+
+   - ``int8`` / ``int8-tensor`` — the PR-2-era per-row int8 path in
+     `parallel/quantized.py`, kept verbatim as the A/B control tier
+     (dequantizes straight back to the operand dtype at every collective).
+   - ``fp8`` — per-row float8_e4m3fn payloads (one fp32 scale per row).
+   - ``int8-block:<B>`` / ``fp8-block:<B>`` — block quantization: each row
+     is split into ``cols/B`` blocks of ``B`` columns with one fp32 scale
+     per block, so a single outlier only poisons its own block's scale.
+
+   Quantized payloads always travel with their fp32 scale side-channel on
+   the same lane (a scale ppermute per payload ppermute, a scale
+   all_gather per payload all_gather) — lint's COLL-Q-001 certifies this
+   statically. Dequantization happens in fp32 and, for the non-legacy
+   formats, the consuming matmul can keep the fp32 value (``fuse_f32``) so
+   the whole mode performs **exactly one** downcast — the ksplit
+   accumulate-high discipline (DESIGN §16; DTYPE-Q-001).
+
+2. **Mesh-level wrappers + `verify_collectives`** (SURVEY I2): the
+   reference gates every scaling run on a pre-flight smoke test of its
+   NCCL collectives (reference `matmul_scaling_benchmark.py:26-57`);
+   `verify_collectives` is the same gate re-expressed over a JAX mesh.
 """
 
 from __future__ import annotations
 
+import dataclasses
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_matmul_bench.parallel.mesh import ring_perm, smap as _smap
-from tpu_matmul_bench.utils.compat import pcast_varying
+from tpu_matmul_bench.parallel.quantized import (
+    _psum_varying,
+    comm_quant_extra,
+    quantized_all_gather,
+    quantized_psum,
+    uses_quantized_comm,
+)
+from tpu_matmul_bench.utils.compat import axis_size, pcast_varying
+
+__all__ = [
+    "WireFormat", "parse_wire_format", "wire_psum", "wire_all_gather",
+    "psum_impl", "allgather_impl", "comm_quant_extra", "uses_quantized_comm",
+    "comm_quant_record_extra", "WIRE_DTYPES",
+    "psum_over", "pmean_over", "all_gather_over", "verify_collectives",
+]
+
+# dtype names that only ever appear on the wire (quantized payloads) —
+# lint's DTYPE-Q rules use this to separate wire converts from the mode's
+# own dtype discipline
+WIRE_DTYPES = ("int8", "float8_e4m3fn")
+
+_WIRE_QMAX = {"int8": 127.0, "fp8": 448.0}  # fp8 = float8_e4m3fn finfo.max
+
+
+@dataclasses.dataclass(frozen=True)
+class WireFormat:
+    """A parsed --comm-quant value (see `parse_wire_format`)."""
+
+    spec: str          # the normalized flag value, e.g. "int8-block:32"
+    qtype: str         # "int8" | "fp8"
+    block: int | None  # columns per scale block; None = one scale per row
+    legacy: bool = False  # True → parallel/quantized.py control tier
+
+    @property
+    def wire_dtype(self):
+        return jnp.int8 if self.qtype == "int8" else jnp.float8_e4m3fn
+
+    @property
+    def qmax(self) -> float:
+        return _WIRE_QMAX[self.qtype]
+
+    def scale_blocks(self, cols: int) -> int:
+        """Scales per row for a `cols`-wide payload."""
+        if self.block is None:
+            return 1
+        if cols % self.block:
+            raise ValueError(
+                f"--comm-quant {self.spec}: block size {self.block} must "
+                f"divide the collective payload's last dim ({cols})")
+        return cols // self.block
+
+
+def parse_wire_format(spec: str | None) -> WireFormat | None:
+    """Parse a --comm-quant value; None/"none" → None (exact collectives).
+
+    Grammar: ``none | int8 | int8-tensor | fp8 | int8-block:<B> |
+    fp8-block:<B>`` with ``<B>`` a positive int. ``int8`` and
+    ``int8-tensor`` both name the legacy per-row control tier so existing
+    specs/ledgers keep their meaning.
+    """
+    if spec in (None, "none"):
+        return None
+    if spec in ("int8", "int8-tensor"):
+        return WireFormat(spec=spec, qtype="int8", block=None, legacy=True)
+    if spec == "fp8":
+        return WireFormat(spec=spec, qtype="fp8", block=None)
+    base, sep, arg = spec.partition(":")
+    if sep and base in ("int8-block", "fp8-block"):
+        try:
+            block = int(arg)
+        except ValueError:
+            block = 0
+        if block > 0:
+            return WireFormat(spec=spec, qtype=base.split("-")[0], block=block)
+    raise ValueError(
+        f"unknown comm quantization {spec!r} (expected none, int8, "
+        f"int8-tensor, fp8, int8-block:<B> or fp8-block:<B>)")
+
+
+def _wire_quantize(x: jax.Array, fmt: WireFormat) -> tuple[jax.Array, jax.Array]:
+    """Block-quantize a [rows, cols] float array.
+
+    Returns (q [rows, cols] in fmt.wire_dtype, scales [rows, nb] fp32)
+    where nb = fmt.scale_blocks(cols). Symmetric: scale = blockmax/qmax.
+    """
+    xf = x.astype(jnp.float32)
+    rows, cols = xf.shape
+    nb = fmt.scale_blocks(cols)
+    xb = xf.reshape(rows, nb, cols // nb)
+    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / fmt.qmax, jnp.finfo(jnp.float32).tiny)
+    scaled = xb / scale
+    if fmt.qtype == "int8":
+        q = jnp.clip(jnp.round(scaled), -fmt.qmax, fmt.qmax).astype(jnp.int8)
+    else:
+        # fp32→fp8 overflows to NaN rather than saturating; clip to ±448
+        # first so rounding at the top of the range stays finite
+        q = jnp.clip(scaled, -fmt.qmax, fmt.qmax).astype(jnp.float8_e4m3fn)
+    return q.reshape(rows, cols), scale.reshape(rows, nb)
+
+
+def _wire_dequantize(q: jax.Array, scales: jax.Array) -> jax.Array:
+    """Invert `_wire_quantize` → fp32 [rows, cols].
+
+    The block size is inferred from the shapes (cols // scales.shape[-1]),
+    which makes the same function correct after gathering along either
+    axis: gathered columns and gathered scale blocks line up in the same
+    device order.
+    """
+    rows, cols = q.shape
+    nb = scales.shape[-1]
+    xf = q.astype(jnp.float32).reshape(rows, nb, cols // nb)
+    return (xf * scales[:, :, None]).reshape(rows, cols)
+
+
+def wire_psum(x: jax.Array, axis_name: str, fmt: WireFormat,
+              out_dtype=None) -> jax.Array:
+    """all_reduce(SUM) with block-quantized wire traffic; use inside
+    shard_map.
+
+    Same ring schedule as `quantized_psum` (reduce-scatter hops then one
+    all_gather), but every hop carries `fmt`-formatted payloads + per-block
+    fp32 scales. `out_dtype=None` downcasts once to x.dtype at the end;
+    pass jnp.float32 to keep the fp32 accumulator alive so the consuming
+    matmul fuses the dequant (zero extra downcasts here). Integer inputs
+    take the exact lax.psum path; d==1 is inert.
+    """
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return lax.psum(x, axis_name)
+    d = axis_size(axis_name)
+    if d == 1:
+        return x  # fully inert: identical to the exact program (DTYPE-Q-002)
+    res_dtype = jnp.dtype(out_dtype) if out_dtype is not None else x.dtype
+    orig_shape = x.shape
+    x2 = x.reshape(-1, orig_shape[-1])
+    m = x2.shape[0]
+    if m % d:
+        raise ValueError(
+            f"flattened leading dim {m} of shape {orig_shape} must divide "
+            f"the {d}-device axis")
+    chunk = m // d
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % d) for i in range(d)]
+
+    def my_chunk(c):
+        return lax.dynamic_slice_in_dim(x2, c * chunk, chunk).astype(jnp.float32)
+
+    # reduce-scatter phase: quantized accumulator ring (chunk `my` is home
+    # after d−1 hops, fully summed)
+    acc = my_chunk(lax.rem(my + 2 * d - 1, d))
+    for t in range(1, d):
+        q, s = _wire_quantize(acc, fmt)
+        q = lax.ppermute(q, axis_name, perm)
+        s = lax.ppermute(s, axis_name, perm)
+        acc = _wire_dequantize(q, s) + my_chunk(lax.rem(my + 2 * d - 1 - t, d))
+
+    # all-gather phase: one quantized broadcast of the reduced chunks
+    q, s = _wire_quantize(acc, fmt)
+    q_all = lax.all_gather(q, axis_name, axis=0, tiled=True)
+    s_all = lax.all_gather(s, axis_name, axis=0, tiled=True)
+    out = _wire_dequantize(q_all, s_all).reshape(orig_shape)
+    return out.astype(res_dtype)
+
+
+def wire_all_gather(x: jax.Array, axis_name: str, fmt: WireFormat,
+                    axis: int = 0, out_dtype=None) -> jax.Array:
+    """all_gather with block-quantized wire traffic; use inside shard_map.
+
+    Each device quantizes its shard once and gathers payloads + scales
+    (single rounding — no per-hop accumulation like the psum ring).
+    `out_dtype` as in `wire_psum`. Integer inputs gather exactly; d==1 is
+    inert.
+    """
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    if axis_size(axis_name) == 1:
+        return x  # fully inert: identical to the exact program (DTYPE-Q-002)
+    res_dtype = jnp.dtype(out_dtype) if out_dtype is not None else x.dtype
+    if x.ndim > 2:
+        # N-D last-axis gather (e.g. the hybrid step's [batch, n, n/tp]
+        # column gather): flatten the leading dims into rows
+        if axis != x.ndim - 1:
+            raise ValueError(
+                f"unsupported gather axis {axis} for rank {x.ndim}")
+        lead = x.shape[:-1]
+        out = wire_all_gather(x.reshape(-1, x.shape[-1]), axis_name, fmt,
+                              axis=1, out_dtype=out_dtype)
+        return out.reshape(*lead, -1)
+    if axis not in (0, 1):
+        raise ValueError(f"unsupported gather axis {axis}")
+    q, s = _wire_quantize(x, fmt)
+    q_all = lax.all_gather(q, axis_name, axis=axis, tiled=True)
+    s_all = lax.all_gather(s, axis_name, axis=axis, tiled=True)
+    # `_wire_dequantize` infers the block width from the gathered shapes,
+    # which is correct for both axes: axis=0 stacks rows (scales stack the
+    # same way); axis=1 concatenates each device's column blocks next to
+    # its own scale blocks
+    return _wire_dequantize(q_all, s_all).astype(res_dtype)
+
+
+def _count_program(fmt: WireFormat, collective: str) -> None:
+    """Obs counter: one tick per program *build* that selects a quantized
+    wire format (trace-time, not per-step — collectives run inside jit)."""
+    try:
+        from tpu_matmul_bench.obs.registry import get_registry
+
+        get_registry().counter("comm_quant_programs_total",
+                               format=fmt.spec, collective=collective).inc()
+    except Exception:
+        pass  # observability must never break a build
+
+
+def psum_impl(comm_quant: str | None, varying_out: bool = False,
+              fuse_f32: bool = False):
+    """The psum implementation a mode should use for --comm-quant.
+
+    None/"none" → exact lax.psum; "int8"/"int8-tensor" → the legacy
+    per-row control tier (`quantized_psum`, which ignores `fuse_f32` —
+    it downcasts at every collective by design); anything else → the
+    block-quantized `wire_psum`.
+
+    `varying_out=True` returns a callable whose output vma is varying over
+    the axis either way — the quantized ring's output is already varying
+    (it ends in an all_gather of per-device chunks), while exact psum
+    needs a pcast; callers with sharded out_specs must not pcast again.
+
+    `fuse_f32=True` keeps the non-legacy output in fp32 so the consuming
+    matmul applies the scales in its fp32 accumulator and the caller owns
+    the single downcast (DTYPE-Q-001's "exactly one" contract).
+    """
+    fmt = parse_wire_format(comm_quant)
+    if fmt is None:
+        return _psum_varying if varying_out else lax.psum
+    _count_program(fmt, "all_reduce")
+    if fmt.legacy:
+        if not varying_out:
+            return quantized_psum
+
+        def legacy_varying(x: jax.Array, axis_name: str) -> jax.Array:
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                return _psum_varying(x, axis_name)
+            return quantized_psum(x, axis_name)
+
+        return legacy_varying
+    out_dtype = jnp.float32 if fuse_f32 else None
+
+    def wire(x: jax.Array, axis_name: str) -> jax.Array:
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            # exact integer path: axis-invariant output needs the same
+            # pcast as the plain-psum branch when out_specs shard the axis
+            return (_psum_varying if varying_out else lax.psum)(x, axis_name)
+        return wire_psum(x, axis_name, fmt, out_dtype=out_dtype)
+
+    return wire
+
+
+def allgather_impl(comm_quant: str | None, fuse_f32: bool = False):
+    """The all_gather implementation a mode should use for --comm-quant
+    (the AG analogue of `psum_impl`; same format routing and `fuse_f32`
+    contract)."""
+    fmt = parse_wire_format(comm_quant)
+    if fmt is None:
+        return lambda x, axis_name, axis=0: lax.all_gather(
+            x, axis_name, axis=axis, tiled=True)
+    _count_program(fmt, "all_gather")
+    if fmt.legacy:
+        return quantized_all_gather
+    out_dtype = jnp.float32 if fuse_f32 else None
+
+    def wire(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
+        return wire_all_gather(x, axis_name, fmt, axis=axis,
+                               out_dtype=out_dtype)
+
+    return wire
+
+
+def comm_quant_record_extra(config, world: int, *, mode: str, size: int,
+                            batch: int = 4, dp: int | None = None,
+                            rows: int | None = None) -> dict:
+    """The ledger's `extras["comm_quant"]` value: the inertness-aware
+    format label plus the static wire-byte model for this (mode, world,
+    size) cell — the bandwidth axis of the accuracy-vs-bandwidth frontier.
+    """
+    tp = (world // dp) if dp else None
+    extra: dict = {
+        "spec": config.comm_quant,
+        "format": comm_quant_extra(config, world, dp=dp, tp=tp),
+    }
+    fmt = parse_wire_format(config.comm_quant)
+    inert = (fmt is None or world <= 1
+             or jnp.issubdtype(jnp.dtype(config.dtype), jnp.integer))
+    if not inert:
+        from tpu_matmul_bench.analysis.comms_model import wire_bytes_summary
+
+        try:
+            extra.update(wire_bytes_summary(
+                mode, world, size, config.dtype, config.comm_quant,
+                batch=batch, dp=dp, rows=rows))
+        except ValueError:
+            pass  # modes the analytic model doesn't cover stay label-only
+    return extra
 
 
 def psum_over(mesh: Mesh, axis: str = "x"):

@@ -29,9 +29,9 @@ from tpu_matmul_bench.parallel.modes import (
     expected_corner,
     make_corner_validate,
 )
-from tpu_matmul_bench.parallel.quantized import (
+from tpu_matmul_bench.parallel.collectives import (
     allgather_impl,
-    comm_quant_extra,
+    comm_quant_record_extra,
     psum_impl,
     uses_quantized_comm,
 )
@@ -59,7 +59,13 @@ def hybrid_programs(mesh: Mesh, impl: str = "xla",
     `comm_quant="int8"` routes BOTH collectives over the int8 wire (the
     tp column gather and the dp gradient-sync psum)."""
     mm = matmul_2d(impl, blocks, mesh_device_kind(mesh))
-    ag = allgather_impl(comm_quant)
+    # the tp gather feeds the dp reduction, not the ledger: fuse_f32 keeps
+    # the block formats' dequantized values in fp32 through the batch sum
+    # and the dp psum, so the whole step performs exactly one downcast (the
+    # final astype below) — the accumulate-high discipline DTYPE-Q-001
+    # certifies. The legacy int8/int8-tensor control tier ignores fuse_f32
+    # and downcasts at every collective, as in PR 2.
+    ag = allgather_impl(comm_quant, fuse_f32=True)
     psum = psum_impl(comm_quant, varying_out=True)
 
     def compute_body(x, w):  # x: [batch/dp, n, n], w: [n, n/tp]
@@ -67,12 +73,16 @@ def hybrid_programs(mesh: Mesh, impl: str = "xla",
 
     def full_body(x, w):
         y = jax.lax.optimization_barrier(compute_body(x, w))
+        out_dt = y.dtype  # the exact program's output dtype
         # tp leg: assemble full output columns on every tp rank
         y = ag(y, "tp", axis=2)
         # dp leg: gradient-sync-style reduction of the batch shard sum
         # (psum_impl's varying_out covers the 'dp' axis; the quantized
         # ring's output is varying already, exact psum gets a pcast)
         g = psum(jnp.sum(y, axis=0), "dp")
+        # the single downcast for the fused wire formats; a no-op (and not
+        # traced) for exact, legacy-quantized and integer programs
+        g = g.astype(out_dt)
         return pcast_varying(g, "tp")
 
     compute = smap(compute_body, mesh,
@@ -105,17 +115,11 @@ def hybrid_mode(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
         extras = {"dp": dp, "tp": tp, "global_batch": g,
                   "local_batch": local_batch}
         if uses_quantized_comm(config):
-            label = comm_quant_extra(config, world)
-            if label == config.comm_quant:
-                # half-inert grids: a 1-extent axis short-circuits ITS
-                # collective (dp=1 → the psum, tp=1 → the gather) while
-                # the other is genuinely quantized; dp=tp=1 is the
-                # world=1 case comm_quant_extra already flags
-                if dp == 1:
-                    label += " (psum inert at dp=1)"
-                elif tp == 1:
-                    label += " (gather inert at tp=1)"
-            extras["comm_quant"] = label
+            # per-axis inertness (dp=1 → the psum is a no-op, tp=1 → the
+            # gather is) is worded by comm_quant_extra itself; the dict
+            # adds the static wire-byte model for the frontier
+            extras["comm_quant"] = comm_quant_record_extra(
+                config, world, mode="hybrid", size=size, batch=batch, dp=dp)
         if g != batch:
             extras["note"] = f"global batch grown from {batch} to {g} to cover dp={dp}"
         return BenchmarkRecord(
@@ -142,6 +146,6 @@ def hybrid_mode(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
                          lambda xx, ww: full(xx, ww)[:size], (x, w),
                          lambda: expected_corner(jnp.sum(x, axis=0), w),
                          config.dtype,
-                         quantized_comm=uses_quantized_comm(config),
+                         comm_quant=config.comm_quant,
                          # dp psum hops + one AG rounding drive the error
                          world=dp + 1))

@@ -34,9 +34,9 @@ from tpu_matmul_bench.parallel.mesh import (
     smap as _smap,
     world_size,
 )
-from tpu_matmul_bench.parallel.quantized import (
+from tpu_matmul_bench.parallel.collectives import (
     allgather_impl,
-    comm_quant_extra,
+    comm_quant_record_extra,
     psum_impl,
     uses_quantized_comm,
 )
@@ -160,9 +160,30 @@ def corner_validation(got: jax.Array, expected: jax.Array, dtype: Any,
     }
 
 
+def quantized_tolerance(comm_quant: str | None, world: int) -> float | None:
+    """The corner-validation tolerance a quantized-wire run must meet, or
+    None for exact collectives.
+
+    The wire ring's documented worst case grows ~(per-step rounding)·world
+    per hop, so the tolerance scales with the reduction width — a fixed
+    dtype tolerance spuriously FAILs correct runs at d ≥ 8. The per-step
+    rounding depends on the wire dtype: int8 rounds to 1/254 of the block
+    max (so 2·world/254, the PR-2 bound); float8_e4m3fn's 3-bit mantissa
+    rounds to at most 1/16 of each value (so 2·world/16 — loose, a sanity
+    rail; the seeded accuracy bounds live in tests/test_comm_quant_block).
+    """
+    from tpu_matmul_bench.parallel.collectives import parse_wire_format
+
+    fmt = parse_wire_format(comm_quant)
+    if fmt is None:
+        return None
+    per_step = 2 / 254 if fmt.qtype == "int8" else 2 / 16
+    return max(validation_tolerance(jnp.bfloat16), world * per_step)
+
+
 def make_corner_validate(program, operands, expected_fn, dtype,
                          index: int | None = None,
-                         quantized_comm: bool = False,
+                         comm_quant: str | None = None,
                          world: int = 1) -> Callable[[], dict]:
     """Build a ModeSetup.validate closure: run `program` over `operands`,
     take `[index]` of the result when the output is stacked, and
@@ -173,14 +194,11 @@ def make_corner_validate(program, operands, expected_fn, dtype,
         if index is not None:
             out = out[index]
         got = out[:VALIDATION_CORNER, :VALIDATION_CORNER]
-        if quantized_comm and not jnp.issubdtype(jnp.dtype(dtype),
-                                                 jnp.integer):
-            # int8-wire psum's documented worst case grows ~d/254 per hop
-            # (quantized.py), so the tolerance must scale with the
-            # reduction width — a fixed dtype tolerance spuriously FAILs
-            # correct runs at d ≥ 8. Integer inputs bypass the quantized
-            # wire (exact lax.psum path) and keep their exact tolerance.
-            tol = max(validation_tolerance(jnp.bfloat16), 2 * world / 254)
+        tol = quantized_tolerance(comm_quant, world)
+        if tol is not None and not jnp.issubdtype(jnp.dtype(dtype),
+                                                  jnp.integer):
+            # integer inputs bypass the quantized wire (exact lax.psum
+            # path) and keep their exact tolerance
             return corner_validation(got, expected_fn(), dtype, tol=tol)
         return corner_validation(got, expected_fn(), dtype)
 
@@ -345,7 +363,8 @@ def batch_parallel(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
         per_dev = calculate_tflops(size, total_s, num_ops=local_batch)
         extras = {"global_batch": g, "local_batch": local_batch}
         if uses_quantized_comm(config):
-            extras["comm_quant"] = comm_quant_extra(config, d)
+            extras["comm_quant"] = comm_quant_record_extra(
+                config, d, mode="batch_parallel", size=size, batch=batch)
         if g != batch:
             extras["note"] = f"global batch grown from {batch} to {g} to cover {d} devices"
         return _record_base(
@@ -369,7 +388,7 @@ def batch_parallel(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
                          lambda: expected_corner_sum(a[::local_batch],
                                                      b[::local_batch]),
                          config.dtype, index=0,
-                         quantized_comm=uses_quantized_comm(config),
+                         comm_quant=config.comm_quant,
                          world=d))
 
 
@@ -398,7 +417,8 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
 
             def build_flagged(t_c, t_f, comm_s):
                 rec = inner(t_c, t_f, comm_s)
-                rec.extras["comm_quant"] = comm_quant_extra(config, 1)
+                rec.extras["comm_quant"] = comm_quant_record_extra(
+                    config, 1, mode="matrix_parallel", size=size)
                 return rec
 
             return dataclasses.replace(setup, mode="matrix_parallel",
@@ -430,7 +450,8 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
         per_dev = actual / d  # effective per-device (:233)
         extras = {"portion_per_device": f"1/{d} of B's columns"}
         if uses_quantized_comm(config):
-            extras["comm_quant"] = comm_quant_extra(config, d)
+            extras["comm_quant"] = comm_quant_record_extra(
+                config, d, mode="matrix_parallel", size=size)
         return _record_base(
             config, benchmark, "matrix_parallel", size, d, t_full or t_compute,
             avg_time_s=total_s,
@@ -447,7 +468,7 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
                      validate=make_corner_validate(
                          full, (a, b), lambda: expected_corner(a, b),
                          config.dtype,
-                         quantized_comm=uses_quantized_comm(config),
+                         comm_quant=config.comm_quant,
                          world=d))
 
 
@@ -483,7 +504,8 @@ def data_parallel(config: BenchConfig, mesh: Mesh, size: int,
         total_s = t_full.avg_s if t_full else t_compute.avg_s
         extras = {}
         if uses_quantized_comm(config):
-            extras["comm_quant"] = comm_quant_extra(config, d)
+            extras["comm_quant"] = comm_quant_record_extra(
+                config, d, mode="data_parallel", size=size)
         return _record_base(
             config, benchmark, "data_parallel", size, d, t_full or t_compute,
             avg_time_s=total_s,
@@ -500,7 +522,7 @@ def data_parallel(config: BenchConfig, mesh: Mesh, size: int,
                      validate=make_corner_validate(
                          full, (a, b), lambda: expected_corner_sum(a, b),
                          config.dtype, index=0,
-                         quantized_comm=uses_quantized_comm(config),
+                         comm_quant=config.comm_quant,
                          world=d))
 
 
@@ -555,7 +577,8 @@ def model_parallel(config: BenchConfig, mesh: Mesh, size: int,
         per_dev = actual / d
         extras = {"combine": "psum (reference used all_gather on partial sums)"}
         if uses_quantized_comm(config):
-            extras["comm_quant"] = comm_quant_extra(config, d)
+            extras["comm_quant"] = comm_quant_record_extra(
+                config, d, mode="model_parallel", size=size)
         return _record_base(
             config, benchmark, "model_parallel", size, d, t_full or t_compute,
             avg_time_s=total_s,
@@ -572,7 +595,7 @@ def model_parallel(config: BenchConfig, mesh: Mesh, size: int,
                      validate=make_corner_validate(
                          full, (a, b), lambda: expected_corner(a, b),
                          config.dtype,
-                         quantized_comm=uses_quantized_comm(config),
+                         comm_quant=config.comm_quant,
                          world=d))
 
 

@@ -22,6 +22,13 @@ is fp32. Error grows O(hops · per-hop rounding) ≈ d/254 of the row max;
 the tests pin < 2% max relative error (vs the sum's max) for Gaussian
 data on the 8-device mesh — the cost of halving bf16 wire bytes. Integer inputs are
 summed exactly (no quantization needed — they pass through lax.psum).
+
+Since PR 10 this per-row path is the **A/B control tier** behind the
+block-quantized wire formats in `parallel/collectives.py`; the flag
+values ``int8`` and ``int8-tensor`` both select it (it downcasts back to
+the operand dtype at every collective, unlike the fused block formats).
+Modes import `psum_impl`/`allgather_impl` from `collectives`, which
+delegates here for the legacy formats.
 """
 
 from __future__ import annotations
@@ -190,15 +197,30 @@ def psum_impl(comm_quant: str | None, varying_out: bool = False):
     raise ValueError(f"unknown comm quantization {comm_quant!r}")
 
 
-def comm_quant_extra(config, world: int) -> str:
-    """The `comm_quant` extras value for a record: when the quantized
+def comm_quant_extra(config, world: int, *, dp: int | None = None,
+                     tp: int | None = None) -> str:
+    """The `comm_quant` format label for a record: when the quantized
     collectives are exact no-ops the record must say so, or a "quantized"
-    record is indistinguishable from an int8-wire measurement. Two inert
-    cases: world=1 (the d==1 short-circuits below), and integer operand
-    dtypes at ANY world size (quantized_psum/quantized_all_gather take
-    the exact integer-collective early return — the matmul outputs the
-    collectives move are integer whenever the inputs are)."""
+    record is indistinguishable from a quantized-wire measurement. The
+    wording applies to every wire format (legacy int8/int8-tensor, fp8,
+    int8-block:<B>, fp8-block:<B> — all share the same integer and d==1
+    short-circuits). Inert cases:
+
+    - integer operand dtypes at ANY world size (the collectives take the
+      exact integer early return — the matmul outputs the collectives
+      move are integer whenever the inputs are);
+    - world=1 (the d==1 short-circuits);
+    - per-axis inertness in hybrid meshes (pass dp/tp): dp=1 makes the
+      gradient psum a no-op, tp=1 makes the column gather a no-op.
+    """
     q = config.comm_quant
     if jnp.issubdtype(jnp.dtype(config.dtype), jnp.integer):
         return f"{q} (inert: integer operands take the exact collective)"
-    return f"{q} (inert at world=1)" if world <= 1 else q
+    if world <= 1:
+        return f"{q} (inert at world=1)"
+    if dp is not None and tp is not None:
+        if dp == 1:
+            return f"{q} (psum inert at dp=1)"
+        if tp == 1:
+            return f"{q} (gather inert at tp=1)"
+    return q

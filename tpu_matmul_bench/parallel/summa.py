@@ -49,8 +49,8 @@ from tpu_matmul_bench.parallel.modes import (
     expected_corner,
     make_corner_validate,
 )
-from tpu_matmul_bench.parallel.quantized import (
-    comm_quant_extra,
+from tpu_matmul_bench.parallel.collectives import (
+    comm_quant_record_extra,
     psum_impl,
     uses_quantized_comm,
 )
@@ -111,7 +111,12 @@ def summa_programs(mesh: Mesh, impl: str = "xla",
     r, c = mesh.shape["i"], mesh.shape["j"]
     s = math.lcm(r, c)
     mm = matmul_2d(impl, blocks, mesh_device_kind(mesh))
-    psum = psum_impl(comm_quant)
+    # fuse_f32: the broadcast panels feed the step matmul directly, so the
+    # block wire formats keep their dequantized fp32 panels alive into the
+    # dot and the per-step `astype(out_dtype)` on the accumulate is the
+    # mode's single downcast (the legacy int8 control tier ignores this
+    # and downcasts at each broadcast, as in PR 2)
+    psum = psum_impl(comm_quant, fuse_f32=True)
 
     def body(a_local, b_local, with_comm: bool):
         # a_local [m/r, k/c], b_local [k/r, n/c]; k panels of width k/s
@@ -173,7 +178,8 @@ def summa_mode(config: BenchConfig, mesh: Mesh, size: int,
         extras = {"grid": f"{r}x{c}", "k_panels": s,
                   "algorithm": "SUMMA (2-D grid, masked-psum broadcasts)"}
         if uses_quantized_comm(config):
-            extras["comm_quant"] = comm_quant_extra(config, world)
+            extras["comm_quant"] = comm_quant_record_extra(
+                config, world, mode="summa", size=size, rows=r)
         return BenchmarkRecord(
             benchmark=benchmark, mode="summa", size=size,
             dtype=config.dtype_name, world=world,
@@ -193,7 +199,7 @@ def summa_mode(config: BenchConfig, mesh: Mesh, size: int,
             "summa", config, world, size),
         validate=make_corner_validate(
             full, (a, b), lambda: expected_corner(a, b), config.dtype,
-            quantized_comm=uses_quantized_comm(config),
+            comm_quant=config.comm_quant,
             # each C element crosses two quantized broadcasts per panel;
             # scale the tolerance by the broader of the two axes
             world=max(r, c) + 1),

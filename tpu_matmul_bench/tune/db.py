@@ -27,6 +27,16 @@ Durability follows `campaign/state.py`: JSONL, one fsync'd line per
 cell, append-only — later records supersede earlier ones for the same
 key, so promotions never rewrite history and a crash mid-write loses at
 most the line being written.
+
+**Wire-format keying (PR 10):** a ``--comm-quant`` wire format is part of
+the problem identity — `problem_fingerprint` folds it into the digest
+when set, and `Cell.comm_quant` records it in the cell's ``problem``
+block. Every cell written before PR 10 is implicitly full-precision
+(``comm_quant`` absent → the fingerprint is byte-identical to what it
+always was, nothing in the committed DB is invalidated); quantized-wire
+problems hash to NEW fingerprints, so they start with no cells and no
+inherited winners until a measured/analytic promotion cites a
+quantized-wire artifact.
 """
 
 from __future__ import annotations
@@ -78,13 +88,25 @@ def canonical_dtype(dtype: Any) -> str:
     return "bfloat16" if name == "float16" else name
 
 
-def problem_fingerprint(m: int, k: int, n: int, dtype: Any) -> str:
+def problem_fingerprint(m: int, k: int, n: int, dtype: Any,
+                        comm_quant: str | None = None) -> str:
     """Stable digest of one routing question. Hashing convention shared
-    with the DRIFT gate (analysis/fingerprint.digest)."""
+    with the DRIFT gate (analysis/fingerprint.digest).
+
+    A quantized wire format is part of the problem identity: the fused
+    dequant changes the consuming program (fp32 panels into the matmul,
+    one trailing downcast), so a cell tuned under ``--comm-quant`` must
+    never alias the full-precision cell for the same shape. The key is
+    only added when a format is active — every pre-PR-10 fingerprint
+    (and the committed DB) is unchanged; quantized-wire routing starts
+    from empty cells rather than inheriting full-precision winners."""
     from tpu_matmul_bench.analysis.fingerprint import digest
 
-    return digest({"op": "matmul_2d", "m": int(m), "k": int(k),
-                   "n": int(n), "dtype": canonical_dtype(dtype)})
+    record = {"op": "matmul_2d", "m": int(m), "k": int(k),
+              "n": int(n), "dtype": canonical_dtype(dtype)}
+    if comm_quant and comm_quant != "none":
+        record["comm_quant"] = str(comm_quant)
+    return digest(record)
 
 
 def program_digest(m: int, k: int, n: int, dtype: Any, impl: str,
@@ -130,6 +152,10 @@ class Cell:
     jax_version: str = ""
     program_digest: str = ""
     created_at: str = ""
+    # wire format the problem ran under (None = full-precision
+    # collectives); folded into the fingerprint so quantized cells never
+    # alias full-precision ones
+    comm_quant: str | None = None
 
     def __post_init__(self) -> None:
         if self.provenance_kind not in PROVENANCE_KINDS:
@@ -142,7 +168,8 @@ class Cell:
 
     @property
     def fingerprint(self) -> str:
-        return problem_fingerprint(self.m, self.k, self.n, self.dtype)
+        return problem_fingerprint(self.m, self.k, self.n, self.dtype,
+                                   self.comm_quant)
 
     @property
     def key(self) -> tuple[str, str]:
@@ -158,13 +185,16 @@ class Cell:
         return f"{text} — {self.detail}" if self.detail else text
 
     def to_record(self) -> dict[str, Any]:
+        problem: dict[str, Any] = {"m": self.m, "k": self.k, "n": self.n,
+                                   "dtype": self.dtype}
+        if self.comm_quant and self.comm_quant != "none":
+            problem["comm_quant"] = self.comm_quant
         return {
             "record_type": "tune_cell",
             "schema": CELL_SCHEMA,
             "fingerprint": self.fingerprint,
             "device_kind": self.device_kind,
-            "problem": {"m": self.m, "k": self.k, "n": self.n,
-                        "dtype": self.dtype},
+            "problem": problem,
             "impl": self.impl,
             "blocks": list(self.blocks) if self.blocks else None,
             "provenance": {"kind": self.provenance_kind,
@@ -194,6 +224,7 @@ class Cell:
             jax_version=str(rec.get("jax_version", "")),
             program_digest=str(rec.get("program_digest", "")),
             created_at=str(rec.get("created_at", "")),
+            comm_quant=prob.get("comm_quant"),
         )
 
 

@@ -109,6 +109,20 @@ class BenchConfig:
         return tuple(DEFAULT_BLOCK if v is None else v for v in given)
 
 
+def comm_quant_arg(value: str) -> str:
+    """argparse type for --comm-quant: validate against the wire-format
+    grammar (none | int8 | int8-tensor | fp8 | int8-block:<B> |
+    fp8-block:<B>) at parse time, keeping the raw string as the config
+    value (parallel/collectives.py parses it again where it is used)."""
+    from tpu_matmul_bench.parallel.collectives import parse_wire_format
+
+    try:
+        parse_wire_format(value)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+    return value
+
+
 def build_parser(
     description: str,
     modes: Sequence[str] | None = None,
@@ -171,11 +185,17 @@ def build_parser(
              "live)",
     )
     p.add_argument(
-        "--comm-quant", type=str, default=None, choices=["none", "int8"],
-        help="Quantize all_reduce wire traffic (int8 payloads + per-row "
-             "scales over a ring — half the bf16 bytes at ~d/254 relative "
-             "error; parallel/quantized.py). Applies to the psum modes "
-             "(batch_parallel, data_parallel, model_parallel).",
+        "--comm-quant", type=comm_quant_arg, default=None,
+        metavar="{none,int8,int8-tensor,fp8,int8-block:<B>,fp8-block:<B>}",
+        help="Wire format for the collectives (parallel/collectives.py): "
+             "quantized payloads + fp32 scale side-channel over the ring — "
+             "half the bf16 wire bytes at a bounded relative error. "
+             "'int8'/'int8-tensor' select the legacy per-row control tier "
+             "(parallel/quantized.py); 'fp8' is per-row float8_e4m3fn; "
+             "'int8-block:<B>'/'fp8-block:<B>' quantize per B-column block "
+             "with one fp32 scale each and fuse the dequant into the "
+             "consuming matmul. Applies to every distributed mode's "
+             "psum/all_gather leg.",
     )
     p.add_argument(
         "--precision", type=str, default="default",

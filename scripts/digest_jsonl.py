@@ -88,6 +88,24 @@ def _serve_sublines(r) -> list[str]:
                 f"      tenant {tid:<14} {row.get('requests', 0):>6} done "
                 f"{row.get('shed', 0):>5} shed  p99={row.get('p99_ms')}ms "
                 f"wait={row.get('wait_p99_ms')}ms  {slo}")
+    # circuit-breaker state (continuous scheduler): one line per bucket
+    # that ever tripped, plus the door-shed count with its own reason —
+    # the "why did this bucket's traffic vanish" answer (DESIGN §17)
+    queue = s.get("queue") or {}
+    breakers = queue.get("breakers") or {}
+    tripped = {label: b for label, b in breakers.items()
+               if isinstance(b, dict)
+               and (b.get("opens") or b.get("state") != "closed")}
+    if tripped or queue.get("breaker_sheds"):
+        for label, b in sorted(tripped.items()):
+            lines.append(
+                f"      breaker {label:<27} state={b.get('state')} "
+                f"opens={b.get('opens', 0)} "
+                f"fails={b.get('consecutive_fails', 0)}")
+        if queue.get("breaker_sheds"):
+            lines.append(
+                f"      breaker sheds: {queue['breaker_sheds']} "
+                "(reason=breaker_open, distinct from depth overflow)")
     buckets = s.get("buckets") or {}
     effs = {label: b.get("flops_efficiency_pct")
             for label, b in buckets.items()
@@ -314,6 +332,29 @@ def _frontier_lines(rows: list[tuple[str, dict]]) -> list[str]:
     return lines
 
 
+def _digest_fault_audit(recs: list[dict]) -> None:
+    """Fault-audit verdict ledger (fault_audit.jsonl from `faults
+    audit`): one line per chaos cell — fault plan, subsystem, PASS/FAIL,
+    attempts the retry budget burned, recovery wall time, escalation
+    ladder — with every surviving problem printed under its cell."""
+    rows = [r for r in recs if r.get("record_type") == "fault_audit"]
+    print(f"  {'cell':<26} {'subsystem':<9} {'verdict':<7} "
+          f"{'att':>3} {'recovery':>9} escalation")
+    passed = 0
+    for r in rows:
+        status = str(r.get("status"))
+        passed += status == "PASS"
+        print(f"  {str(r.get('cell')):<26} {str(r.get('subsystem')):<9} "
+              f"{status:<7} {r.get('attempts', 1):>3} "
+              f"{r.get('recovery_s', 0):>8.2f}s "
+              f"{r.get('escalation') or '-'}")
+        for p in r.get("problems") or []:
+            print(f"      ! {p}")
+    verdict = "CERTIFIED" if passed == len(rows) else "FAILED"
+    print(f"  total: {passed}/{len(rows)} cells PASS — "
+          f"crash consistency {verdict}")
+
+
 def _is_campaign_dir(p: Path) -> bool:
     return (p / _JOURNAL).exists() or (p / _JOBS_SUBDIR).is_dir()
 
@@ -368,8 +409,10 @@ def _digest_campaign(d: Path) -> None:
             except ValueError:
                 continue
             # per-job manifests are identical boilerplate here — the
-            # campaign's spec.json carries the provenance for the set
-            if not isinstance(r, dict) or r.get("record_type") == "manifest":
+            # campaign's spec.json carries the provenance for the set;
+            # streamed progress lines (serve_batch) are a liveness
+            # channel, not measurements — only `benchmark` records rank
+            if not isinstance(r, dict) or "benchmark" not in r:
                 continue
             rows.append((job_id, r))
     if not rows:
@@ -433,9 +476,23 @@ def main(paths: list[str]) -> None:
                   f"{m.get('device_count')}x{m.get('device_kind')} "
                   f"git={sha} dtype={cfg.get('dtype')}{run_bits} "
                   f"argv={' '.join(m.get('argv') or [])}")
+        # streamed serve_batch progress lines are liveness evidence for
+        # the fault audit, not measurements — aggregate, never rank
+        batches = [r for r in recs if r.get("record_type") == "serve_batch"]
+        if batches:
+            recs = [r for r in recs
+                    if r.get("record_type") != "serve_batch"]
+            done = sum(r.get("n", 0) for r in batches)
+            failed = sum(r.get("failed", 0) for r in batches)
+            print(f"  [stream] {len(batches)} serve_batch lines "
+                  f"({done} requests, {failed} failed) — liveness "
+                  "channel, excluded from ranking")
         if any(r.get("record_type") in ("lint_finding", "lint_summary")
                for r in recs):
             _digest_lint(recs, manifests)
+            continue
+        if any(r.get("record_type") == "fault_audit" for r in recs):
+            _digest_fault_audit(recs)
             continue
         if any(r.get("record_type") == "tune_cell" for r in recs):
             _digest_tune(recs)

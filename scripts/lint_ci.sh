@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Six layers, cheapest first:
+# Seven layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -31,7 +31,13 @@
 #      bounds per --comm-quant format on the 8-device virtual CPU mesh,
 #      the block→per-row degeneracy identity, the outlier-row fixture
 #      (block scales must beat per-row scales), and integer inertness.
-#   6. python -m tpu_matmul_bench serve selftest — drives the
+#   6. python -m tpu_matmul_bench faults selftest — in-process fault
+#      machinery invariants (DESIGN §17): fault-plan grammar round-trip,
+#      deterministic retry backoff, the circuit breaker's open/shed/
+#      half-open/recover cycle with obs-bus visibility, the FAULT-001/002
+#      static audits (clean tree + seeded violations), and chaos-matrix
+#      coverage. No subprocesses, no device.
+#   7. python -m tpu_matmul_bench serve selftest — drives the
 #      multi-tenant continuous-batching scheduler end-to-end on CPU and
 #      validates the serve ledger contract: scheduler identity, cache
 #      and queue reconciliation, per-tenant rows summing to the request
@@ -58,6 +64,9 @@ JAX_PLATFORMS=cpu python -m tpu_matmul_bench obs selftest
 echo "== collectives selftest (quantized wire formats, numeric bounds) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m tpu_matmul_bench collectives selftest
+
+echo "== faults selftest (fault plans / retries / breaker / static audits) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench faults selftest
 
 echo "== serve selftest (multi-tenant scheduler / ledger contract) =="
 JAX_PLATFORMS=cpu python -m tpu_matmul_bench serve selftest

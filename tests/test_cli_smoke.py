@@ -120,6 +120,45 @@ def test_cli_serve_selftest_validates_its_own_ledger():
     assert s["cold_requests"] == 0
 
 
+def test_cli_faults_selftest_invariants_hold():
+    """`faults selftest` is the fault machinery's CI hook: in-process
+    invariants (plan grammar, retry determinism, breaker lifecycle,
+    FAULT-001/002 static audits, chaos-matrix coverage) must all hold on
+    the shipped tree, exit 0, and say so."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu_matmul_bench", "faults", "selftest"],
+        env=scrubbed_env(platforms="cpu", device_count=1),
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "faults selftest: all invariants hold" in out.stdout
+    assert "[FAIL]" not in out.stdout
+
+
+def test_cli_faults_audit_smoke_certifies(tmp_path):
+    """The crash-consistency certifier's CI subset: one direct cell per
+    subsystem from the shipped chaos matrix (kill a child mid-write,
+    resume, require convergence with the clean run). Exit 0 plus a
+    PASS-only fault_audit.jsonl is the certification evidence."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu_matmul_bench", "faults", "audit",
+         "--spec", str(REPO / "specs" / "chaos.toml"),
+         "--dir", str(tmp_path), "--smoke"],
+        env=scrubbed_env(platforms="cpu", device_count=1),
+        capture_output=True, text=True, timeout=600, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "[FAIL]" not in out.stdout
+    recs = [json.loads(line) for line in
+            (tmp_path / "fault_audit.jsonl").read_text().splitlines()]
+    verdicts = [r for r in recs if r.get("record_type") == "fault_audit"]
+    assert verdicts and all(r["status"] == "PASS" for r in verdicts)
+    # one cell per non-campaign direct subsystem, fault actually fired
+    # and was recovered from (clean + faulted + resumed evidence on disk)
+    assert {r["subsystem"] for r in verdicts} == {"ledger", "tune", "obs"}
+    assert all(r["problems"] == [] for r in verdicts)
+
+
 def test_cli_lint_full_audit_exits_zero(tmp_path):
     """Acceptance bar: `python -m tpu_matmul_bench lint --fail-on error`
     must exit 0 on the shipped tree, and its --json-out ledger must be a

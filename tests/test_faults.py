@@ -1,0 +1,762 @@
+"""Fault-injection subsystem tests (DESIGN §17).
+
+Covers the deterministic fault-plan grammar and its runtime, the
+supervisor's escalation ladder, the unified retry policy, the failure
+taxonomy table, the serve circuit breaker's deterministic lifecycle,
+the FAULT-001/002 static audits (with seeded-violation fixtures pinning
+the rule IDs), the chaos-matrix spec lint, and — the crash-consistency
+core — a torn-line fuzz over every durable JSONL artifact: truncate AND
+garble the last record at every byte offset, and the repo's own readers
+must recover every complete record without raising.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_matmul_bench.faults import plan as plan_mod
+from tpu_matmul_bench.faults.plan import (
+    FaultPlan,
+    FaultPlanError,
+    FaultSpec,
+    KINDS,
+    parse_inline,
+    parse_plan,
+    tear_file,
+)
+
+SPEC_PATH = Path(__file__).resolve().parents[1] / "specs" / "chaos.toml"
+
+
+# ---------------------------------------------------------------------------
+# fault-plan grammar
+
+
+class TestPlanGrammar:
+    def test_inline_round_trips_every_kind(self):
+        plan = FaultPlan(specs=(
+            FaultSpec(kind="kill9", phase="w:record", occurrence=2),
+            FaultSpec(kind="hang", phase="w:cell", delay_ms=1500),
+            FaultSpec(kind="torn-write", phase="w:cell", glob="*.jsonl",
+                      occurrence=3),
+            FaultSpec(kind="transient-exc", phase="job:*",
+                      errclass="transport"),
+            FaultSpec(kind="disk-full", phase="w:snapshot", occurrence=2),
+        ), seed=7)
+        assert {s.kind for s in plan.specs} == set(KINDS)
+        assert parse_inline(plan.to_inline(), seed=7) == plan
+
+    def test_empty_phase_defaults_to_star(self):
+        # "kill9@" is valid: an empty phase glob means "every span"
+        assert parse_inline("kill9@").specs[0].phase == "*"
+
+    @pytest.mark.parametrize("bad", [
+        "kill9",                   # no @phase separator
+        "meteor-strike@w:record",  # unknown kind
+        "hang@w:cell",             # hang without a delay
+        "hang:zero@w:cell",        # non-numeric delay
+        "torn-write@w:cell",       # torn-write without a glob
+        "kill9@w:record#0",        # occurrence below 1
+        "kill9@w:record#two",      # non-integer occurrence
+        "kill9:arg@w:record",      # kind that takes no argument
+        "transient-exc:gamma-ray@w:record",  # unknown errclass
+        "",                        # empty plan
+    ])
+    def test_malformed_plans_rejected_loudly(self, bad):
+        with pytest.raises(FaultPlanError):
+            parse_inline(bad)
+
+    def test_plan_file_toml(self, tmp_path):
+        p = tmp_path / "plan.toml"
+        p.write_text('seed = 9\n'
+                     '[[fault]]\n'
+                     'kind = "transient-exc"\n'
+                     'phase = "w:record"\n'
+                     'errclass = "transport"\n'
+                     'occurrence = 2\n')
+        plan = parse_plan(str(p))
+        assert plan.seed == 9
+        assert plan.specs == (FaultSpec(
+            kind="transient-exc", phase="w:record", errclass="transport",
+            occurrence=2),)
+
+    def test_plan_file_rejects_unknown_fields(self, tmp_path):
+        p = tmp_path / "plan.json"
+        p.write_text(json.dumps(
+            {"fault": [{"kind": "kill9", "blast_radius": 3}]}))
+        with pytest.raises(FaultPlanError):
+            parse_plan(str(p))
+
+
+# ---------------------------------------------------------------------------
+# runtime: occurrence counting + span hook (only the non-lethal kinds can
+# fire in-process; kill9/torn-write are covered by `faults audit`)
+
+
+class TestPlanRuntime:
+    def test_transient_exc_fires_on_nth_matching_span(self):
+        active = plan_mod.ActivePlan(
+            parse_inline("transient-exc:transport@w:x#2"))
+        active.on_span("w:x")          # occurrence 1: no fire
+        active.on_span("unrelated")    # non-matching span: not counted
+        with pytest.raises(ConnectionResetError):
+            active.on_span("w:x")      # occurrence 2: fire
+        active.on_span("w:x")          # already fired: stays quiet
+        assert active.fired == [1]
+
+    def test_disk_full_is_enospc(self):
+        active = plan_mod.ActivePlan(parse_inline("disk-full@w:x"))
+        with pytest.raises(OSError) as exc_info:
+            active.on_span("w:x")
+        import errno
+
+        assert exc_info.value.errno == errno.ENOSPC
+
+    def test_injected_faults_classify_transient(self):
+        from tpu_matmul_bench.utils.errors import TRANSIENT, classify
+
+        for inline in ("transient-exc:transport@s", "transient-exc:oom@s",
+                       "disk-full@s"):
+            active = plan_mod.ActivePlan(parse_inline(inline))
+            with pytest.raises(BaseException) as exc_info:
+                active.on_span("s")
+            assert classify(exc_info.value) == TRANSIENT, inline
+
+    def test_telemetry_span_consults_env_plan(self, monkeypatch):
+        from tpu_matmul_bench.utils import telemetry
+
+        monkeypatch.setenv(plan_mod.FAULT_PLAN_ENV,
+                           "transient-exc:runtime@chaos:test")
+        plan_mod.reset_active_plan()
+        try:
+            with telemetry.span("chaos:other"):
+                pass  # glob does not match: no fire
+            with pytest.raises(RuntimeError, match="injected"):
+                with telemetry.span("chaos:test"):
+                    pass
+        finally:
+            plan_mod.reset_active_plan()
+
+    def test_span_touches_heartbeat_file(self, monkeypatch, tmp_path):
+        from tpu_matmul_bench.utils import telemetry
+
+        hb = tmp_path / "job.log.hb"
+        monkeypatch.setenv(plan_mod.HEARTBEAT_ENV, str(hb))
+        plan_mod.reset_active_plan()
+        try:
+            with telemetry.span("w:record"):
+                pass
+            assert hb.exists()
+            os.utime(hb, (0, 0))
+            with telemetry.span("w:record"):
+                pass
+            assert os.stat(hb).st_mtime > 0
+        finally:
+            plan_mod.reset_active_plan()
+
+
+class TestTearFile:
+    def test_tears_mid_last_line(self, tmp_path):
+        p = tmp_path / "f.jsonl"
+        p.write_text('{"a": 1}\n{"b": 22222222}\n')
+        assert tear_file(p)
+        data = p.read_bytes()
+        assert data.startswith(b'{"a": 1}\n{')
+        assert not data.endswith(b"\n")
+        lines = data.split(b"\n")
+        json.loads(lines[0])
+        with pytest.raises(ValueError):
+            json.loads(lines[1])
+
+    def test_empty_and_missing_are_noops(self, tmp_path):
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        assert not tear_file(p)
+        assert not tear_file(tmp_path / "missing.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# supervisor: escalation ladder
+
+
+class TestSupervisor:
+    def _run(self, code, tmp_path, **kw):
+        from tpu_matmul_bench.faults.supervisor import supervised_run
+
+        return supervised_run([sys.executable, "-c", code],
+                              log_path=tmp_path / "jobs" / "t.log", **kw)
+
+    def test_clean_exit(self, tmp_path):
+        from tpu_matmul_bench.faults.supervisor import heartbeat_path
+
+        res = self._run("print('ok')", tmp_path)
+        assert (res.rc, res.timed_out, res.escalation) == (0, False, "")
+        log = tmp_path / "jobs" / "t.log"
+        assert "ok" in log.read_text()
+        # the heartbeat file is touched at spawn, before the first span
+        assert heartbeat_path(log).exists()
+
+    def test_nonzero_exit_is_reported_not_escalated(self, tmp_path):
+        res = self._run("raise SystemExit(3)", tmp_path)
+        assert (res.rc, res.escalation) == (3, "")
+
+    def test_deadline_escalates_sigterm(self, tmp_path):
+        res = self._run("import time; time.sleep(60)", tmp_path,
+                        timeout_s=0.5)
+        assert res.rc is None and res.timed_out
+        assert "deadline" in res.error
+        assert res.escalation.startswith("SIGTERM")
+
+    def test_stall_watchdog_fires_before_deadline(self, tmp_path):
+        start = time.monotonic()
+        res = self._run("import time; time.sleep(60)", tmp_path,
+                        timeout_s=30.0, heartbeat_timeout_s=1.0)
+        assert res.rc is None and res.timed_out
+        assert "heartbeat stale" in res.error
+        # the stall clock, not the 30 s deadline, killed it
+        assert time.monotonic() - start < 15.0
+
+    def test_sigterm_ignorer_gets_sigkill(self, tmp_path):
+        code = ("import signal, time\n"
+                "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+                "time.sleep(60)\n")
+        res = self._run(code, tmp_path, timeout_s=1.0, term_grace_s=0.3)
+        assert res.escalation == "SIGTERM+SIGKILL"
+        log = (tmp_path / "jobs" / "t.log").read_text()
+        assert "sending SIGTERM" in log and "sending SIGKILL" in log
+
+    def test_spawn_failure_is_an_error_result(self, tmp_path):
+        from tpu_matmul_bench.faults.supervisor import supervised_run
+
+        res = supervised_run([str(tmp_path / "no-such-binary")],
+                             log_path=tmp_path / "jobs" / "t.log")
+        assert res.rc is None and not res.timed_out
+        assert "spawn failed" in res.error
+
+
+# ---------------------------------------------------------------------------
+# retry policy + budget
+
+
+class TestRetry:
+    def test_jitter_deterministic_per_seed_attempt_kind(self):
+        from tpu_matmul_bench.faults.retry import RetryPolicy
+
+        pol = RetryPolicy(base_s=30.0, jitter_pct=20.0, seed=11)
+        twin = RetryPolicy(base_s=30.0, jitter_pct=20.0, seed=11)
+        other = RetryPolicy(base_s=30.0, jitter_pct=20.0, seed=12)
+        grid = [(a, k) for a in (1, 2, 3, 6)
+                for k in ("error", "transport", "timeout")]
+        assert all(pol.delay(a, k) == twin.delay(a, k) for a, k in grid)
+        assert any(pol.delay(a, k) != other.delay(a, k) for a, k in grid)
+
+    def test_transport_floor_and_cap(self):
+        from tpu_matmul_bench.faults.retry import RetryPolicy
+
+        pol = RetryPolicy()
+        assert pol.delay(1, "transport") >= pol.transport_min_s
+        assert pol.delay(1, "error") == pol.base_s
+        # exponential growth saturates at the cap
+        assert pol.delay(50, "error") == pol.cap_s
+
+    def test_budget_spends_exactly_retries(self):
+        from tpu_matmul_bench.faults.retry import RetryBudget
+
+        budget = RetryBudget(retries=2)
+        spent = 0
+        while budget.allow():
+            budget.spend()
+            spent += 1
+        assert spent == 2 and budget.attempts == 3
+
+    def test_executor_reexports_the_extracted_policy(self):
+        from tpu_matmul_bench.campaign import executor
+        from tpu_matmul_bench.faults import retry
+
+        assert executor.BACKOFF_CAP_S == retry.BACKOFF_CAP_S
+        assert executor.TRANSPORT_MIN_BACKOFF_S \
+            == retry.TRANSPORT_MIN_BACKOFF_S
+
+
+# ---------------------------------------------------------------------------
+# failure taxonomy (satellite: table-driven classify test)
+
+
+class TestClassify:
+    @pytest.mark.parametrize("exc,want", [
+        (ConnectionResetError("Connection reset by peer"), "transient"),
+        (ConnectionRefusedError("Connection refused"), "transient"),
+        (TimeoutError("rendezvous timed out"), "transient"),
+        (RuntimeError("Gloo allreduce failed: Read timeout"), "transient"),
+        (RuntimeError("RESOURCE_EXHAUSTED: out of memory"), "transient"),
+        (OSError(28, "No space left on device"), "transient"),
+        (RuntimeError("DEADLINE_EXCEEDED waiting for barrier"), "transient"),
+        (ValueError("shape mismatch"), "permanent"),
+        (KeyError("missing_field"), "permanent"),
+        (RuntimeError("assertion failed: x != y"), "permanent"),
+    ])
+    def test_table(self, exc, want):
+        from tpu_matmul_bench.utils.errors import classify
+
+        assert classify(exc) == want
+
+    def test_overload_family(self):
+        from tpu_matmul_bench.utils.errors import (
+            OVERLOAD,
+            BreakerOpenError,
+            QueueOverflowError,
+            classify,
+            is_breaker_error,
+        )
+
+        shed = QueueOverflowError(8, 8)
+        trip = BreakerOpenError(0, 8, bucket="256x256x256/f32")
+        assert classify(shed) == OVERLOAD
+        assert classify(trip) == OVERLOAD
+        # breaker sheds are a distinguishable subtype of overload: they
+        # carry their own marker AND remain QueueOverflowError for every
+        # existing shed handler
+        assert isinstance(trip, QueueOverflowError)
+        assert is_breaker_error(trip) and not is_breaker_error(shed)
+
+    def test_text_classification_matches_exception(self):
+        # log tails classify the same as live exceptions (dual convention)
+        from tpu_matmul_bench.utils.errors import classify
+
+        exc = ConnectionResetError("Connection reset by peer")
+        assert classify(str(exc)) == classify(exc) == "transient"
+
+
+# ---------------------------------------------------------------------------
+# serve circuit breaker: deterministic lifecycle with an injected clock
+
+
+class TestBreaker:
+    def test_open_shed_halfopen_recover(self):
+        from tpu_matmul_bench.obs.registry import get_registry
+        from tpu_matmul_bench.serve.queue import Request
+        from tpu_matmul_bench.serve.scheduler import ContinuousScheduler
+        from tpu_matmul_bench.utils.errors import BreakerOpenError
+
+        def totals():
+            counters = get_registry().snapshot().get("counters", {})
+
+            def total(name):
+                return sum(v for k, v in counters.items()
+                           if k == name or k.startswith(name + "{"))
+
+            return {n: total(f"serve_breaker_{n}_total")
+                    for n in ("opens", "sheds", "recoveries")}
+
+        before = totals()
+        clock = [0.0]
+        sched = ContinuousScheduler(breaker_threshold=3,
+                                    breaker_cooldown_s=5.0,
+                                    clock=lambda: clock[0])
+        bucket = sched.grid.bucket(256, 256, 256)
+
+        # below threshold: stays closed
+        sched.note_result(bucket, "float32", ok=False)
+        sched.note_result(bucket, "float32", ok=False)
+        sched.note_result(bucket, "float32", ok=True)
+        (label, st), = sched.stats()["breakers"].items()
+        assert st["state"] == "closed" and st["opens"] == 0
+        assert st["consecutive_fails"] == 0  # the success reset the streak
+
+        # threshold consecutive failures: opens exactly once
+        for _ in range(3):
+            sched.note_result(bucket, "float32", ok=False)
+        st = sched.stats()["breakers"][label]
+        assert st["state"] == "open" and st["opens"] == 1
+
+        # open breaker sheds at the door with the breaker-specific error
+        with pytest.raises(BreakerOpenError) as exc_info:
+            sched.submit(Request(rid=0, m=256, k=256, n=256,
+                                 dtype="float32"))
+        assert exc_info.value.bucket == label
+        assert sched.stats()["breaker_sheds"] >= 1
+
+        # before the cooldown elapses it still sheds (clock is injected,
+        # so this is deterministic, not sleep-based)
+        clock[0] += 4.9
+        with pytest.raises(BreakerOpenError):
+            sched.submit(Request(rid=1, m=256, k=256, n=256,
+                                 dtype="float32"))
+
+        # cooldown elapsed: half-open admits one probe; its success closes
+        clock[0] += 0.2
+        probe = sched.submit(Request(rid=2, m=256, k=256, n=256,
+                                     dtype="float32"))
+        sched.take_batch()
+        sched.note_result(probe.bucket, "float32", ok=True)
+        assert sched.stats()["breakers"][label]["state"] == "closed"
+
+        after = totals()
+        assert after["opens"] >= before["opens"] + 1
+        assert after["sheds"] >= before["sheds"] + 2
+        assert after["recoveries"] >= before["recoveries"] + 1
+
+    def test_failed_probe_reopens(self):
+        from tpu_matmul_bench.serve.queue import Request
+        from tpu_matmul_bench.serve.scheduler import ContinuousScheduler
+        from tpu_matmul_bench.utils.errors import BreakerOpenError
+
+        clock = [0.0]
+        sched = ContinuousScheduler(breaker_threshold=2,
+                                    breaker_cooldown_s=5.0,
+                                    clock=lambda: clock[0])
+        bucket = sched.grid.bucket(512, 512, 512)
+        for _ in range(2):
+            sched.note_result(bucket, "float32", ok=False)
+        clock[0] += 5.0
+        probe = sched.submit(Request(rid=0, m=512, k=512, n=512,
+                                     dtype="float32"))
+        sched.take_batch()
+        sched.note_result(probe.bucket, "float32", ok=False)
+        (label, st), = sched.stats()["breakers"].items()
+        assert st["state"] == "open" and st["opens"] == 2
+        with pytest.raises(BreakerOpenError):
+            sched.submit(Request(rid=1, m=512, k=512, n=512,
+                                 dtype="float32"))
+
+
+# ---------------------------------------------------------------------------
+# static audits: FAULT-001 / FAULT-002 (seeded fixtures pin the rule IDs)
+
+
+class TestStaticAudit:
+    def test_real_tree_is_clean(self):
+        from tpu_matmul_bench.faults.audit import static_findings
+
+        findings = static_findings()
+        assert not findings, [f"{f.rule} {f.where}" for f in findings]
+
+    def test_seeded_spawn_trips_fault_001(self, tmp_path):
+        from tpu_matmul_bench.faults.audit import static_findings
+
+        # concatenation keeps this test file itself out of any grep-based
+        # audit of call-site spellings
+        (tmp_path / "rogue.py").write_text(
+            "import subprocess\n" + "subprocess" + ".run(['true'])\n")
+        found = static_findings(tmp_path, spawn_allowlist={},
+                                writer_registry={})
+        assert [f.rule for f in found] == ["FAULT-001"]
+        assert found[0].where == "rogue.py:2"
+
+    def test_seeded_fsync_trips_fault_002(self, tmp_path):
+        from tpu_matmul_bench.faults.audit import static_findings
+
+        (tmp_path / "writer.py").write_text(
+            "import os\n" + "os" + ".fsync(3)\n")
+        found = static_findings(tmp_path, spawn_allowlist={},
+                                writer_registry={})
+        assert [f.rule for f in found] == ["FAULT-002"]
+        assert found[0].where == "writer.py:2"
+
+    def test_allowlist_and_registry_silence_findings(self, tmp_path):
+        from tpu_matmul_bench.faults.audit import static_findings
+
+        (tmp_path / "ok.py").write_text(
+            "import os, subprocess\n"
+            + "subprocess" + ".run(['true'])\n"
+            + "os" + ".fsync(3)\n")
+        found = static_findings(
+            tmp_path,
+            spawn_allowlist={"ok.py": "sanctioned for this test"},
+            writer_registry={"ok.py": "certified by this test"})
+        assert not found
+
+    def test_stale_registry_entry_trips_fault_002(self, tmp_path):
+        from tpu_matmul_bench.faults.audit import static_findings
+
+        found = static_findings(tmp_path, spawn_allowlist={},
+                                writer_registry={"ghost.py": "gone"})
+        assert [(f.rule, f.where) for f in found] \
+            == [("FAULT-002", "ghost.py")]
+
+    def test_comments_do_not_trip(self, tmp_path):
+        from tpu_matmul_bench.faults.audit import static_findings
+
+        (tmp_path / "doc.py").write_text(
+            "# " + "subprocess" + ".run(['true']) is forbidden\n"
+            "x = 1  # " + "os" + ".fsync(3)\n")
+        assert not static_findings(tmp_path, spawn_allowlist={},
+                                   writer_registry={})
+
+    def test_lint_route_carries_fault_rules(self):
+        # the `lint` CLI surfaces the same findings via analysis/auditor
+        from tpu_matmul_bench.analysis.auditor import AUDITS
+
+        assert "faults" in AUDITS
+        assert AUDITS["faults"]() == []
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix spec + lint route
+
+
+class TestChaosSpec:
+    def test_shipped_matrix_covers_everything(self):
+        from tpu_matmul_bench.faults.audit import SUBSYSTEMS, load_chaos_spec
+
+        spec = load_chaos_spec(SPEC_PATH)
+        assert {c.fault for c in spec.cells} == set(KINDS)
+        assert {c.subsystem for c in spec.cells} == set(SUBSYSTEMS)
+        for cell in spec.cells:
+            cell.validate()
+
+    def test_shipped_matrix_lints_clean(self):
+        from tpu_matmul_bench.campaign.spec import _parse_toml
+        from tpu_matmul_bench.faults.audit import lint_chaos_data
+
+        data = _parse_toml(SPEC_PATH.read_text())
+        assert lint_chaos_data(data, str(SPEC_PATH)) == []
+
+    @pytest.mark.parametrize("data,rules", [
+        ({"chaos": "not-a-table"}, {"SPEC-001"}),
+        ({"chaos": {"seed": 1}}, {"SPEC-001"}),  # no cells
+        ({"chaos": {"blast": 1, "cell": [
+            {"fault": "kill9", "subsystem": "ledger"}]}}, {"SPEC-002"}),
+        ({"chaos": {"cell": [
+            {"fault": "kill9", "subsystem": "ledger",
+             "radius": 2}]}}, {"SPEC-002"}),
+        ({"chaos": {"cell": [
+            {"fault": "meteor", "subsystem": "ledger"}]}}, {"SPEC-001"}),
+        ({"chaos": {"cell": [
+            {"fault": "kill9", "subsystem": "ledger",
+             "units": 1}]}}, {"SPEC-001"}),
+    ])
+    def test_lint_catches_structural_errors(self, data, rules):
+        from tpu_matmul_bench.faults.audit import lint_chaos_data
+
+        found = lint_chaos_data(data, "<test>")
+        assert {f.rule for f in found} == rules
+
+    def test_cell_validation(self):
+        from tpu_matmul_bench.faults.audit import ChaosCell
+
+        with pytest.raises(FaultPlanError, match="units"):
+            ChaosCell(fault="kill9", subsystem="ledger",
+                      units=1).validate()
+        with pytest.raises(FaultPlanError, match="heartbeat"):
+            ChaosCell(fault="hang", subsystem="campaign",
+                      delay_ms=60000).validate()
+        # the subsystem's workload span is the default injection phase
+        cell = ChaosCell(fault="kill9", subsystem="tune", occurrence=2)
+        assert cell.fault_spec() == FaultSpec(kind="kill9", phase="w:cell",
+                                              occurrence=2)
+
+
+# ---------------------------------------------------------------------------
+# serve_batch stream contract
+
+
+class TestServeBatchRecord:
+    def _valid(self):
+        return {"record_type": "serve_batch", "seq": 1,
+                "bucket": "256x256x256/float32", "n": 4, "failed": 0,
+                "batch_ms": 1.25}
+
+    def test_valid_record_passes(self):
+        from tpu_matmul_bench.serve.service import validate_serve_batch_record
+
+        assert validate_serve_batch_record(self._valid()) == []
+
+    @pytest.mark.parametrize("mutate", [
+        lambda d: d.update(record_type="manifest"),
+        lambda d: d.pop("seq"),
+        lambda d: d.update(seq=0),
+        lambda d: d.update(n="four"),
+        lambda d: d.update(failed=9),
+        lambda d: d.update(batch_ms=True),
+    ])
+    def test_broken_records_fail(self, mutate):
+        from tpu_matmul_bench.serve.service import validate_serve_batch_record
+
+        d = self._valid()
+        mutate(d)
+        assert validate_serve_batch_record(d)
+
+
+# ---------------------------------------------------------------------------
+# torn-line fuzz (satellite): every durable JSONL artifact, truncated AND
+# garbled at every byte offset of its last record, must stay readable by
+# the repo's own reader — all complete records recovered, nothing raised.
+
+
+def _build_journal(tmp_path):
+    from tpu_matmul_bench.campaign.state import JOURNAL_NAME, Journal
+
+    with Journal(tmp_path / JOURNAL_NAME) as j:
+        j.record("fp-aaaa", "job-a", "running", attempt=1)
+        j.record("fp-aaaa", "job-a", "done", rc=0)
+        j.record("fp-bbbb", "job-b", "running", attempt=1,
+                 detail="second attempt after transport drop")
+
+    def count(path):
+        from tpu_matmul_bench.campaign.state import load_events
+
+        return len(load_events(path.parent))
+
+    return tmp_path / JOURNAL_NAME, count
+
+
+def _build_tune_db(tmp_path):
+    from tpu_matmul_bench.faults.workloads import run_tune
+
+    path = tmp_path / "tune_db.jsonl"
+    run_tune(str(path), cells=3)
+
+    def count(p):
+        from tpu_matmul_bench.tune.db import TuningDB
+
+        return TuningDB.load(str(p)).records_read
+
+    return path, count
+
+
+def _build_obs(tmp_path):
+    from tpu_matmul_bench.faults.workloads import run_obs
+    from tpu_matmul_bench.obs.export import SNAPSHOT_NAME
+
+    run_obs(str(tmp_path), snapshots=3)
+
+    def count(p):
+        from tpu_matmul_bench.obs.export import read_snapshots
+
+        return len(read_snapshots(p))
+
+    return tmp_path / SNAPSHOT_NAME, count
+
+
+def _build_ledger(tmp_path):
+    from tpu_matmul_bench.faults.workloads import ledger_have, run_ledger
+
+    path = tmp_path / "ledger.jsonl"
+    run_ledger(str(path), records=3)
+    return path, lambda p: len(ledger_have(p))
+
+
+_ARTIFACTS = {
+    "campaign_journal": _build_journal,
+    "tune_db": _build_tune_db,
+    "obs_snapshots": _build_obs,
+    "faults_ledger": _build_ledger,
+}
+
+
+class TestTornLineFuzz:
+    @pytest.fixture(params=sorted(_ARTIFACTS))
+    def artifact(self, request, tmp_path):
+        path, count = _ARTIFACTS[request.param](tmp_path)
+        data = path.read_bytes()
+        assert data.endswith(b"\n"), "artifact must end on a record boundary"
+        last_start = data[:-1].rfind(b"\n") + 1
+        baseline = count(path)
+        assert baseline == 3
+        return path, count, data, last_start, baseline
+
+    def test_truncation_at_every_offset(self, artifact):
+        path, count, data, last_start, baseline = artifact
+        # every cut strictly inside the last record (from "record gone"
+        # through "one byte short of its newline") leaves exactly the
+        # complete records readable — never an exception, never a
+        # phantom record
+        for cut in range(last_start, len(data) - 1):
+            path.write_bytes(data[:cut])
+            assert count(path) == baseline - 1, f"cut at byte {cut}"
+        path.write_bytes(data)
+        assert count(path) == baseline
+
+    def test_garbled_byte_at_every_offset(self, artifact):
+        path, count, data, last_start, baseline = artifact
+        # flipping any single byte of the last record to NUL makes that
+        # line unparseable; readers must skip it, not raise
+        for pos in range(last_start, len(data) - 1):
+            garbled = bytearray(data)
+            garbled[pos] = 0
+            path.write_bytes(bytes(garbled))
+            assert count(path) == baseline - 1, f"garbled byte {pos}"
+
+    def test_repair_then_append_never_splices(self, artifact):
+        from tpu_matmul_bench.utils.durable import repair_torn_tail
+
+        path, count, data, last_start, baseline = artifact
+        # tear mid-record, repair, and the file ends on a record boundary
+        # again with only complete lines — the precondition every
+        # appender in the repo re-establishes before writing
+        cut = last_start + max(1, (len(data) - 1 - last_start) // 2)
+        path.write_bytes(data[:cut])
+        assert repair_torn_tail(path)
+        repaired = path.read_bytes()
+        assert repaired == data[:last_start]
+        assert count(path) == baseline - 1
+        for line in repaired.decode().splitlines():
+            json.loads(line)
+        # repairing a clean file is a no-op
+        path.write_bytes(data)
+        assert not repair_torn_tail(path)
+        assert path.read_bytes() == data
+
+
+class TestResumeConvergence:
+    def test_journal_append_after_tear(self, tmp_path):
+        from tpu_matmul_bench.campaign.state import (
+            Journal,
+            latest_status,
+            load_events,
+        )
+
+        path, _count = _build_journal(tmp_path)
+        tear_file(path)
+        # Journal.__init__ repairs the torn tail before appending, so
+        # the new event lands on a record boundary
+        with Journal(path) as j:
+            j.record("fp-bbbb", "job-b", "done", rc=0)
+        events = load_events(tmp_path)
+        for line in path.read_text().splitlines():
+            json.loads(line)
+        assert latest_status(events)["fp-bbbb"].status == "done"
+
+    def test_tune_put_after_tear(self, tmp_path):
+        from tpu_matmul_bench.faults.workloads import run_tune
+        from tpu_matmul_bench.tune.db import TuningDB
+
+        path, _count = _build_tune_db(tmp_path)
+        tear_file(path)
+        run_tune(str(path), cells=3)  # resume rewrites the torn unit
+        db = TuningDB.load(str(path))
+        assert db.parse_errors == []
+        assert db.records_read == 3
+
+    def test_ledger_resume_matches_clean(self, tmp_path):
+        from tpu_matmul_bench.faults.audit import _ledger_state
+        from tpu_matmul_bench.faults.workloads import run_ledger
+
+        clean = tmp_path / "clean.jsonl"
+        torn = tmp_path / "torn.jsonl"
+        run_ledger(str(clean), records=3)
+        run_ledger(str(torn), records=2)
+        tear_file(torn)
+        run_ledger(str(torn), records=3)
+        cp: list[str] = []
+        tp: list[str] = []
+        assert _ledger_state(clean, 3, cp) == _ledger_state(torn, 3, tp)
+        assert cp == [] and tp == []
+
+    def test_obs_resume_continues_seq(self, tmp_path):
+        from tpu_matmul_bench.faults.workloads import obs_progress, run_obs
+        from tpu_matmul_bench.obs.export import SNAPSHOT_NAME
+
+        run_obs(str(tmp_path), snapshots=2)
+        tear_file(tmp_path / SNAPSHOT_NAME)
+        run_obs(str(tmp_path), snapshots=3)
+        last_seq, values = obs_progress(tmp_path)
+        assert values == {1, 2, 3}

@@ -13,7 +13,14 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 from envutil import scrubbed_env
+
+# every test here spawns a real 2-process jax.distributed cluster; on
+# jaxlib builds that can't form one on CPU the conftest probe skips the
+# whole module instead of failing it (see conftest.pytest_runtest_setup)
+pytestmark = pytest.mark.requires_multihost
 
 WORKER = Path(__file__).parent / "multihost_worker.py"
 

@@ -332,11 +332,16 @@ def _run_serve(args, timeout=300):
 
 
 def _ledger(path):
+    # measurement view: manifest header + BenchmarkRecord lines; the
+    # streamed per-batch serve_batch progress lines are a liveness
+    # channel, not measurements (validated in test_faults.py)
     manifests, records = [], []
     for line in Path(path).read_text().splitlines():
         d = json.loads(line)
-        (manifests if d.get("record_type") == "manifest"
-         else records).append(d)
+        if d.get("record_type") == "manifest":
+            manifests.append(d)
+        elif "benchmark" in d:
+            records.append(d)
     return manifests, records
 
 

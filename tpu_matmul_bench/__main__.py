@@ -42,6 +42,11 @@ _PROGRAMS = {
     # resumable execution and a regression gate (campaign/cli.py). Not a
     # benchmark itself — campaign specs name the other programs as jobs.
     "campaign": "tpu_matmul_bench.campaign.cli",
+    # fault injection + crash-consistency certification: resumable chaos
+    # workloads (`faults run`), the chaos-matrix certifier (`faults
+    # audit`, specs/chaos.toml), and the in-process selftest CI runs
+    # (faults/cli.py). Campaign specs may name `faults` as a job program.
+    "faults": "tpu_matmul_bench.faults.cli",
 }
 
 

@@ -830,6 +830,15 @@ def audit_obs() -> list[Finding]:
     return findings
 
 
+def audit_faults() -> list[Finding]:
+    """FAULT-001/002: every subprocess spawn supervised, every durable
+    fsync writer registered with the crash-consistency certifier
+    (faults/audit.py owns the scan; this is the lint wiring)."""
+    from tpu_matmul_bench.faults.audit import static_findings
+
+    return static_findings()
+
+
 AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "modes": audit_modes,
     "impls": audit_impls,
@@ -842,6 +851,7 @@ AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "sched": _audit_sched,
     "memory": _audit_memory,
     "fingerprint": _audit_fingerprint,
+    "faults": audit_faults,
 }
 
 #: groups that compile optimized HLO (slower than trace-only audits);

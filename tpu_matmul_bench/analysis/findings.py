@@ -136,6 +136,15 @@ RULES: dict[str, tuple[Severity, str]] = {
                          "snapshot, or its snapshot counters do not "
                          "reconcile with the ledger's extras — the obs bus "
                          "and the ledger disagree about what happened"),
+    "FAULT-001": ("error", "subprocess spawn site not routed through "
+                           "faults/supervisor.supervised_run (and not on "
+                           "its allowlist) — the child escapes the "
+                           "heartbeat watchdog and signal-escalation "
+                           "ladder"),
+    "FAULT-002": ("error", "durable JSONL writer (fsync site) not "
+                           "registered in faults/audit.WRITER_REGISTRY — "
+                           "crash-consistency certification does not know "
+                           "this artifact exists"),
 }
 
 

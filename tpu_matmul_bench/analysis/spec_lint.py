@@ -20,10 +20,13 @@ from tpu_matmul_bench.analysis.findings import Finding
 # key vocabulary per spec table, mirroring what campaign/spec.py actually
 # reads — anything else is dead weight the executor will never see
 _CAMPAIGN_KEYS = {"name"}
-_DEFAULTS_KEYS = {"flags", "timeout_s", "retries", "backoff_s"}
-_JOB_KEYS = {"id", "program", "flags", "timeout_s", "retries", "backoff_s"}
+_DEFAULTS_KEYS = {"flags", "timeout_s", "retries", "backoff_s",
+                  "heartbeat_s"}
+_JOB_KEYS = {"id", "program", "flags", "timeout_s", "retries", "backoff_s",
+             "heartbeat_s"}
 _SWEEP_KEYS = {"id_prefix", "program", "flags", "timeout_s", "retries",
-               "backoff_s", "sizes", "modes", "dtypes", "num_devices"}
+               "backoff_s", "heartbeat_s", "sizes", "modes", "dtypes",
+               "num_devices"}
 
 # modes whose program shards the [size, size] problem over the device
 # axis and therefore needs size % num_devices == 0
@@ -384,6 +387,14 @@ def lint_spec_file(path: str | Path) -> list[Finding]:
     # not a campaign spec at all — lint the tenant blocks and stop
     if set(data) == {"tenants"}:
         return _lint_tenants_data(data, where)
+
+    # a chaos matrix (root is exactly [chaos]): the fault-injection
+    # audit's spec, not a campaign — validate its cells and stop before
+    # SPEC-001/002 fire on a vocabulary it never claimed to speak
+    if set(data) == {"chaos"}:
+        from tpu_matmul_bench.faults.audit import lint_chaos_data
+
+        return lint_chaos_data(data, where)
 
     findings = _unknown_key_findings(data, where)
 

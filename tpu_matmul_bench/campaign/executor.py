@@ -22,8 +22,6 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import shlex
-import subprocess
 import sys
 import time
 from pathlib import Path
@@ -31,9 +29,16 @@ from typing import Any, Callable, Mapping
 
 from tpu_matmul_bench.campaign import state
 from tpu_matmul_bench.campaign.spec import CampaignSpec, Job
+from tpu_matmul_bench.faults.retry import (  # noqa: F401  (re-exports)
+    BACKOFF_CAP_S,
+    TRANSPORT_MIN_BACKOFF_S,
+    RetryPolicy,
+)
+from tpu_matmul_bench.faults.supervisor import LaunchResult, supervised_run
 from tpu_matmul_bench.obs import context as obs_context
 from tpu_matmul_bench.obs.registry import get_registry
 from tpu_matmul_bench.utils import telemetry
+from tpu_matmul_bench.utils import errors as _errors
 from tpu_matmul_bench.utils.errors import is_transport_message
 
 JOBS_SUBDIR = "jobs"
@@ -41,24 +46,8 @@ SPEC_COPY_NAME = "spec.json"
 OBS_SUBDIR = "obs"
 MERGED_TRACE_NAME = "trace.json"
 
-# backoff grows base * 2^(attempt-1), capped — a transport-dead tunnel
-# needs minutes, not unbounded hours (measure_r5.sh used 180 s..900 s)
-BACKOFF_CAP_S = 900.0
-# transport failures get at least the r5 watcher's short backoff: the
-# tunnel that dropped the TCP pair is still dropping it one second later
-TRANSPORT_MIN_BACKOFF_S = 60.0
-
 # how many trailing log bytes the failure classifier reads
 _LOG_TAIL_BYTES = 64 * 1024
-
-
-@dataclasses.dataclass
-class LaunchResult:
-    """What one attempt of one job produced."""
-
-    rc: int | None  # None = killed on timeout
-    timed_out: bool = False
-    error: str = ""  # launcher-level failure (spawn error), not job output
 
 
 @dataclasses.dataclass
@@ -93,22 +82,15 @@ def job_command(job: Job, campaign_dir: str | Path,
 
 
 def _default_launch(cmd: list[str], *, log: Path, timeout_s: float,
-                    env: Mapping[str, str] | None) -> LaunchResult:
-    with open(log, "a") as fh:
-        fh.write(f"+ {shlex.join(cmd)}\n")
-        fh.flush()
-        try:
-            proc = subprocess.run(
-                cmd, stdout=fh, stderr=subprocess.STDOUT,
-                timeout=timeout_s or None,
-                env=dict(env) if env is not None else None)
-        except subprocess.TimeoutExpired:
-            fh.write(f"\n[campaign] TIMEOUT after {timeout_s:.0f}s "
-                     "(child killed)\n")
-            return LaunchResult(rc=None, timed_out=True)
-        except OSError as e:
-            return LaunchResult(rc=None, error=f"spawn failed: {e}")
-    return LaunchResult(rc=proc.returncode)
+                    env: Mapping[str, str] | None,
+                    heartbeat_timeout_s: float | None = None) -> LaunchResult:
+    """Production launch: the supervisor owns the child — deadline AND
+    heartbeat-stall escalation (SIGTERM, grace, SIGKILL to the process
+    group), with the ladder recorded in the job log (DESIGN §17)."""
+    return supervised_run(
+        cmd, log_path=log, timeout_s=timeout_s or None,
+        env=dict(env) if env is not None else None,
+        heartbeat_timeout_s=heartbeat_timeout_s)
 
 
 def ledger_measurement_count(ledger: Path) -> int:
@@ -140,16 +122,19 @@ def _classify_failure(result: LaunchResult, log: Path) -> str:
             tail = fh.read().decode(errors="replace")
     except OSError:
         tail = ""
-    return "transport" if is_transport_message(tail) else "error"
+    if is_transport_message(tail):
+        return "transport"
+    # non-transport transients (OOM, ENOSPC, injected chaos) retry on
+    # the plain exponential — no re-rendezvous floor
+    return "transient" if _errors.classify(tail) == _errors.TRANSIENT \
+        else "error"
 
 
 def backoff_delay(job: Job, attempt: int, kind: str) -> float:
     """Exponential backoff before attempt N+1: base · 2^(N−1), capped;
-    transport failures take at least the watcher's short backoff."""
-    delay = min(job.backoff_s * (2.0 ** (attempt - 1)), BACKOFF_CAP_S)
-    if kind == "transport":
-        delay = max(delay, TRANSPORT_MIN_BACKOFF_S)
-    return delay
+    transport failures take at least the watcher's short backoff. The
+    schedule itself lives in faults/retry.py (the unified policy)."""
+    return RetryPolicy(base_s=job.backoff_s).delay(attempt, kind)
 
 
 def _campaign_env(env: Mapping[str, str] | None) -> dict[str, str] | None:
@@ -293,7 +278,13 @@ def _run_one(job: Job, d: Path, ledger: Path, log: Path,
             # attempt may have left a partial file a later VALID attempt
             # would sit after — unlink so the ledger is one run's output
             ledger.unlink(missing_ok=True)
-            result = launch(cmd, log=log, timeout_s=job.timeout_s, env=env)
+            # the heartbeat kwarg rides only when the job opts in, so
+            # injected test launchers keep the historical 4-arg protocol
+            extra = {}
+            if getattr(job, "heartbeat_s", 0):
+                extra["heartbeat_timeout_s"] = job.heartbeat_s
+            result = launch(cmd, log=log, timeout_s=job.timeout_s, env=env,
+                            **extra)
         if result.rc == 0:
             n = ledger_measurement_count(ledger)
             if n > 0:

@@ -94,6 +94,11 @@ class Job:
     timeout_s: float = _DEFAULT_TIMEOUT_S
     retries: int = _DEFAULT_RETRIES
     backoff_s: float = _DEFAULT_BACKOFF_S
+    # liveness deadline: supervisor kills a child whose heartbeat file
+    # (touched at every telemetry span) goes stale this long. 0 = off —
+    # a hung collective then only dies at timeout_s. Execution policy,
+    # outside the fingerprint like the rest.
+    heartbeat_s: float = 0.0
 
     @property
     def fingerprint(self) -> str:
@@ -107,6 +112,7 @@ class Job:
             "timeout_s": self.timeout_s,
             "retries": self.retries,
             "backoff_s": self.backoff_s,
+            "heartbeat_s": self.heartbeat_s,
         }
 
 
@@ -192,6 +198,7 @@ def _job_policy(entry: dict[str, Any], defaults: dict[str, Any],
         "timeout_s": num("timeout_s", _DEFAULT_TIMEOUT_S),
         "retries": num("retries", _DEFAULT_RETRIES, cast=int),
         "backoff_s": num("backoff_s", _DEFAULT_BACKOFF_S),
+        "heartbeat_s": num("heartbeat_s", 0.0),
     }
 
 

@@ -24,6 +24,8 @@ import time
 from pathlib import Path
 from typing import Any, IO
 
+from tpu_matmul_bench.utils.durable import repair_torn_tail
+
 JOURNAL_NAME = "journal.jsonl"
 
 PENDING = "pending"
@@ -64,6 +66,10 @@ class Journal:
 
     def __init__(self, path: str | Path):
         self.path = Path(path)
+        # a crash mid-append can leave a torn (newline-less) final line;
+        # appending after it would splice the next event onto the torn
+        # half-record — truncate back to the last complete line first
+        repair_torn_tail(self.path)
         self._fh: IO[str] = open(self.path, "a")
 
     def record(self, fingerprint: str, job_id: str, status: str, *,

@@ -1,0 +1,40 @@
+"""Deterministic fault injection, supervised execution, and
+crash-consistency certification.
+
+The repo's durability story — fsync-per-line journals, SIGKILL-surviving
+span flush, torn-line-tolerant JSONL stores — is asserted by one-off
+tests. This package attacks it systematically:
+
+- `plan.py` — seeded fault schedules (`kill9`, `hang`, `torn-write`,
+  `transient-exc`, `disk-full`) injected at telemetry span boundaries
+  via the `TPU_BENCH_FAULT_PLAN` env var, so injection points are
+  exactly the phase boundaries the obs bus already instruments.
+- `retry.py` — the unified retry-budget/backoff policy (jittered
+  exponential with a transport floor), extracted from
+  `campaign/executor.py`.
+- `supervisor.py` — heartbeat-file watchdog for child processes with
+  deadline escalation (SIGTERM, grace, SIGKILL); the single sanctioned
+  subprocess spawn path (lint FAULT-001).
+- `audit.py` — the crash-consistency certifier: each fault class runs
+  fault-free and faulted-then-resumed, and the durable artifacts must
+  converge to semantically identical final state; plus the FAULT-001/002
+  static audits and the durable-writer registry (lint FAULT-002).
+- `workloads.py` / `cli.py` — resumable micro-workloads per subsystem
+  and the `python -m tpu_matmul_bench faults {run,audit,selftest}`
+  entrypoints, driven by the committed chaos matrix `specs/chaos.toml`.
+"""
+
+from tpu_matmul_bench.faults.plan import (  # noqa: F401
+    FAULT_PLAN_ENV,
+    FAULT_SCOPE_ENV,
+    HEARTBEAT_ENV,
+    FaultPlan,
+    FaultPlanError,
+    FaultSpec,
+    parse_plan,
+)
+from tpu_matmul_bench.faults.retry import RetryBudget, RetryPolicy  # noqa: F401
+from tpu_matmul_bench.faults.supervisor import (  # noqa: F401
+    LaunchResult,
+    supervised_run,
+)

@@ -31,6 +31,7 @@ from typing import Any
 
 from tpu_matmul_bench.obs import context as obs_context
 from tpu_matmul_bench.obs.registry import MetricsRegistry, get_registry
+from tpu_matmul_bench.utils.durable import repair_torn_tail
 
 SNAPSHOT_NAME = "obs_snapshot.jsonl"
 PROM_NAME = "metrics.prom"
@@ -103,14 +104,18 @@ class SnapshotExporter:
     def __init__(self, out_dir: str | Path, *,
                  registry: MetricsRegistry | None = None,
                  interval_s: float = DEFAULT_INTERVAL_S,
-                 run_id: str | None = None) -> None:
+                 run_id: str | None = None,
+                 seq_start: int = 0) -> None:
         self.out_dir = Path(out_dir)
         self.snapshot_path = self.out_dir / SNAPSHOT_NAME
         self.prom_path = self.out_dir / PROM_NAME
         self._registry = registry
         self._interval_s = max(float(interval_s), 0.01)
         self._run_id = run_id
-        self._seq = 0
+        # seq_start lets a resumed process continue an existing snapshot
+        # file with monotonic seq numbers (faults/workloads.py) instead
+        # of restarting at 1
+        self._seq = int(seq_start)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -125,6 +130,7 @@ class SnapshotExporter:
         self._seq += 1
         snap = snapshot_record(self._registry, run_id=self._run_id,
                                seq=self._seq)
+        repair_torn_tail(self.snapshot_path)
         with open(self.snapshot_path, "a") as fh:
             fh.write(json.dumps(snap, sort_keys=True) + "\n")
             fh.flush()

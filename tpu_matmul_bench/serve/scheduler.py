@@ -56,9 +56,18 @@ from tpu_matmul_bench.serve.queue import (
     ShapeGrid,
 )
 from tpu_matmul_bench.serve.tenants import DEFAULT_TENANTS, TenantSpec
-from tpu_matmul_bench.utils.errors import QueueOverflowError
+from tpu_matmul_bench.utils.errors import BreakerOpenError, QueueOverflowError
 
 DEFAULT_STARVATION_MS = 100.0
+
+# Circuit breaker policy (DESIGN §17): a bucket whose dispatches fail
+# this many times in a row stops admitting new work for the cooldown,
+# then lets exactly one probe through (half-open); the probe's outcome
+# closes or re-opens it. Failures here are *executable* failures — a
+# poisoned compile cache entry, a wedged device — where re-admitting
+# traffic just converts queue capacity into guaranteed errors.
+DEFAULT_BREAKER_THRESHOLD = 3
+DEFAULT_BREAKER_COOLDOWN_S = 5.0
 
 # EWMA smoothing for the per-request service-time estimate that prices
 # SLO shedding; one batch's jitter shouldn't whipsaw admission decisions
@@ -83,6 +92,26 @@ def _padded_flops(req: Request) -> float:
     return 2.0 * bm * bk * bn
 
 
+def _bucket_label(bucket, dtype: str) -> str:
+    m, k, n = bucket
+    return f"{m}x{k}x{n}/{dtype}"
+
+
+class _Breaker:
+    """Per-(bucket, dtype) circuit state. closed → open after N
+    consecutive failures; open → half-open after the cooldown; the
+    single half-open probe closes (success) or re-opens (failure) it."""
+
+    __slots__ = ("state", "fails", "opened_at", "probing", "opens")
+
+    def __init__(self) -> None:
+        self.state = "closed"
+        self.fails = 0
+        self.opened_at = 0.0
+        self.probing = False
+        self.opens = 0
+
+
 class ContinuousScheduler:
     """Weighted-fair, priority-classed, continuously-batching admission.
 
@@ -100,11 +129,18 @@ class ContinuousScheduler:
         max_depth: int = DEFAULT_MAX_DEPTH,
         max_batch: int = DEFAULT_MAX_BATCH,
         starvation_ms: float = DEFAULT_STARVATION_MS,
+        breaker_threshold: int = DEFAULT_BREAKER_THRESHOLD,
+        breaker_cooldown_s: float = DEFAULT_BREAKER_COOLDOWN_S,
+        clock=time.monotonic,
     ) -> None:
         if max_depth < 1 or max_batch < 1 or starvation_ms <= 0:
             raise ValueError(
                 f"bad scheduler policy: depth={max_depth} "
                 f"batch={max_batch} starvation={starvation_ms}")
+        if breaker_threshold < 1 or breaker_cooldown_s <= 0:
+            raise ValueError(
+                f"bad breaker policy: threshold={breaker_threshold} "
+                f"cooldown={breaker_cooldown_s}")
         if not tenants:
             raise ValueError("scheduler needs at least one tenant")
         self.grid = grid or ShapeGrid()
@@ -139,6 +175,19 @@ class ContinuousScheduler:
         self._m_tenant_shed = {
             tid: reg.counter("serve_tenant_shed_total", tenant=tid)
             for tid in self._tenants}
+        # circuit breakers: per-(bucket, dtype) failure gates fed by the
+        # worker's note_result; sheds carry the distinct breaker_open
+        # reason on the obs bus (ISSUE 11 / DESIGN §17)
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self._clock = clock  # injectable for deterministic tests
+        self._breakers: dict[tuple, _Breaker] = {}
+        self._m_breaker_opened = reg.counter("serve_breaker_opens_total")
+        self._m_breaker_shed = reg.counter(
+            "serve_breaker_sheds_total", reason="breaker_open")
+        self._m_breaker_recovered = reg.counter(
+            "serve_breaker_recoveries_total")
+        self._m_breaker_open_gauge = reg.gauge("serve_breaker_open_buckets")
 
     # -- compat view (AdmissionQueue contract)
     @property
@@ -211,6 +260,23 @@ class ContinuousScheduler:
         with self._cond:
             if self._closed:
                 raise RuntimeError("scheduler is closed to new submissions")
+            # circuit breaker: a tripped bucket sheds at the door with
+            # its own reason — except the single half-open probe, which
+            # is admitted to test whether the bucket recovered
+            br = self._breakers.get((req.bucket, req.dtype))
+            if br is not None and br.state != "closed":
+                now = self._clock()
+                if br.state == "open" \
+                        and now - br.opened_at >= self.breaker_cooldown_s:
+                    br.state = "half-open"
+                if br.state == "half-open" and not br.probing:
+                    br.probing = True  # this request is the probe
+                else:
+                    self._shed_locked(state, self._m_breaker_shed)
+                    self._rejected += 1
+                    raise BreakerOpenError(
+                        self._depth, self.max_depth,
+                        bucket=_bucket_label(req.bucket, req.dtype))
             # SLO shedding: this tenant's own backlog already implies a
             # wait past its p99 budget — admitting more of its traffic
             # manufactures SLO misses. Other tenants are untouched.
@@ -343,6 +409,46 @@ class ContinuousScheduler:
                     r.dispatched_at = dispatch
                 return batch
 
+    def _open_breakers_locked(self) -> int:
+        return sum(1 for b in self._breakers.values()
+                   if b.state != "closed")
+
+    def note_result(self, bucket, dtype: str, ok: bool) -> None:
+        """Worker feedback per dispatched request: success closes (and
+        counts a recovery for a half-open probe); failure counts toward
+        the consecutive-failure threshold, trips the breaker at N, and
+        re-opens a half-open bucket whose probe failed."""
+        key = (tuple(bucket), dtype)
+        with self._cond:
+            br = self._breakers.get(key)
+            if ok:
+                if br is None:
+                    return
+                if br.state != "closed":
+                    self._m_breaker_recovered.inc()
+                br.state = "closed"
+                br.fails = 0
+                br.probing = False
+            else:
+                if br is None:
+                    br = self._breakers[key] = _Breaker()
+                br.fails += 1
+                now = self._clock()
+                if br.state == "half-open":
+                    # the probe failed: re-open, restart the cooldown
+                    br.state = "open"
+                    br.opened_at = now
+                    br.probing = False
+                    br.opens += 1
+                    self._m_breaker_opened.inc()
+                elif br.state == "closed" \
+                        and br.fails >= self.breaker_threshold:
+                    br.state = "open"
+                    br.opened_at = now
+                    br.opens += 1
+                    self._m_breaker_opened.inc()
+            self._m_breaker_open_gauge.set(self._open_breakers_locked())
+
     def note_service(self, service_s: float, n_requests: int) -> None:
         """Worker feedback: measured service time for `n_requests`, EWMA'd
         into the per-request estimate that prices SLO shedding."""
@@ -368,10 +474,20 @@ class ContinuousScheduler:
 
     def stats(self) -> dict[str, Any]:
         with self._cond:
+            breakers = {
+                _bucket_label(bucket, dtype): {
+                    "state": br.state,
+                    "consecutive_fails": br.fails,
+                    "opens": br.opens,
+                }
+                for (bucket, dtype), br in sorted(self._breakers.items())
+            }
             return {
                 "scheduler": "continuous",
                 "submitted": self.submitted,
                 "shed": self.shed,
+                "breaker_sheds": int(self._m_breaker_shed.value),
+                "breakers": breakers,
                 "max_depth": self.max_depth,
                 "max_batch": self.max_batch,
                 "starvation_ms": round(self.starvation_s * 1e3, 3),

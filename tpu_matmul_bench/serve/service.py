@@ -58,7 +58,7 @@ from tpu_matmul_bench.serve.tenants import (
     parse_tenants_arg,
 )
 from tpu_matmul_bench.utils import telemetry
-from tpu_matmul_bench.utils.errors import QueueOverflowError
+from tpu_matmul_bench.utils.errors import QueueOverflowError, classify
 from tpu_matmul_bench.utils.reporting import (
     BenchmarkRecord,
     JsonWriter,
@@ -66,6 +66,12 @@ from tpu_matmul_bench.utils.reporting import (
     report,
 )
 from tpu_matmul_bench.utils.timing import sample_stats, sync
+
+# per-batch progress lines streamed into the ledger while the run is
+# live: a SIGKILL mid-serve leaves a manifest + complete serve_batch
+# lines (each fsynced), so the partial ledger is schema-valid evidence
+# instead of a truncated buffer. Measurement readers skip the type.
+SERVE_BATCH_RECORD_TYPE = "serve_batch"
 
 # within-run p99 stability estimate (first-half vs second-half p99) is
 # capped before it widens the gate: a short window's halves can differ
@@ -179,17 +185,24 @@ def _worker_drain(
     impl: str,
     mesh_shape: tuple[int, ...],
     on_complete=None,
+    stream: JsonWriter | None = None,
 ) -> None:
     """Drain the queue to exhaustion (producer closes it). Runs on the
     main thread — the only JAX-touching thread in the harness."""
     reg = get_registry()
     m_requests = reg.counter("serve_requests_total")
+    m_failures = reg.counter("serve_request_failures_total")
     latency_hists: dict[str, Any] = {}
     wait_hists: dict[str, Any] = {}
     # continuous scheduler only: measured service time feeds its EWMA
     # estimate that prices per-tenant SLO shedding
     note_service = getattr(q, "note_service", None)
+    # fixed queue predates breakers; only schedulers that grow
+    # note_result get failure feedback (and hence circuit breaking)
+    note_result = getattr(q, "note_result", None)
+    batch_seq = 0
     while (batch := q.take_batch()) is not None:
+        batch_seq += 1
         m, k, n = batch[0].bucket
         key = ExecKey(m=m, k=k, n=n, dtype=batch[0].dtype, impl=impl,
                       mesh_shape=mesh_shape)
@@ -200,34 +213,66 @@ def _worker_drain(
             hist = latency_hists[key.label] = reg.histogram(
                 "serve_latency_ms", bucket=key.label)
         batch_t0 = time.perf_counter()
-        for req in batch:
-            t0 = time.perf_counter()
-            # per-request get: the batch's first miss pays the cold
-            # compile inside its own latency; the rest are counted hits
-            # (hit rate is then a per-request service property, not an
-            # artifact of how requests happened to batch)
-            entry = cache.get(key)
-            out = entry.compiled(a, b)
-            sync(out)
-            done = time.perf_counter()
-            wait_s = max(req.dispatched_at - req.submitted_at, 0.0)
-            samples.append(Sample(
-                rid=req.rid, bucket=key.label,
-                latency_s=done - req.submitted_at,
-                service_s=done - t0,
-                cold=not was_cached,
-                tenant=req.tenant,
-                wait_s=wait_s))
-            m_requests.inc()
-            hist.observe((done - req.submitted_at) * 1e3)
-            whist = wait_hists.get(req.tenant)
-            if whist is None:
-                whist = wait_hists[req.tenant] = reg.histogram(
-                    "serve_wait_ms", tenant=req.tenant)
-            whist.observe(wait_s * 1e3)
-            was_cached = True  # only the batch's first request was cold
-            if on_complete is not None:
-                on_complete(req)
+        failed = 0
+        with telemetry.span("serve:batch", seq=batch_seq,
+                            bucket=key.label, n=len(batch)):
+            for req in batch:
+                t0 = time.perf_counter()
+                try:
+                    # per-request get: the batch's first miss pays the
+                    # cold compile inside its own latency; the rest are
+                    # counted hits (hit rate is then a per-request
+                    # service property, not an artifact of how requests
+                    # happened to batch)
+                    entry = cache.get(key)
+                    out = entry.compiled(a, b)
+                    sync(out)
+                except Exception as e:  # noqa: BLE001 — fault boundary
+                    # a failed request must not take the worker down:
+                    # count it, feed the breaker, release the client
+                    # slot, and keep draining (the breaker — not this
+                    # loop — decides when a bucket stops admitting)
+                    failed += 1
+                    m_failures.inc()
+                    if note_result is not None:
+                        note_result(req.bucket, req.dtype, ok=False)
+                    report(f"serve: request {req.rid} ({key.label}) "
+                           f"failed [{classify(e)}]: {e}",
+                           file=sys.stderr)
+                    if on_complete is not None:
+                        on_complete(req)
+                    continue
+                done = time.perf_counter()
+                wait_s = max(req.dispatched_at - req.submitted_at, 0.0)
+                samples.append(Sample(
+                    rid=req.rid, bucket=key.label,
+                    latency_s=done - req.submitted_at,
+                    service_s=done - t0,
+                    cold=not was_cached,
+                    tenant=req.tenant,
+                    wait_s=wait_s))
+                m_requests.inc()
+                if note_result is not None:
+                    note_result(req.bucket, req.dtype, ok=True)
+                hist.observe((done - req.submitted_at) * 1e3)
+                whist = wait_hists.get(req.tenant)
+                if whist is None:
+                    whist = wait_hists[req.tenant] = reg.histogram(
+                        "serve_wait_ms", tenant=req.tenant)
+                whist.observe(wait_s * 1e3)
+                was_cached = True  # only batch's first request was cold
+                if on_complete is not None:
+                    on_complete(req)
+        if stream is not None:
+            stream.write_raw({
+                "record_type": SERVE_BATCH_RECORD_TYPE,
+                "seq": batch_seq,
+                "bucket": key.label,
+                "n": len(batch),
+                "failed": failed,
+                "batch_ms": round(
+                    (time.perf_counter() - batch_t0) * 1e3, 3),
+            })
         if note_service is not None:
             note_service(time.perf_counter() - batch_t0, len(batch))
 
@@ -619,6 +664,7 @@ def _run_load(
     q,
     tenants: Sequence[TenantSpec],
     world: int,
+    stream: JsonWriter | None = None,
 ) -> tuple[list[Sample], float, dict[int, tuple[int, int, int]]]:
     """One producer+worker load run against an already-built admission
     path: (samples, wall_s, rid → requested shape)."""
@@ -642,7 +688,8 @@ def _run_load(
             producer.start()
             _worker_drain(q, cache, pool, samples,
                           impl=config.matmul_impl, mesh_shape=(world,),
-                          on_complete=lambda _r: sem.release())
+                          on_complete=lambda _r: sem.release(),
+                          stream=stream)
         else:
             schedule = tenant_open_loop_schedule(
                 tenants, qps=config.qps, duration_s=config.duration_s,
@@ -658,7 +705,8 @@ def _run_load(
                 daemon=True)
             producer.start()
             _worker_drain(q, cache, pool, samples,
-                          impl=config.matmul_impl, mesh_shape=(world,))
+                          impl=config.matmul_impl, mesh_shape=(world,),
+                          stream=stream)
         producer.join()
         wall_s = time.perf_counter() - t0
     return samples, wall_s, schedule_shapes
@@ -669,11 +717,18 @@ def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
     devices, info, pool, cache, q, tenants = _setup(config)
     world = len(devices)
     _bench_header(config, config.scheduler, tenants)
-    with telemetry.session(config.trace_out), _exporter(config):
+    # the ledger opens BEFORE load (manifest first, then per-batch
+    # progress lines): a SIGKILL mid-run leaves a schema-valid partial
+    # ledger — the crash-consistency bar faults/audit.py certifies
+    with telemetry.session(config.trace_out), _exporter(config), \
+            JsonWriter(config.json_out,
+                       manifest=telemetry.build_manifest(
+                           extra={"serve_config": _config_manifest(config)}),
+                       append=config.append_ledger) as writer:
         prewarmed = _prewarm(config, q.grid, cache, world, tenants) \
             if config.prewarm else 0
         samples, wall_s, schedule_shapes = _run_load(
-            config, pool, cache, q, tenants, world)
+            config, pool, cache, q, tenants, world, stream=writer)
         requested_f, executed_f, bucket_f = _flops(samples, schedule_shapes)
         stats = serve_stats(
             samples, q, cache, load_mode=config.load_mode,
@@ -687,11 +742,7 @@ def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
                             prewarmed=prewarmed)
         _attach_cost_analysis(rec, cache)
         _report_summary(stats)
-        with JsonWriter(config.json_out,
-                        manifest=telemetry.build_manifest(
-                            extra={"serve_config": _config_manifest(config)}),
-                        append=config.append_ledger) as writer:
-            writer.write(rec)
+        writer.write(rec)
     return [rec]
 
 
@@ -719,7 +770,12 @@ def run_ab(config: ServeConfig) -> list[BenchmarkRecord]:
 
     records: list[BenchmarkRecord] = []
     arm_stats: dict[str, dict[str, Any]] = {}
-    with telemetry.session(config.trace_out), _exporter(config):
+    with telemetry.session(config.trace_out), _exporter(config), \
+            JsonWriter(config.json_out,
+                       manifest=telemetry.build_manifest(
+                           extra={"serve_config": _config_manifest(
+                               config, "ab")}),
+                       append=config.append_ledger) as writer:
         for arm in ("fixed", "continuous"):
             _bench_header(config, arm, tenants)
             # fresh operand pool + cache + admission per arm: neither arm
@@ -731,7 +787,7 @@ def run_ab(config: ServeConfig) -> list[BenchmarkRecord]:
             prewarmed = _prewarm(config, grid, cache, world, tenants) \
                 if config.prewarm else 0
             samples, wall_s, shapes = _run_load(
-                config, pool, cache, q, tenants, world)
+                config, pool, cache, q, tenants, world, stream=writer)
             requested_f, executed_f, bucket_f = _flops(samples, shapes)
             stats = serve_stats(
                 samples, q, cache, load_mode=config.load_mode,
@@ -783,13 +839,8 @@ def run_ab(config: ServeConfig) -> list[BenchmarkRecord]:
             f"  - tolerance ±{tol}% (noise-aware) → "
             + ("REGRESSED" if regressed else "ok"),
         )
-        with JsonWriter(config.json_out,
-                        manifest=telemetry.build_manifest(
-                            extra={"serve_config": _config_manifest(
-                                config, "ab")}),
-                        append=config.append_ledger) as writer:
-            for rec in records:
-                writer.write(rec)
+        for rec in records:
+            writer.write(rec)
     if regressed:
         raise SystemExit(1)
     return records
@@ -858,7 +909,12 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
     key = ExecKey(*q.grid.bucket(e.m, e.k, e.n), dtype=config.dtype_name,
                   impl=config.matmul_impl, mesh_shape=(world,))
     samples: list[Sample] = []
-    with telemetry.session(config.trace_out), _exporter(config):
+    with telemetry.session(config.trace_out), _exporter(config), \
+            JsonWriter(config.json_out,
+                       manifest=telemetry.build_manifest(
+                           extra={"serve_config": _config_manifest(
+                               config, "selftest")}),
+                       append=config.append_ledger) as writer:
         with telemetry.span("warm-start", buckets=1):
             preloaded = cache.warm_start([key])
         t0 = time.perf_counter()
@@ -868,7 +924,7 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
                              tenant=tenants[rid % len(tenants)].tenant_id))
         q.close()
         _worker_drain(q, cache, pool, samples, impl=config.matmul_impl,
-                      mesh_shape=(world,))
+                      mesh_shape=(world,), stream=writer)
         wall_s = time.perf_counter() - t0
         requested_f, executed_f, bucket_f = _flops(samples, {})
         stats = serve_stats(samples, q, cache, load_mode="selftest",
@@ -881,12 +937,7 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
                             wall_s=wall_s, prewarmed=preloaded)
         _attach_cost_analysis(rec, cache)
         _report_summary(stats)
-        with JsonWriter(config.json_out,
-                        manifest=telemetry.build_manifest(
-                            extra={"serve_config": _config_manifest(
-                                config, "selftest")}),
-                        append=config.append_ledger) as writer:
-            writer.write(rec)
+        writer.write(rec)
     problems = validate_serve_record(rec)
     s = rec.extras["serve"]
     # the warm-start guarantee: the preload phase compiled the serving
@@ -970,4 +1021,27 @@ def validate_serve_record(rec: BenchmarkRecord) -> list[str]:
         problems.append(
             f"goodput_qps {s['goodput_qps']} exceeds achieved_qps "
             f"{s['achieved_qps']}")
+    return problems
+
+
+def validate_serve_batch_record(d: dict[str, Any]) -> list[str]:
+    """Schema contract for one streamed `serve_batch` progress line —
+    what faults/audit.py holds a SIGKILL'd serve ledger's complete lines
+    to. Empty list = valid."""
+    problems: list[str] = []
+    if d.get("record_type") != SERVE_BATCH_RECORD_TYPE:
+        return [f"record_type is {d.get('record_type')!r}, "
+                f"not {SERVE_BATCH_RECORD_TYPE!r}"]
+    for key, kind in (("seq", int), ("bucket", str), ("n", int),
+                      ("failed", int), ("batch_ms", (int, float))):
+        v = d.get(key)
+        if not isinstance(v, kind) or isinstance(v, bool):
+            problems.append(f"serve_batch lacks a well-typed {key!r} "
+                            f"(got {v!r})")
+    if not problems:
+        if d["seq"] < 1:
+            problems.append(f"serve_batch seq {d['seq']} not positive")
+        if not 0 <= d["failed"] <= d["n"]:
+            problems.append(
+                f"serve_batch failed {d['failed']} outside [0, {d['n']}]")
     return problems

@@ -46,6 +46,8 @@ import json
 import os
 from typing import Any, Iterable
 
+from tpu_matmul_bench.utils.durable import repair_torn_tail
+
 PROVENANCE_KINDS = ("measured", "analytic")
 
 CELL_SCHEMA = 1
@@ -291,6 +293,8 @@ class TuningDB:
         empty, so promotions always land fully keyed."""
         cell = self._complete(cell)
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        # crash hygiene: never append after a torn (newline-less) tail
+        repair_torn_tail(self.path)
         with open(self.path, "a") as fh:
             fh.write(json.dumps(cell.to_record()) + "\n")
             fh.flush()
@@ -302,10 +306,10 @@ class TuningDB:
     def _complete(self, cell: Cell) -> Cell:
         import datetime
 
-        import jax
-
         updates: dict[str, Any] = {}
         if not cell.jax_version:
+            import jax  # lazy: fully-keyed puts stay backend-free
+
             updates["jax_version"] = jax.__version__
         if not cell.program_digest:
             updates["program_digest"] = program_digest(

@@ -10,6 +10,7 @@ references (plus an optional live-array delete for eagerness).
 
 from __future__ import annotations
 
+import errno
 import gc
 import re
 
@@ -111,6 +112,77 @@ def is_overload_error(e: BaseException | str) -> bool:
     if isinstance(e, QueueOverflowError):
         return True
     return _OVERLOAD_MARKER in str(e)
+
+
+# Marker for circuit-breaker sheds: distinct from the depth-overflow
+# marker so ledgers and log tails can attribute a shed to a tripped
+# bucket rather than a full queue.
+_BREAKER_MARKER = "BREAKER_OPEN"
+
+
+class BreakerOpenError(QueueOverflowError):
+    """The request's bucket has its circuit breaker open: recent
+    dispatches on that executable kept failing, so the scheduler sheds
+    new work for the bucket until a half-open probe succeeds
+    (serve/scheduler.py). Subclasses QueueOverflowError because a
+    breaker shed IS load feedback — every producer that already treats
+    overflow as "shed, don't crash" handles it unchanged."""
+
+    def __init__(self, depth: int, max_depth: int, bucket: str = ""):
+        RuntimeError.__init__(
+            self,
+            f"{_BREAKER_MARKER}: bucket {bucket or '?'} circuit open; "
+            "request shed")
+        self.depth = depth
+        self.max_depth = max_depth
+        self.bucket = bucket
+
+
+def is_breaker_error(e: BaseException | str) -> bool:
+    if isinstance(e, BreakerOpenError):
+        return True
+    return _BREAKER_MARKER in str(e)
+
+
+# The unified failure taxonomy (DESIGN §17). Every retry/shed decision
+# in the repo routes through `classify`:
+#   transient — worth a backed-off retry (dropped transport, OOM,
+#               timeouts, disk pressure, injected chaos faults)
+#   overload  — load feedback: shed/propagate, never retry in place
+#   permanent — deterministic; retries spend budget without hope
+TRANSIENT = "transient"
+OVERLOAD = "overload"
+PERMANENT = "permanent"
+
+_TRANSIENT_EXTRA_SIGNATURES = (
+    "No space left on device",
+    "Read timeout",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+)
+
+
+def classify(e: BaseException | str) -> str:
+    """Map an exception (or captured failure text — a log tail, a
+    formatted message) onto the transient/overload/permanent taxonomy.
+    Used by the campaign executor's retry policy and the serve loop's
+    shed handling; table-tested in tests/test_faults.py."""
+    if is_overload_error(e):
+        return OVERLOAD
+    msg = str(e)
+    if is_transport_message(msg) or is_oom_error(e if isinstance(
+            e, BaseException) else RuntimeError(msg)):
+        return TRANSIENT
+    if isinstance(e, BaseException):
+        if isinstance(e, (TimeoutError, ConnectionError)):
+            return TRANSIENT
+        if isinstance(e, OSError) and e.errno in (errno.ENOSPC,
+                                                  errno.EAGAIN):
+            return TRANSIENT
+    low = msg.lower()
+    if any(sig.lower() in low for sig in _TRANSIENT_EXTRA_SIGNATURES):
+        return TRANSIENT
+    return PERMANENT
 
 
 def release_device_memory(*arrays: object) -> None:

@@ -19,6 +19,7 @@ from typing import Any, IO
 
 import jax
 
+from tpu_matmul_bench.utils.durable import repair_torn_tail
 from tpu_matmul_bench.utils.metrics import (
     matmul_flops,
     matmul_out_dtype,
@@ -274,6 +275,11 @@ class JsonWriter:
             if path == "-":
                 self._fh = sys.stdout
             else:
+                if append:
+                    # a crash mid-append leaves a torn final line;
+                    # truncate back to the last complete record so the
+                    # next write can't splice onto the torn half
+                    repair_torn_tail(path)
                 if append and manifest is not None and _has_manifest(path):
                     manifest = None
                 self._fh = open(path, "a" if append else "w")
@@ -295,6 +301,15 @@ class JsonWriter:
     def write(self, rec: BenchmarkRecord) -> None:
         if self._fh is not None:
             self._fh.write(rec.to_json() + "\n")
+            self._sync()
+
+    def write_raw(self, rec: dict[str, Any]) -> None:
+        """Append a non-BenchmarkRecord JSONL line (e.g. the serve
+        loop's per-batch progress records) with the same fsync-per-line
+        durability. Callers must set a `record_type` so measurement
+        readers can skip it."""
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
             self._sync()
 
     def close(self) -> None:

@@ -209,7 +209,18 @@ def _null_span(meta: dict[str, Any]) -> Iterator[dict[str, Any]]:
 
 def span(name: str, **args: Any):
     """Module-level span: records into the installed tracker, or is a
-    free null context when telemetry is off. Yields the args dict."""
+    free null context when telemetry is off. Yields the args dict.
+
+    Span opens are ALSO the repo's fault-injection points and liveness
+    signal: when `TPU_BENCH_FAULT_PLAN` / `TPU_BENCH_HEARTBEAT_FILE`
+    are set (faults/plan.py), the hook fires scheduled faults and
+    touches the supervisor's heartbeat file. Env names are inlined so
+    the fault-free hot path pays two dict lookups and no import."""
+    if os.environ.get("TPU_BENCH_FAULT_PLAN") \
+            or os.environ.get("TPU_BENCH_HEARTBEAT_FILE"):
+        from tpu_matmul_bench.faults import plan as _fault_plan
+
+        _fault_plan.on_span(name)
     tracker = _TRACKER
     if tracker is None:
         return _null_span(dict(args))

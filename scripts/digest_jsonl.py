@@ -111,13 +111,46 @@ def _serve_sublines(r) -> list[str]:
             for label, b in buckets.items()
             if isinstance(b, dict)
             and isinstance(b.get("flops_efficiency_pct"), (int, float))}
-    # only worth a line when padding actually wastes something
-    if effs and any(e < 100.0 for e in effs.values()):
-        for label, eff in sorted(effs.items()):
-            count = (buckets[label] or {}).get("count")
+    sources = {label: b.get("impl_source")
+               for label, b in buckets.items()
+               if isinstance(b, dict) and b.get("impl_source")}
+    # bucket lines when padding wastes something or the routing tiers
+    # are interesting (anything beyond a uniform db/table resolution):
+    # impl_source is the per-bucket provenance — db / table / online /
+    # artifact / flag — the "where did this executable come from" answer
+    interesting = any(src in ("online", "artifact") or len(set(
+        sources.values())) > 1 for src in sources.values())
+    if (effs and any(e < 100.0 for e in effs.values())) or interesting:
+        for label in sorted(set(effs) | set(sources)):
+            count = (buckets.get(label) or {}).get("count")
+            bits = f"      bucket {label:<28} {count:>6} reqs"
+            if label in effs:
+                bits += f"  flops-eff={effs[label]}%"
+            if label in sources:
+                bits += f"  src={sources[label]}"
+            lines.append(bits)
+    # explorer decisions (serve --explore): one line per shadow-routed
+    # bucket — arm means, sample counts, and the promotion verdict under
+    # the 1%-tie discipline — plus what an attached --explore-db took
+    exp = s.get("explore")
+    if isinstance(exp, dict):
+        lines.append(
+            f"      explore eps={exp.get('epsilon')} "
+            f"{exp.get('explored')}/{exp.get('seen')} shadow-routed "
+            f"({exp.get('explored_pct')}%) blocked={exp.get('blocked')}")
+        for d in exp.get("decisions") or []:
+            inc, alt = d.get("incumbent") or {}, d.get("alternate") or {}
             lines.append(
-                f"      bucket {label:<28} {count:>6} reqs  "
-                f"flops-eff={eff}%")
+                f"        {d.get('bucket'):<24} "
+                f"{inc.get('impl')}={inc.get('mean_ms')}ms"
+                f"(n={inc.get('samples')}) vs "
+                f"{alt.get('impl')}={alt.get('mean_ms')}ms"
+                f"(n={alt.get('samples')})  "
+                f"[{d.get('provenance')}] → {d.get('verdict')}")
+        for p in exp.get("promoted") or []:
+            lines.append(f"        promoted {p}")
+        for reason in exp.get("skipped") or []:
+            lines.append(f"        skipped  {reason}")
     return lines
 
 
@@ -263,6 +296,49 @@ def _digest_tune(recs: list[dict]) -> None:
               f"{str(prov.get('kind')):>8}  {prov.get('artifact')}{tf}{flag}")
     bits = ", ".join(f"{n} {k}" for k, n in sorted(by_kind.items()))
     print(f"  total: {len(cells)} cells ({bits})"
+          + (f", {stale} jax-stale" if stale else "")
+          + ("" if jax_now else " [no jax importable: staleness unchecked]"))
+
+
+def _digest_artifacts(recs: list[dict]) -> None:
+    """Artifact-manifest digest (measurements/artifacts/manifest.jsonl):
+    one line per live serialized executable — key prefix, problem, impl,
+    blob size, export-time jax — with last-wins dedupe matching
+    tune/artifacts.py's load. Like the tune digest, the jax column is
+    the standalone half of staleness; the digest-recompute half stays
+    with `tune artifacts verify --check-drift` / lint's ART-002."""
+    try:
+        import jax
+        jax_now = jax.__version__
+    except Exception:
+        jax_now = None
+    arts: dict[str, dict] = {}
+    for r in recs:
+        if r.get("record_type") == "exec_artifact" and r.get("key"):
+            arts[str(r["key"])] = r  # append-only: last record wins
+    by_impl: dict[str, int] = {}
+    total_bytes = stale = 0
+    print(f"  {'key':<16} {'problem':>22} {'impl':>6} "
+          f"{'blocks':>14} {'size':>9}  backend/jax")
+    for key, r in sorted(arts.items()):
+        prob = r.get("problem") or {}
+        by_impl[str(r.get("impl"))] = by_impl.get(str(r.get("impl")), 0) + 1
+        total_bytes += r.get("size_bytes") or 0
+        blocks = r.get("blocks")
+        blk = "x".join(str(b) for b in blocks) if blocks else "-"
+        shape = f"{prob.get('m')}x{prob.get('k')}x{prob.get('n')}"
+        flag = ""
+        if jax_now and r.get("jax_version") and r["jax_version"] != jax_now:
+            flag = f" [jax-stale: {r['jax_version']} → {jax_now}]"
+            stale += 1
+        print(f"  {key[:16]:<16} "
+              f"{shape + '/' + str(prob.get('dtype')):>22} "
+              f"{str(r.get('impl')):>6} {blk:>14} "
+              f"{(r.get('size_bytes') or 0) / 1024:>7.0f}KB  "
+              f"{r.get('backend')}/{r.get('jax_version')}{flag}")
+    bits = ", ".join(f"{n} {k}" for k, n in sorted(by_impl.items()))
+    print(f"  total: {len(arts)} artifacts ({bits}), "
+          f"{total_bytes / 2**20:.1f} MiB of blobs"
           + (f", {stale} jax-stale" if stale else "")
           + ("" if jax_now else " [no jax importable: staleness unchecked]"))
 
@@ -496,6 +572,9 @@ def main(paths: list[str]) -> None:
             continue
         if any(r.get("record_type") == "tune_cell" for r in recs):
             _digest_tune(recs)
+            continue
+        if any(r.get("record_type") == "exec_artifact" for r in recs):
+            _digest_artifacts(recs)
             continue
         if any(r.get("record_type") == "obs_snapshot" for r in recs):
             _digest_obs(recs)

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Seven layers, cheapest first:
+# Eight layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -41,7 +41,14 @@
 #      multi-tenant continuous-batching scheduler end-to-end on CPU and
 #      validates the serve ledger contract: scheduler identity, cache
 #      and queue reconciliation, per-tenant rows summing to the request
-#      total, and SLO attainment for every budgeted tenant.
+#      total, SLO attainment for every budgeted tenant, and the
+#      compile/deserialize preload split.
+#   8. python -m tpu_matmul_bench tune online selftest + tune artifacts
+#      verify — the online-autotuning layer: the shadow-traffic
+#      explorer's ε budget and SLO-debt/breaker guards against a seeded
+#      adversarial stream, then the serialized-executable store's
+#      integrity chain (manifest keys recompute, blobs hash to their
+#      digests; an absent store verifies vacuously).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -70,3 +77,9 @@ JAX_PLATFORMS=cpu python -m tpu_matmul_bench faults selftest
 
 echo "== serve selftest (multi-tenant scheduler / ledger contract) =="
 JAX_PLATFORMS=cpu python -m tpu_matmul_bench serve selftest
+
+echo "== tune online selftest (explorer ε budget + SLO/breaker guards) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench tune online selftest
+
+echo "== tune artifacts verify (executable store integrity chain) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench tune artifacts verify

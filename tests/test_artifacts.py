@@ -1,0 +1,349 @@
+"""The serialized-executable store (tune/artifacts.py) + its serve wiring.
+
+Four contract families, all CPU:
+
+- **Round trip** — an AOT-compiled matmul survives pack → store → fresh
+  load → unpack and computes the same product in a different cache
+  instance (the in-process half of zero-cold-compile serving).
+- **Corruption** — a truncated or byte-flipped blob is *rejected at
+  read time* (digest mismatch → None, never bad bytes loaded); a torn
+  manifest tail is tolerated on load and repaired before append — the
+  same byte-offset discipline tests/test_faults.py pins for every other
+  durable artifact.
+- **Lint** — seeded ART-001 (key/digest/blob integrity) and ART-002
+  (jax/program drift) fixtures pin the rule IDs; a clean store audits
+  clean.
+- **Two-process e2e** — a second serve process against the store a
+  first process populated reaches warm dispatch with cold_requests == 0
+  and every preload accounted to the deserialize phase.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.envutil import scrubbed_env
+from tpu_matmul_bench.tune.artifacts import (
+    ArtifactMeta,
+    ArtifactStore,
+    blob_digest,
+    pack_executable,
+    unpack_executable,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _compiled_matmul(m: int = 16, k: int = 16, n: int = 16):
+    shapes = (jax.ShapeDtypeStruct((m, k), "float32"),
+              jax.ShapeDtypeStruct((k, n), "float32"))
+    return jax.jit(lambda a, b: a @ b).lower(*shapes).compile()
+
+
+def _meta(m: int = 16, k: int = 16, n: int = 16) -> ArtifactMeta:
+    return ArtifactMeta.build(m, k, n, "float32", impl="xla",
+                              device_kind="cpu")
+
+
+@pytest.fixture
+def store(tmp_path) -> ArtifactStore:
+    return ArtifactStore.load(str(tmp_path / "store"))
+
+
+class TestRoundTrip:
+    def test_pack_unpack_executes(self):
+        compiled = _compiled_matmul()
+        blob = pack_executable(compiled)
+        assert isinstance(blob, bytes) and len(blob) > 0
+        a = jnp.arange(16 * 16, dtype=jnp.float32).reshape(16, 16)
+        b = jnp.ones((16, 16), dtype=jnp.float32)
+        back = unpack_executable(blob)
+        np.testing.assert_allclose(np.asarray(back(a, b)),
+                                   np.asarray(compiled(a, b)))
+
+    def test_store_round_trip_across_fresh_load(self, store):
+        meta = _meta()
+        blob = pack_executable(_compiled_matmul())
+        rec = store.put(meta, blob)
+        assert rec["key"] == meta.key
+        assert rec["blob_digest"] == blob_digest(blob)
+        # a different process' view: reload from disk, hit, verify
+        fresh = ArtifactStore.load(store.root)
+        assert len(fresh) == 1
+        hit = fresh.lookup(meta)
+        assert hit is not None and hit["key"] == meta.key
+        got = fresh.get_blob(hit)
+        assert got == blob
+        a = jnp.ones((16, 16), dtype=jnp.float32)
+        out = unpack_executable(got)(a, a)
+        np.testing.assert_allclose(np.asarray(out), np.full((16, 16), 16.0))
+
+    def test_identity_axes_are_in_the_key(self):
+        meta = _meta()
+        # any drift axis changes the key: staleness can only MISS
+        assert dataclass_replace(meta, jax_version="0.0.1").key != meta.key
+        assert dataclass_replace(meta, program_digest="feed").key != meta.key
+        assert dataclass_replace(meta, backend="tpu").key != meta.key
+        assert dataclass_replace(meta, mesh_shape=(8,)).key != meta.key
+
+    def test_put_is_idempotent_last_wins(self, store):
+        meta = _meta()
+        blob = pack_executable(_compiled_matmul())
+        store.put(meta, blob)
+        store.put(meta, blob)
+        fresh = ArtifactStore.load(store.root)
+        assert len(fresh) == 1  # two manifest lines, one live record
+        assert fresh.records_read == 2
+
+
+def dataclass_replace(meta: ArtifactMeta, **kw) -> ArtifactMeta:
+    import dataclasses
+
+    return dataclasses.replace(meta, **kw)
+
+
+class TestCorruption:
+    def test_truncated_blob_rejected_at_every_stride(self, store):
+        meta = _meta()
+        blob = pack_executable(_compiled_matmul())
+        rec = store.put(meta, blob)
+        path = Path(store.root) / rec["blob"]
+        data = path.read_bytes()
+        # every prefix (coarse stride + the one-byte-short boundary) must
+        # be rejected by the digest check — never loaded, never raised
+        cuts = sorted({*range(0, len(data), max(1, len(data) // 64)),
+                       len(data) - 1})
+        for cut in cuts:
+            path.write_bytes(data[:cut])
+            store.rejected.clear()
+            assert store.get_blob(rec) is None, f"cut at byte {cut}"
+            assert store.rejected, f"cut at byte {cut} not recorded"
+        path.write_bytes(data)
+        assert store.get_blob(rec) == blob
+
+    def test_flipped_byte_rejected_at_every_stride(self, store):
+        meta = _meta()
+        blob = pack_executable(_compiled_matmul())
+        rec = store.put(meta, blob)
+        path = Path(store.root) / rec["blob"]
+        data = path.read_bytes()
+        for pos in range(0, len(data), max(1, len(data) // 64)):
+            garbled = bytearray(data)
+            garbled[pos] ^= 0xFF
+            path.write_bytes(bytes(garbled))
+            assert store.get_blob(rec) is None, f"flip at byte {pos}"
+        path.write_bytes(data)
+        assert store.get_blob(rec) == blob
+
+    def test_missing_blob_is_a_recorded_miss(self, store):
+        meta = _meta()
+        rec = store.put(meta, pack_executable(_compiled_matmul()))
+        (Path(store.root) / rec["blob"]).unlink()
+        assert store.get_blob(rec) is None
+        assert any("unreadable" in r for r in store.rejected)
+
+    def test_torn_manifest_tail_tolerated_then_repaired(self, store):
+        blob = pack_executable(_compiled_matmul())
+        store.put(_meta(16, 16, 16), blob)
+        store.put(_meta(32, 32, 32), blob)
+        manifest = Path(store.manifest_path)
+        data = manifest.read_bytes()
+        last_start = data[:-1].rfind(b"\n") + 1
+        cut = last_start + (len(data) - 1 - last_start) // 2
+        manifest.write_bytes(data[:cut])
+        torn = ArtifactStore.load(store.root)
+        assert len(torn) == 1  # complete record readable, torn one gone
+        assert torn.parse_errors
+        # append after the tear: repair_torn_tail must prevent splicing
+        torn.put(_meta(64, 64, 64), blob)
+        healed = ArtifactStore.load(store.root)
+        assert len(healed) == 2
+        assert not healed.parse_errors
+
+
+class TestArtifactLint:
+    def _audit(self, store):
+        from tpu_matmul_bench.analysis.auditor import audit_artifacts
+
+        return audit_artifacts(store=ArtifactStore.load(store.root))
+
+    def _tamper(self, store, mutate):
+        """Rewrite the manifest's single record through `mutate`."""
+        manifest = Path(store.manifest_path)
+        recs = [json.loads(line) for line in
+                manifest.read_text().splitlines()]
+        manifest.write_text("".join(
+            json.dumps(mutate(dict(r))) + "\n" for r in recs))
+
+    def test_clean_store_audits_clean(self, store):
+        store.put(_meta(), pack_executable(_compiled_matmul()))
+        assert self._audit(store) == []
+
+    def test_absent_store_audits_clean(self, tmp_path):
+        from tpu_matmul_bench.analysis.auditor import audit_artifacts
+
+        empty = ArtifactStore.load(str(tmp_path / "nowhere"))
+        assert audit_artifacts(store=empty) == []
+
+    def test_art001_tampered_key(self, store):
+        store.put(_meta(), pack_executable(_compiled_matmul()))
+        self._tamper(store, lambda r: {**r, "key": "0" * 16})
+        rules = {f.rule for f in self._audit(store)}
+        assert "ART-001" in rules
+
+    def test_art001_blob_digest_mismatch(self, store):
+        rec = store.put(_meta(), pack_executable(_compiled_matmul()))
+        path = Path(store.root) / rec["blob"]
+        path.write_bytes(path.read_bytes()[:-1] + b"\x00")
+        findings = self._audit(store)
+        assert any(f.rule == "ART-001" and "hash" in f.message
+                   for f in findings)
+
+    def test_art001_missing_blob(self, store):
+        rec = store.put(_meta(), pack_executable(_compiled_matmul()))
+        (Path(store.root) / rec["blob"]).unlink()
+        findings = self._audit(store)
+        assert any(f.rule == "ART-001" and "missing" in f.message
+                   for f in findings)
+
+    def test_art002_jax_drift(self, store):
+        store.put(_meta(), pack_executable(_compiled_matmul()))
+        self._tamper(store, lambda r: {
+            **r, "jax_version": "0.0.1",
+            "key": _rekey({**r, "jax_version": "0.0.1"})})
+        findings = self._audit(store)
+        assert any(f.rule == "ART-002" for f in findings)
+        assert not any(f.rule == "ART-001" for f in findings)
+        # warn severity: a jax bump reports, it does not fail --fail-on error
+        assert all(f.severity == "warn" for f in findings
+                   if f.rule == "ART-002")
+
+    def test_art002_program_digest_drift(self, store):
+        store.put(_meta(), pack_executable(_compiled_matmul()))
+        self._tamper(store, lambda r: {
+            **r, "program_digest": "deadbeef",
+            "key": _rekey({**r, "program_digest": "deadbeef"})})
+        findings = self._audit(store)
+        assert any(f.rule == "ART-002" and "digest" in f.message
+                   for f in findings)
+        assert not any(f.rule == "ART-001" for f in findings)
+
+    def test_verify_cli_exits_nonzero_on_tamper(self, store):
+        from tpu_matmul_bench.tune import cli as tune_cli
+
+        store.put(_meta(), pack_executable(_compiled_matmul()))
+        assert tune_cli.main(
+            ["artifacts", "verify", "--store", store.root]) == 0
+        self._tamper(store, lambda r: {**r, "key": "0" * 16})
+        with pytest.raises(SystemExit) as exc:
+            tune_cli.main(["artifacts", "verify", "--store", store.root])
+        assert exc.value.code == 1
+
+
+def _rekey(rec: dict) -> str:
+    from tpu_matmul_bench.tune.artifacts import artifact_key
+
+    return artifact_key(rec["fingerprint"], rec["jax_version"],
+                        rec["program_digest"], rec["backend"],
+                        tuple(rec["mesh_shape"]))
+
+
+class TestWarmStartDeserialize:
+    def test_second_cache_instance_deserializes(self, store):
+        from tpu_matmul_bench.serve.cache import ExecKey, ExecutableCache
+
+        key = ExecKey(16, 16, 16, "float32", "xla")
+        build = lambda k: (lambda a, b: a @ b)  # noqa: E731
+        meta = lambda k: _meta(k.m, k.k, k.n)  # noqa: E731
+        first = ExecutableCache(build, artifacts=store, artifact_meta=meta)
+        assert first.warm_start([key]) == 1
+        s1 = first.stats()
+        assert s1["preload"] == {
+            "count": 1, "compiled": 1, "deserialized": 0,
+            "total_ms": s1["preload"]["total_ms"],
+            "compile_ms": s1["preload"]["compile_ms"], "deserialize_ms": 0.0}
+        assert s1["artifacts"]["exports"] == 1
+        assert s1["by_entry"][key.label]["source"] == "compile"
+
+        second = ExecutableCache(build, artifacts=ArtifactStore.load(
+            store.root), artifact_meta=meta)
+        assert second.warm_start([key]) == 1
+        s2 = second.stats()
+        assert s2["preload"]["deserialized"] == 1
+        assert s2["preload"]["compiled"] == 0
+        assert s2["artifacts"] == {"hits": 1, "misses": 0, "exports": 0,
+                                   "errors": 0}
+        entry = s2["by_entry"][key.label]
+        assert entry["source"] == "artifact"
+        assert entry["cold_compile_ms"] == 0.0
+        assert entry["deserialize_ms"] >= 0.0
+        # the imported executable actually serves
+        a = jnp.ones((16, 16), dtype=jnp.float32)
+        out = second.get(key).compiled(a, a)
+        np.testing.assert_allclose(np.asarray(out), np.full((16, 16), 16.0))
+
+    def test_corrupt_blob_falls_back_to_compile(self, store):
+        from tpu_matmul_bench.serve.cache import ExecKey, ExecutableCache
+
+        key = ExecKey(16, 16, 16, "float32", "xla")
+        build = lambda k: (lambda a, b: a @ b)  # noqa: E731
+        meta = lambda k: _meta(k.m, k.k, k.n)  # noqa: E731
+        first = ExecutableCache(build, artifacts=store, artifact_meta=meta)
+        first.warm_start([key])
+        rec = store.records()[0]
+        path = Path(store.root) / rec["blob"]
+        path.write_bytes(b"junk")
+        second = ExecutableCache(build, artifacts=ArtifactStore.load(
+            store.root), artifact_meta=meta)
+        assert second.warm_start([key]) == 1
+        s = second.stats()
+        assert s["preload"]["compiled"] == 1  # rejected blob → compile
+        assert s["preload"]["deserialized"] == 0
+        assert s["artifacts"]["errors"] == 1
+        assert s["by_entry"][key.label]["source"] == "compile"
+
+
+class TestTwoProcessE2E:
+    def _run(self, out: Path, store: Path, extra=()):
+        cmd = [sys.executable, "-m", "tpu_matmul_bench", "serve", "bench",
+               "--qps", "40", "--duration", "0.5", "--mix", "32,64:0.5",
+               "--prewarm", "--matmul-impl", "xla",
+               "--artifacts", str(store), "--json-out", str(out), *extra]
+        proc = subprocess.run(
+            cmd, env=scrubbed_env(platforms="cpu", device_count=1),
+            capture_output=True, text=True, timeout=300, cwd=str(REPO))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        for line in out.read_text().splitlines():
+            rec = json.loads(line)
+            if "serve" in (rec.get("extras") or {}):
+                return rec["extras"]["serve"]
+        raise AssertionError(f"no serve record in {out}")
+
+    def test_second_process_serves_zero_cold(self, tmp_path):
+        store = tmp_path / "store"
+        s1 = self._run(tmp_path / "run1.jsonl", store)
+        pre1 = s1["cache"]["preload"]
+        assert pre1["compiled"] == pre1["count"] > 0
+        assert pre1["deserialized"] == 0
+        assert s1["cache"]["artifacts"]["exports"] == pre1["compiled"]
+
+        s2 = self._run(tmp_path / "run2.jsonl", store)
+        pre2 = s2["cache"]["preload"]
+        # the tentpole claim: a fresh process, zero cold compiles —
+        # every preload was a deserialize, every request warm
+        assert s2["cold_requests"] == 0
+        assert pre2["compiled"] == 0
+        assert pre2["deserialized"] == pre2["count"] == pre1["count"]
+        assert s2["cache"]["artifacts"]["hits"] == pre2["count"]
+        assert pre2["deserialize_ms"] > 0
+        assert pre2["compile_ms"] == 0.0
+        for label, row in s2["buckets"].items():
+            assert row["impl_source"] == "artifact", label

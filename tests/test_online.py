@@ -1,0 +1,254 @@
+"""The shadow-traffic online autotuner (tune/online.py).
+
+Pins the four disciplines ISSUE 13 ships:
+
+- **ε budget is a hard prefix invariant** — explored ≤ ε·seen at every
+  point of an adversarial stream, not merely in expectation;
+- **guards are absolute** — a tenant in SLO debt or a bucket behind an
+  open breaker is never explored, at any ε;
+- **promotion discipline is the offline one** — warm samples only, both
+  arms at min_samples, the 1% runner-up tie gate, and the promoted cell
+  is a valid ``measured-online`` cell citing the serve ledger (.jsonl),
+  which is exactly what lint's TUNE-003 enforces;
+- **budget placement** — measured-provenance incumbents explore at a
+  discount, analytic/table buckets at full ε.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpu_matmul_bench.serve.cache import ExecKey
+from tpu_matmul_bench.tune.db import Cell, TuningDB
+from tpu_matmul_bench.tune.online import (
+    MEASURED_DISCOUNT,
+    OnlineExplorer,
+    run_selftest,
+)
+
+KEY = ExecKey(256, 256, 256, "float32", "auto")
+
+
+class FakeQueue:
+    """Duck-typed scheduler guards with call recording."""
+
+    def __init__(self, debtors=(), open_buckets=()):
+        self.debtors = set(debtors)
+        self.open_buckets = {tuple(b) for b in open_buckets}
+
+    def tenant_in_slo_debt(self, tenant):
+        return tenant in self.debtors
+
+    def breaker_open(self, bucket, dtype):
+        return tuple(bucket) in self.open_buckets
+
+
+def _explorer(epsilon=0.5, **kw) -> OnlineExplorer:
+    kw.setdefault("db", TuningDB(path="/dev/null"))
+    return OnlineExplorer(epsilon=epsilon, device_kind="cpu", seed=0, **kw)
+
+
+def _feed(ex, key, n, *, tenant="t", warm_ms=2.0, alt_factor=0.9,
+          rng=None):
+    """Drive n requests through consider/observe, returning explored count."""
+    rng = rng or random.Random(1)
+    explored = 0
+    for _ in range(n):
+        alt = ex.consider(key, tenant)
+        base = warm_ms * (alt_factor if alt else 1.0)
+        ex.observe(key, base * 1e-3 * rng.uniform(0.999, 1.001),
+                   cold=False, explored=alt is not None)
+        explored += alt is not None
+    return explored
+
+
+class TestBudget:
+    def test_epsilon_bounds_validated(self):
+        for bad in (0.0, -0.1, 1.5):
+            with pytest.raises(ValueError):
+                _explorer(epsilon=bad)
+
+    @pytest.mark.parametrize("epsilon", [0.02, 0.1, 0.5])
+    def test_hard_prefix_invariant(self, epsilon):
+        ex = _explorer(epsilon=epsilon)
+        rng = random.Random(7)
+        for i in range(2000):
+            alt = ex.consider(KEY, "t")
+            # the invariant must hold after EVERY request, so an
+            # adversarial prefix can never be over budget
+            assert ex.explored <= epsilon * ex.seen, f"request {i}"
+            ex.observe(KEY, 2e-3 * rng.uniform(0.9, 1.1), cold=False,
+                       explored=alt is not None)
+        assert ex.explored > 0, "budget accounting starved exploration"
+        assert ex.seen == 2000
+        blocked = sum(ex.blocked.values())
+        routine = ex.seen - ex.explored - blocked
+        assert routine >= 0
+
+    def test_cold_samples_never_feed_arms(self):
+        ex = _explorer()
+        ex.observe(KEY, 5e-3, cold=True, explored=False)
+        ex.observe(KEY, -1.0, cold=False, explored=False)
+        st = ex._bucket_state(KEY)
+        assert not st.incumbent.samples and not st.alternate.samples
+
+    def test_measured_incumbent_is_discounted(self):
+        db = TuningDB(path="/dev/null")
+        db._cells[("fp", "cpu")] = None  # not used; route via injected cells
+        measured = Cell(m=256, k=256, n=256, dtype="float32",
+                        device_kind="cpu", impl="xla",
+                        provenance_kind="measured",
+                        artifact="measurements/x.jsonl")
+        db._cells = {measured.key: measured}
+        ex = _explorer(db=db)
+        st = ex._bucket_state(KEY)
+        assert st.weight == MEASURED_DISCOUNT
+        assert st.provenance_kind == "measured"
+        # table fallback gets the full budget
+        ex2 = _explorer()
+        assert ex2._bucket_state(KEY).weight == 1.0
+
+    def test_configured_impl_pins_incumbent(self):
+        ex = _explorer(configured_impl="pallas")
+        st = ex._bucket_state(KEY)
+        assert st.incumbent.impl == "pallas"
+        assert st.alternate.impl == "xla"
+        assert st.provenance_kind == "flag"
+
+
+class TestGuards:
+    def test_slo_debt_is_absolute(self):
+        ex = _explorer(epsilon=1.0)
+        ex.bind(FakeQueue(debtors={"debtor"}))
+        for _ in range(500):
+            assert ex.consider(KEY, "debtor") is None
+        assert ex.blocked["slo_debt"] == 500
+        assert ex.explored == 0
+
+    def test_breaker_open_is_absolute(self):
+        ex = _explorer(epsilon=1.0)
+        ex.bind(FakeQueue(open_buckets={(256, 256, 256)}))
+        for _ in range(500):
+            assert ex.consider(KEY, "t") is None
+        assert ex.blocked["breaker_open"] == 500
+        # an unguarded bucket on the same stream still explores
+        other = ExecKey(128, 128, 128, "float32", "auto")
+        assert _feed(ex, other, 50) > 0
+
+    def test_unbound_queue_means_no_guards(self):
+        ex = _explorer(epsilon=1.0)  # bind() never called
+        assert _feed(ex, KEY, 50, tenant="debtor") > 0
+
+    def test_real_scheduler_exposes_the_guard_hooks(self):
+        from tpu_matmul_bench.serve.scheduler import ContinuousScheduler
+
+        assert callable(getattr(ContinuousScheduler, "tenant_in_slo_debt"))
+        assert callable(getattr(ContinuousScheduler, "breaker_open"))
+
+
+class TestPromotion:
+    def _evidence(self, ex, alt_factor, n=400):
+        _feed(ex, KEY, n, alt_factor=alt_factor)
+
+    def test_promotes_measured_online_cell_with_ledger_ref(self, tmp_path):
+        ex = _explorer(epsilon=0.5)
+        self._evidence(ex, alt_factor=0.9)  # alternate 10% faster
+        db = TuningDB(path=str(tmp_path / "db.jsonl"))
+        result = ex.promote(db, ledger_ref="measurements/serve/run.jsonl")
+        assert len(result["promoted"]) == 1
+        cell = result["promoted"][0]
+        assert cell.provenance_kind == "measured-online"
+        assert cell.artifact.endswith(".jsonl")
+        assert "online explorer" in cell.detail
+        # ... and it round-trips: a fresh load routes through it
+        fresh = TuningDB.load(db.path)
+        got = fresh.lookup(256, 256, 256, "float32", "cpu")
+        assert got is not None
+        assert got.provenance_kind == "measured-online"
+        probs = [p for p in fresh.validate() if "does not exist" not in p]
+        assert probs == []
+
+    def test_promoted_cell_routes_as_online_source(self, tmp_path):
+        from tpu_matmul_bench.ops.impl_select import select_impl
+
+        ex = _explorer(epsilon=0.5)
+        self._evidence(ex, alt_factor=0.9)
+        db = TuningDB(path=str(tmp_path / "db.jsonl"))
+        ex.promote(db, ledger_ref="measurements/serve/run.jsonl")
+        choice = select_impl(256, 256, 256, "cpu", "float32", db=db)
+        assert choice.source == "online"
+
+    def test_tie_inside_gate_not_promoted(self, tmp_path):
+        ex = _explorer(epsilon=0.5)
+        self._evidence(ex, alt_factor=0.998)  # 0.2% — inside the 1% gate
+        db = TuningDB(path=str(tmp_path / "db.jsonl"))
+        result = ex.promote(db, ledger_ref="measurements/serve/run.jsonl")
+        assert result["promoted"] == []
+        assert any("gate" in r for r in result["skipped"])
+
+    def test_insufficient_samples_not_promoted(self, tmp_path):
+        ex = _explorer(epsilon=0.5, min_samples=10_000)
+        self._evidence(ex, alt_factor=0.5)
+        db = TuningDB(path=str(tmp_path / "db.jsonl"))
+        result = ex.promote(db, ledger_ref="measurements/serve/run.jsonl")
+        assert result["promoted"] == []
+        assert any("not enough evidence" in r for r in result["skipped"])
+
+    def test_promotion_without_ledger_ref_raises(self, tmp_path):
+        ex = _explorer()
+        db = TuningDB(path=str(tmp_path / "db.jsonl"))
+        for bad in (None, "", "notes.txt"):
+            with pytest.raises(ValueError, match="TUNE-003"):
+                ex.promote(db, ledger_ref=bad)
+
+    def test_pallas_promotion_carries_blocks(self, tmp_path):
+        # incumbent xla (table fallback on cpu) → alternate is pallas;
+        # a pallas cell without blocks fails db.validate()
+        ex = _explorer(epsilon=0.5)
+        self._evidence(ex, alt_factor=0.9)
+        db = TuningDB(path=str(tmp_path / "db.jsonl"))
+        result = ex.promote(db, ledger_ref="measurements/serve/run.jsonl")
+        [cell] = result["promoted"]
+        assert cell.impl == "pallas"
+        assert cell.blocks is not None and len(cell.blocks) == 3
+
+
+class TestTune003:
+    def _db_with_online_cell(self, tmp_path, artifact):
+        db = TuningDB(path=str(tmp_path / "db.jsonl"))
+        db.put(Cell(m=256, k=256, n=256, dtype="bfloat16",
+                    device_kind="v5-lite", impl="pallas",
+                    provenance_kind="measured-online",
+                    artifact=artifact, blocks=(512, 512, 512)))
+        return TuningDB.load(db.path)
+
+    def test_audit_tune_fires_on_ledgerless_online_cell(self, tmp_path):
+        from tpu_matmul_bench.analysis.auditor import audit_tune
+
+        db = self._db_with_online_cell(tmp_path, "word of mouth")
+        rules = {f.rule for f in audit_tune(db=db)}
+        assert "TUNE-003" in rules
+
+    def test_audit_tune_clean_with_ledger_ref(self, tmp_path):
+        from tpu_matmul_bench.analysis.auditor import audit_tune
+
+        db = self._db_with_online_cell(
+            tmp_path, "measurements/serve/run.jsonl")
+        assert not any(f.rule == "TUNE-003" for f in audit_tune(db=db))
+
+    def test_db_validate_mirrors_the_rule(self, tmp_path):
+        db = self._db_with_online_cell(tmp_path, "word of mouth")
+        assert any("serve" in p and ".jsonl" in p for p in db.validate())
+
+    def test_rule_registered_as_error(self):
+        from tpu_matmul_bench.analysis.findings import RULES
+
+        assert RULES["TUNE-003"][0] == "error"
+        assert RULES["ART-001"][0] == "error"
+        assert RULES["ART-002"][0] == "warn"
+
+
+def test_selftest_green():
+    assert run_selftest(epsilon=0.1, requests=1500, seed=0) == 0

@@ -714,6 +714,21 @@ def audit_tune(db: Any = None) -> list[Finding]:
     digests = recomputed_digests(
         {cell.key: cell for _, _, cell in rows if cell is not None}.values())
     findings: list[Finding] = []
+    # TUNE-003 scans the whole DB, not just the routed surface: an online
+    # promotion without its ledger is broken evidence wherever it sits
+    for cell in db.cells():
+        if cell.provenance_kind == "measured-online" \
+                and ".jsonl" not in cell.artifact:
+            findings.append(Finding(
+                "TUNE-003",
+                f"tune:{cell.dtype}@{cell.m}x{cell.k}x{cell.n}"
+                f"/{cell.device_kind}",
+                f"measured-online cell cites no serve ledger: "
+                f"{cell.artifact!r} — the shadow-traffic stream that "
+                "measured it must be referenceable",
+                details={"fingerprint": cell.fingerprint,
+                         "impl": cell.impl,
+                         "artifact": cell.artifact}))
     for where, choice, cell in rows:
         if cell is None:
             if not any(tok in choice.provenance
@@ -734,6 +749,42 @@ def audit_tune(db: Any = None) -> list[Finding]:
                 + "; ".join(reasons),
                 details={"fingerprint": cell.fingerprint,
                          "impl": cell.impl,
+                         "reasons": reasons}))
+    return findings
+
+
+def audit_artifacts(store: Any = None) -> list[Finding]:
+    """ART-001/ART-002 over the serialized-executable store: every
+    shipped exec_artifact's digest chain must close (key recomputes from
+    its fields, blob hashes to its recorded digest), and drifted
+    artifacts (jax moved, program re-digests differently) are surfaced
+    as dead weight to re-export or prune.
+
+    `store` is injectable for seeded tests; default is the committed
+    `measurements/artifacts` store (missing → nothing to audit)."""
+    from tpu_matmul_bench.tune.artifacts import (
+        ArtifactStore,
+        recomputed_digests,
+    )
+
+    if store is None:
+        store = ArtifactStore.load()
+    findings: list[Finding] = []
+    for where, message in store.validate():
+        findings.append(Finding("ART-001", where, message))
+    digests = recomputed_digests(store.records())
+    for rec in store.records():
+        reasons = store.stale_reasons(rec, digests=digests)
+        if reasons:
+            prob = rec.get("problem") or {}
+            findings.append(Finding(
+                "ART-002",
+                f"artifact:{rec.get('key', '?')[:12]}",
+                f"stale executable for {prob.get('dtype')}@"
+                f"{prob.get('m')}x{prob.get('k')}x{prob.get('n')}"
+                f"/{rec.get('impl')}: " + "; ".join(reasons),
+                details={"key": rec.get("key"),
+                         "blob": rec.get("blob"),
                          "reasons": reasons}))
     return findings
 
@@ -846,6 +897,7 @@ AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "pallas": audit_pallas_static,
     "registry": audit_registry,
     "tune": audit_tune,
+    "artifacts": audit_artifacts,
     "obs": audit_obs,
     "comm_quant": audit_comm_quant,
     "sched": _audit_sched,

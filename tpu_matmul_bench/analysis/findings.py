@@ -145,6 +145,21 @@ RULES: dict[str, tuple[Severity, str]] = {
                            "registered in faults/audit.WRITER_REGISTRY — "
                            "crash-consistency certification does not know "
                            "this artifact exists"),
+    "ART-001": ("error", "artifact store integrity violation: a shipped "
+                         "exec_artifact's key does not recompute from its "
+                         "own fields, its blob is missing, or the blob "
+                         "does not hash to its recorded digest — the "
+                         "store would deserialize something other than "
+                         "what was certified"),
+    "ART-002": ("warn", "stale serialized executable: the artifact's jax "
+                        "version or recomputed program digest drifted "
+                        "from the store's record — the key mismatch "
+                        "makes it dead weight (serving will recompile "
+                        "past it); re-export or prune"),
+    "TUNE-003": ("error", "measured-online tuning cell cites no serve "
+                          "ledger (.jsonl) — an online promotion must "
+                          "reference the shadow-traffic stream that "
+                          "measured it"),
 }
 
 

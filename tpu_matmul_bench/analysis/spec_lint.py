@@ -40,9 +40,10 @@ _SERVE_COMMON_FLAGS = {
     "--starvation-ms", "--window-ms", "--max-depth",
     "--max-batch", "--cache-capacity", "--matmul-impl", "--seed",
     "--device", "--num-devices", "--json-out", "--append", "--trace-out",
-    "--obs-dir",
+    "--obs-dir", "--artifacts",
 }
-_SERVE_BENCH_FLAGS = {"--qps", "--duration", "--concurrency", "--prewarm"}
+_SERVE_BENCH_FLAGS = {"--qps", "--duration", "--concurrency", "--prewarm",
+                      "--explore", "--explore-db"}
 _SERVE_BOOL_FLAGS = {"--prewarm", "--append"}
 # flags whose value must be a strictly positive number
 _SERVE_POSITIVE_FLAGS = {"--qps", "--duration", "--concurrency",
@@ -161,6 +162,18 @@ def _lint_serve_job(job: Any, where: str,
                 "SPEC-001", where,
                 f"{flag} must be a positive number, got {values[flag]!r}",
                 details={"flag": flag, "value": values[flag]}))
+    eps = values.get("--explore")
+    if "--explore" in values:
+        try:
+            eps_num = float(eps) if eps is not None else -1.0
+        except ValueError:
+            eps_num = -1.0
+        if not 0.0 < eps_num <= 1.0:
+            findings.append(Finding(
+                "SPEC-001", where,
+                f"--explore must be a shadow-traffic fraction in (0, 1], "
+                f"got {eps!r}",
+                details={"explore": eps}))
     sched = values.get("--scheduler")
     if sched is not None and sched not in _SERVE_SCHEDULERS:
         findings.append(Finding(

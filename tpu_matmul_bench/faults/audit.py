@@ -64,6 +64,10 @@ WRITER_REGISTRY: dict[str, str] = {
     "tune/db.py":
         "tuning DB (tune_db.jsonl): measured/analytic cells, certified "
         "by the tune chaos cells",
+    "tune/artifacts.py":
+        "serialized-executable store (measurements/artifacts): "
+        "content-addressed blobs + fsync'd exec_artifact manifest; "
+        "torn tails tolerated on load, blobs digest-verified on read",
     "obs/export.py":
         "obs snapshot stream (obs_snapshot.jsonl), certified by the obs "
         "chaos cells",

@@ -64,8 +64,16 @@ class ImplChoice:
 
     impl: str         # "xla" | "pallas"
     provenance: str   # committed artifact (or rule) behind the decision
-    source: str = "table"            # "db" | "table"
+    source: str = "table"            # "db" | "table" | "online"
     blocks: tuple[int, int, int] | None = None  # DB winner tiling, if any
+
+
+def _cell_source(cell: Any) -> str:
+    """The routing-tier name a DB hit reports: cells the online explorer
+    promoted (tune/online.py, measured-online provenance) surface as
+    their own tier so ledgers distinguish shadow-traffic wins from
+    offline sweeps."""
+    return "online" if cell.provenance_kind == "measured-online" else "db"
 
 
 def _rect_axis(m: int, n: int, k: int) -> str | None:
@@ -156,17 +164,19 @@ def select_impl(m: int, n: int, k: int, device_kind: str,
     the committed store, loaded once per process."""
     cell = _db_lookup(m, n, k, device_kind, dtype, db)
     if cell is not None:
-        _route_counter("db").inc()
+        source = _cell_source(cell)
+        _route_counter(source).inc()
         return ImplChoice(cell.impl, cell.provenance_str,
-                          source="db", blocks=cell.blocks)
+                          source=source, blocks=cell.blocks)
     _route_counter("table").inc()
     return table_select(m, n, k, device_kind, dtype)
 
 
 def _route_counter(source: str):
-    """`tune_route_total{source=db|table}` on the obs bus: how often
-    routing resolved from a measured DB cell vs the baked fallback table
-    — the DB-coverage signal `obs status` surfaces during a tune fill."""
+    """`tune_route_total{source=db|table|online}` on the obs bus: how
+    often routing resolved from a measured DB cell, an online-promoted
+    cell, or the baked fallback table — the DB-coverage signal
+    `obs status` surfaces during a tune fill."""
     from tpu_matmul_bench.obs.registry import get_registry
 
     return get_registry().counter("tune_route_total", source=source)
@@ -180,7 +190,8 @@ def resolve_route(m: int, n: int, k: int, device_kind: str, dtype: Any,
     cell = _db_lookup(m, n, k, device_kind, dtype, db)
     if cell is not None:
         return (ImplChoice(cell.impl, cell.provenance_str,
-                           source="db", blocks=cell.blocks), cell)
+                           source=_cell_source(cell), blocks=cell.blocks),
+                cell)
     return table_select(m, n, k, device_kind, dtype), None
 
 

@@ -22,12 +22,24 @@ shape mix must not grow its executable set without bound (each compiled
 program pins host and device memory). Eviction is the signal the padding
 grid is too fine — the queue's bucketing exists precisely to keep the
 working set of executables small.
+
+With an **artifact store** attached (`tune/artifacts.py`), `warm_start`
+grows a second acquisition path: each fresh key first probes the store
+(keyed by problem fingerprint + jax version + program digest, so drift
+can only miss) and *deserializes* the shipped executable instead of
+compiling it; on a store miss it compiles as before and exports the
+result back into the store. That is the zero-cold-compile startup loop:
+the first process pays the compiles once, every later process reaches
+warm dispatch via deserialize alone. The preload time ledger is split by
+phase (``serve_cache_preload_seconds{phase=compile|deserialize}``) so
+the win is measured, not asserted.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import sys
 import time
 from typing import Any, Callable, Iterable
 
@@ -40,6 +52,8 @@ from tpu_matmul_bench.utils import telemetry
 DEFAULT_CAPACITY = 64
 
 _CACHE_EVENTS = ("hit", "miss", "eviction", "preload")
+_PRELOAD_PHASES = ("compile", "deserialize")
+_ARTIFACT_EVENTS = ("hit", "miss", "export", "error")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +91,10 @@ class CacheEntry:
     # XLA cost_analysis() attribution recorded at compile time
     # (obs/attribution.py); None when the backend reports nothing
     cost: dict[str, Any] | None = None
+    # how the executable got here: "compile" (AOT build in this process)
+    # or "artifact" (deserialized from the tune/artifacts store)
+    source: str = "compile"
+    deserialize_s: float = 0.0  # blob load + deserialize wall time
 
 
 class ExecutableCache:
@@ -95,12 +113,19 @@ class ExecutableCache:
         *,
         capacity: int = DEFAULT_CAPACITY,
         operands: Callable[[ExecKey], tuple[Any, ...]] | None = None,
+        artifacts: Any | None = None,
+        artifact_meta: Callable[[ExecKey], Any] | None = None,
     ) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self._build = build
         self._operands = operands
         self._capacity = capacity
+        # tune/artifacts.ArtifactStore (duck-typed: lookup/get_blob/put)
+        # plus the ExecKey → ArtifactMeta resolver the service layer
+        # provides; both None → warm_start compiles exactly as before
+        self._artifacts = artifacts
+        self._artifact_meta = artifact_meta
         self._entries: collections.OrderedDict[ExecKey, CacheEntry] = (
             collections.OrderedDict())
         # counters live on the obs bus; each cache instance gets its own
@@ -110,7 +135,17 @@ class ExecutableCache:
         reg = get_registry()
         self._events = {e: reg.counter("serve_cache_events", event=e)
                         for e in _CACHE_EVENTS}
-        self._preload_seconds = reg.counter("serve_cache_preload_seconds")
+        # preload wall time split by acquisition phase — the whole point
+        # of the artifact store is visible only if compile vs deserialize
+        # are separate series; `preload_s` below sums them for the
+        # pre-split total
+        self._preload_seconds = {
+            p: reg.counter("serve_cache_preload_seconds", phase=p)
+            for p in _PRELOAD_PHASES}
+        self._preload_counts = dict.fromkeys(_PRELOAD_PHASES, 0)
+        self._artifact_events = {
+            e: reg.counter("serve_cache_artifact_events", event=e)
+            for e in _ARTIFACT_EVENTS} if artifacts is not None else None
 
     # -- compat view: the pre-registry int attributes, now reading the
     # -- bus instruments (stats()/tests keep their exact shape + values)
@@ -132,7 +167,7 @@ class ExecutableCache:
 
     @property
     def preload_s(self) -> float:
-        return self._preload_seconds.value
+        return sum(c.value for c in self._preload_seconds.values())
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -150,28 +185,113 @@ class ExecutableCache:
             return entry
         self._events["miss"].inc()
         entry = self._compile(key)
+        self._insert(key, entry)
+        return entry
+
+    def warm_start(self, keys: Iterable[ExecKey]) -> int:
+        """Acquire every not-yet-resident key eagerly — the measured
+        preload phase that turns first-request cold-compiles into
+        startup cost. With an artifact store attached each key is first
+        imported (deserialized) from the store; only store misses
+        compile, and each fresh compile is exported back so the *next*
+        process deserializes it. Either path is a counted miss, so the
+        ledger keeps a single story: accesses = preloads + served
+        requests, and every later request for a preloaded key is a pure
+        warm hit. Already-resident keys are skipped without touching any
+        counter. Returns the number of executables actually acquired."""
+        fresh = [k for k in dict.fromkeys(keys) if k not in self._entries]
+        for key in sorted(fresh, key=lambda kk: kk.label):
+            t0 = time.perf_counter()
+            entry = self._import_artifact(key)
+            if entry is not None:
+                self._events["miss"].inc()
+                self._insert(key, entry)
+                self._preload_seconds["deserialize"].inc(
+                    time.perf_counter() - t0)
+                self._preload_counts["deserialize"] += 1
+            else:
+                self.get(key)
+                self._preload_seconds["compile"].inc(
+                    time.perf_counter() - t0)
+                self._preload_counts["compile"] += 1
+                self._export_artifact(key)
+        self._events["preload"].inc(len(fresh))
+        return len(fresh)
+
+    def _insert(self, key: ExecKey, entry: CacheEntry) -> None:
+        """Insert with the same LRU eviction discipline as `get`."""
         self._entries[key] = entry
         while len(self._entries) > self._capacity:
             self._entries.popitem(last=False)
             self._events["eviction"].inc()
-        return entry
 
-    def warm_start(self, keys: Iterable[ExecKey]) -> int:
-        """Compile every not-yet-resident key eagerly — the measured
-        preload phase that turns first-request cold-compiles into
-        startup cost. Each compile goes through `get`, so it is a
-        counted miss and the ledger keeps a single story: accesses =
-        preloads + served requests, and every later request for a
-        preloaded key is a pure warm hit. Already-resident keys are
-        skipped without touching any counter. Returns the number of
-        executables actually compiled."""
-        fresh = [k for k in dict.fromkeys(keys) if k not in self._entries]
-        t0 = time.perf_counter()
-        for key in sorted(fresh, key=lambda kk: kk.label):
-            self.get(key)
-        self._preload_seconds.inc(time.perf_counter() - t0)
-        self._events["preload"].inc(len(fresh))
-        return len(fresh)
+    def _import_artifact(self, key: ExecKey) -> CacheEntry | None:
+        """Deserialize `key`'s executable from the store, or None (no
+        store, store miss, or a rejected/corrupt blob — every failure
+        falls back to compiling; bad bytes are never loaded)."""
+        if self._artifacts is None or self._artifact_meta is None:
+            return None
+        try:
+            meta = self._artifact_meta(key)
+            if meta is None:
+                return None
+            rec = self._artifacts.lookup(meta)
+            if rec is None:
+                self._artifact_events["miss"].inc()
+                return None
+            blob = self._artifacts.get_blob(rec)
+            if blob is None:  # digest mismatch / unreadable → recompile
+                self._artifact_events["error"].inc()
+                return None
+            from tpu_matmul_bench.tune.artifacts import unpack_executable
+
+            with telemetry.span(f"aot-deserialize:{key.label}"):
+                t0 = time.perf_counter()
+                compiled = unpack_executable(blob)
+                deser_s = time.perf_counter() - t0
+            warm_s = 0.0
+            if self._operands is not None:
+                from tpu_matmul_bench.utils.timing import sync
+
+                ops = self._operands(key)
+                sync(compiled(*ops))
+                t0 = time.perf_counter()
+                sync(compiled(*ops))
+                warm_s = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001 — any import failure is a
+            # recoverable miss; the compile path is always correct
+            self._artifact_events["error"].inc()
+            print(f"artifact import failed for {key.label}: {e}",
+                  file=sys.stderr)
+            return None
+        self._artifact_events["hit"].inc()
+        return CacheEntry(key=key, compiled=compiled, cold_compile_s=0.0,
+                          warm_dispatch_s=warm_s, built_at=time.time(),
+                          cost=attribution.attribution_block(
+                              compiled, key.m, key.k, key.n),
+                          source="artifact", deserialize_s=deser_s)
+
+    def _export_artifact(self, key: ExecKey) -> None:
+        """Serialize a freshly compiled resident entry into the store so
+        the next process deserializes instead of compiling."""
+        if self._artifacts is None or self._artifact_meta is None:
+            return
+        entry = self._entries.get(key)
+        if entry is None or entry.source != "compile":
+            return
+        try:
+            meta = self._artifact_meta(key)
+            if meta is None:
+                return
+            from tpu_matmul_bench.tune.artifacts import pack_executable
+
+            self._artifacts.put(meta, pack_executable(entry.compiled))
+            self._artifact_events["export"].inc()
+        except Exception as e:  # noqa: BLE001 — export is best-effort;
+            # serving must not fail because the store could not persist
+            self._artifact_events["error"].inc()
+            print(f"artifact export failed for {key.label}: {e}",
+                  file=sys.stderr)
 
     def _compile(self, key: ExecKey) -> CacheEntry:
         shapes = (
@@ -212,12 +332,29 @@ class ExecutableCache:
             "preload": {
                 "count": self.preloaded,
                 "total_ms": round(self.preload_s * 1e3, 3),
+                # acquisition split: count + wall time per phase — the
+                # artifact store's win is `deserialize` displacing
+                # `compile` (selftest asserts the split reconciles)
+                "compiled": self._preload_counts["compile"],
+                "deserialized": self._preload_counts["deserialize"],
+                "compile_ms": round(
+                    self._preload_seconds["compile"].value * 1e3, 3),
+                "deserialize_ms": round(
+                    self._preload_seconds["deserialize"].value * 1e3, 3),
             },
+            **({"artifacts": {
+                f"{e}s" if e != "miss" else "misses":
+                    int(c.value) for e, c in self._artifact_events.items()
+            }} if self._artifact_events is not None else {}),
             "by_entry": {
                 e.key.label: {
                     "cold_compile_ms": round(e.cold_compile_s * 1e3, 3),
                     "warm_dispatch_ms": round(e.warm_dispatch_s * 1e3, 3),
                     "hits": e.hits,
+                    "source": e.source,
+                    **({"deserialize_ms":
+                        round(e.deserialize_s * 1e3, 3)}
+                       if e.source == "artifact" else {}),
                 }
                 for e in self._entries.values()
             },

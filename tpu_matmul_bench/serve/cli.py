@@ -100,6 +100,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="export live metrics snapshots (obs_snapshot.jsonl "
                         "+ metrics.prom) into this directory; tail them "
                         "with `python -m tpu_matmul_bench obs status`")
+    p.add_argument("--artifacts", default=None, nargs="?",
+                   const="", metavar="DIR",
+                   help="serialized-executable store root: warm_start "
+                        "imports matching AOT artifacts instead of "
+                        "compiling, and exports what it had to compile "
+                        "(bare flag = the committed "
+                        "measurements/artifacts store)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -125,6 +132,17 @@ def build_parser() -> argparse.ArgumentParser:
                         help="compile every mix bucket before the load "
                              "window, so latencies are steady-state (the "
                              "gated configuration)")
+        sp.add_argument("--explore", type=float, default=0.0,
+                        help="online-autotuning shadow-traffic budget: at "
+                             "most this fraction of requests is routed "
+                             "through each bucket's runner-up impl "
+                             "(0 = off; default %(default)s)")
+        sp.add_argument("--explore-db", default=None,
+                        help="tuning DB the explorer routes from and "
+                             "promotes measured-online winners into "
+                             "(needs --json-out for the ledger citation; "
+                             "default: route from the committed DB, "
+                             "promote nothing)")
         _add_common(sp)
 
     bench = sub.add_parser("bench", help="one load window → one ledger")
@@ -173,12 +191,17 @@ def _config_from(args: argparse.Namespace) -> ServeConfig:
         append_ledger=args.append,
         trace_out=args.trace_out,
         obs_dir=args.obs_dir,
+        artifacts=args.artifacts,
     )
     if args.cache_capacity is not None:
         kwargs["cache_capacity"] = args.cache_capacity
     if args.command in ("bench", "ab"):
+        if not 0.0 <= args.explore <= 1.0:
+            raise SystemExit(f"serve: --explore must be in [0, 1], "
+                             f"got {args.explore}")
         kwargs.update(qps=args.qps, duration_s=args.duration_s,
-                      concurrency=args.concurrency, prewarm=args.prewarm)
+                      concurrency=args.concurrency, prewarm=args.prewarm,
+                      explore=args.explore, explore_db=args.explore_db)
     return ServeConfig(**kwargs)
 
 

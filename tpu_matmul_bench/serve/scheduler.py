@@ -462,6 +462,29 @@ class ContinuousScheduler:
                 self._service_ewma_s += _SERVICE_EWMA_ALPHA * (
                     per_req - self._service_ewma_s)
 
+    # ----------------------------------------------------- explorer guards
+
+    def tenant_in_slo_debt(self, tenant: str) -> bool:
+        """True when this tenant's backlog already implies a wait past
+        its p99 budget — exactly the predicate SLO shedding prices with.
+        The online explorer (tune/online.py) consults this before
+        routing a request through a runner-up impl: a tenant fighting
+        for its SLO never donates shadow traffic."""
+        state = self._tenants.get(tenant)
+        if state is None or state.spec.slo_ms is None:
+            return False
+        with self._cond:
+            return self._slo_wait_estimate_s(state) * 1e3 \
+                > state.spec.slo_ms
+
+    def breaker_open(self, bucket, dtype: str) -> bool:
+        """True when this bucket's circuit breaker is not closed (open
+        OR half-open: a recovering bucket gets its single probe, not
+        extra experimental traffic). The explorer's second guard."""
+        with self._cond:
+            br = self._breakers.get((tuple(bucket), dtype))
+            return br is not None and br.state != "closed"
+
     # ------------------------------------------------------------ stats
 
     @property

@@ -107,6 +107,14 @@ class ServeConfig:
     trace_out: str | None = None
     prewarm: bool = False
     obs_dir: str | None = None  # snapshot exporter output (obs/export.py)
+    # online explorer (tune/online.py): fraction of requests eligible
+    # for shadow-routing through the runner-up impl (0 = off), and the
+    # tune DB measured winners are promoted into (None = no promotion)
+    explore: float = 0.0
+    explore_db: str | None = None
+    # serialized-executable store root (tune/artifacts.py); None = no
+    # store — warm_start compiles as before
+    artifacts: str | None = None
 
     @property
     def mix_entries(self) -> tuple[MixEntry, ...]:
@@ -156,24 +164,67 @@ class _OperandPool:
         return ops
 
 
+def _resolve_key_impl(key: ExecKey,
+                      device_kind: str) -> tuple[str, tuple | None]:
+    """(impl, blocks) a key compiles to: explicit impls run the tuned
+    default tiling; `auto` resolves the route once per executable —
+    tuning-DB cell first, baked table fallback — so the compiled program
+    carries the DB winner's tiling, not just its impl name (the key's
+    padded dims ARE the traced shape)."""
+    impl, blocks = key.impl, None
+    if impl == "auto":
+        from tpu_matmul_bench.ops.impl_select import select_impl
+
+        choice = select_impl(key.m, key.n, key.k, device_kind, key.dtype)
+        impl, blocks = choice.impl, choice.blocks
+    return impl, blocks
+
+
 def _make_cache(config: ServeConfig, device_kind: str,
                 pool: _OperandPool) -> ExecutableCache:
     def build(key: ExecKey):
-        impl, blocks = key.impl, None
-        if impl == "auto":
-            # resolve the route once per executable at build time —
-            # tuning-DB cell first, baked table fallback — so the
-            # compiled program carries the DB winner's tiling, not just
-            # its impl name (the key's padded dims ARE the traced shape)
-            from tpu_matmul_bench.ops.impl_select import select_impl
-
-            choice = select_impl(key.m, key.n, key.k, device_kind,
-                                 key.dtype)
-            impl, blocks = choice.impl, choice.blocks
+        impl, blocks = _resolve_key_impl(key, device_kind)
         return matmul_2d(impl, blocks, device_kind)
 
+    store = meta = None
+    if config.artifacts is not None:  # "" = the committed default store
+        from tpu_matmul_bench.tune.artifacts import ArtifactMeta, ArtifactStore
+
+        store = ArtifactStore.load(config.artifacts or None)
+
+        def meta(key: ExecKey):
+            # the artifact identity is the RESOLVED program (impl +
+            # blocks), digested the same way the tune DB digests its
+            # cells — so jax/program drift changes the key and a stale
+            # artifact can only miss
+            impl, blocks = _resolve_key_impl(key, device_kind)
+            return ArtifactMeta.build(
+                key.m, key.k, key.n, key.dtype, impl=impl, blocks=blocks,
+                device_kind=device_kind, mesh_shape=key.mesh_shape)
+
     return ExecutableCache(build, capacity=config.cache_capacity,
-                           operands=pool.get)
+                           operands=pool.get, artifacts=store,
+                           artifact_meta=meta)
+
+
+def _make_explorer(config: ServeConfig, device_kind: str, q):
+    """The online explorer for this run (`--explore`), bound to the
+    admission path's SLO-debt/breaker guards, or None when off."""
+    if not config.explore:
+        return None
+    from tpu_matmul_bench.tune.online import OnlineExplorer
+
+    db = None
+    if config.explore_db:
+        from tpu_matmul_bench.tune.db import TuningDB
+
+        db = TuningDB.load(config.explore_db)
+    explorer = OnlineExplorer(epsilon=config.explore,
+                              device_kind=device_kind, db=db,
+                              seed=config.seed,
+                              configured_impl=config.matmul_impl)
+    explorer.bind(q)
+    return explorer
 
 
 def _worker_drain(
@@ -186,9 +237,14 @@ def _worker_drain(
     mesh_shape: tuple[int, ...],
     on_complete=None,
     stream: JsonWriter | None = None,
+    explorer=None,
 ) -> None:
     """Drain the queue to exhaustion (producer closes it). Runs on the
-    main thread — the only JAX-touching thread in the harness."""
+    main thread — the only JAX-touching thread in the harness. With an
+    `explorer` (tune/online.py) each request may be shadow-routed
+    through the bucket's runner-up impl — a separate executable under
+    its own ExecKey — and every completion's warm service time feeds
+    the explorer's per-arm evidence."""
     reg = get_registry()
     m_requests = reg.counter("serve_requests_total")
     m_failures = reg.counter("serve_request_failures_total")
@@ -206,7 +262,6 @@ def _worker_drain(
         m, k, n = batch[0].bucket
         key = ExecKey(m=m, k=k, n=n, dtype=batch[0].dtype, impl=impl,
                       mesh_shape=mesh_shape)
-        was_cached = key in cache
         a, b = pool.get(key)
         hist = latency_hists.get(key.label)
         if hist is None:
@@ -217,14 +272,23 @@ def _worker_drain(
         with telemetry.span("serve:batch", seq=batch_seq,
                             bucket=key.label, n=len(batch)):
             for req in batch:
+                use_key = key
+                explored = False
+                if explorer is not None:
+                    alt = explorer.consider(key, req.tenant)
+                    if alt is not None:
+                        # shadow-route: same bucket, same operands,
+                        # the runner-up impl's own executable
+                        use_key = dataclasses.replace(key, impl=alt)
+                        explored = True
+                # per-request residency check: the bucket's first
+                # request of each executable pays the cold compile
+                # inside its own latency (cold is a per-request service
+                # property, not an artifact of how requests batched)
+                was_cached = use_key in cache
                 t0 = time.perf_counter()
                 try:
-                    # per-request get: the batch's first miss pays the
-                    # cold compile inside its own latency; the rest are
-                    # counted hits (hit rate is then a per-request
-                    # service property, not an artifact of how requests
-                    # happened to batch)
-                    entry = cache.get(key)
+                    entry = cache.get(use_key)
                     out = entry.compiled(a, b)
                     sync(out)
                 except Exception as e:  # noqa: BLE001 — fault boundary
@@ -236,7 +300,7 @@ def _worker_drain(
                     m_failures.inc()
                     if note_result is not None:
                         note_result(req.bucket, req.dtype, ok=False)
-                    report(f"serve: request {req.rid} ({key.label}) "
+                    report(f"serve: request {req.rid} ({use_key.label}) "
                            f"failed [{classify(e)}]: {e}",
                            file=sys.stderr)
                     if on_complete is not None:
@@ -245,12 +309,15 @@ def _worker_drain(
                 done = time.perf_counter()
                 wait_s = max(req.dispatched_at - req.submitted_at, 0.0)
                 samples.append(Sample(
-                    rid=req.rid, bucket=key.label,
+                    rid=req.rid, bucket=use_key.label,
                     latency_s=done - req.submitted_at,
                     service_s=done - t0,
                     cold=not was_cached,
                     tenant=req.tenant,
                     wait_s=wait_s))
+                if explorer is not None:
+                    explorer.observe(key, done - t0, cold=not was_cached,
+                                     explored=explored)
                 m_requests.inc()
                 if note_result is not None:
                     note_result(req.bucket, req.dtype, ok=True)
@@ -260,7 +327,6 @@ def _worker_drain(
                     whist = wait_hists[req.tenant] = reg.histogram(
                         "serve_wait_ms", tenant=req.tenant)
                 whist.observe(wait_s * 1e3)
-                was_cached = True  # only batch's first request was cold
                 if on_complete is not None:
                     on_complete(req)
         if stream is not None:
@@ -392,15 +458,22 @@ def serve_stats(
     executed_flops: float,
     tenants: Sequence[TenantSpec] = DEFAULT_TENANTS,
     bucket_flops: dict[str, tuple[float, float]] | None = None,
+    matmul_impl: str = "auto",
+    device_kind: str = "",
+    explore: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """The ledger's `extras["serve"]` block — every serving headline in
     one self-describing dict (digest_jsonl renders it as the latency
     table; campaign/store.py reads p99_ms + p99_noise_pct for the gate,
-    goodput_qps + slo_attainment_pct for the SLO rows)."""
+    goodput_qps + slo_attainment_pct for the SLO rows). `matmul_impl` +
+    `device_kind` price each bucket's `impl_source` (the routing-tier
+    provenance: db / table / online / artifact / flag); `explore` is the
+    explorer's summary block, attached verbatim."""
     lat = [s.latency_s for s in samples]
     submitted = q.submitted + q.shed  # offered = admitted + shed
     qstats = q.stats()
     tenant_rows, good = _tenant_rows(samples, qstats, tenants)
+    cache_stats = cache.stats()
     stats: dict[str, Any] = {
         "load_mode": load_mode,
         "scheduler": qstats.get("scheduler", "fixed"),
@@ -426,18 +499,65 @@ def serve_stats(
             100.0 * (executed_flops - requested_flops) / requested_flops, 2)
         if requested_flops else 0.0,
         "queue": qstats,
-        "cache": cache.stats(),
-        "buckets": _bucket_breakdown(samples, bucket_flops),
+        "cache": cache_stats,
+        "buckets": _bucket_breakdown(
+            samples, bucket_flops,
+            sources=_impl_sources(samples, cache_stats, matmul_impl,
+                                  device_kind,
+                                  explore_active=explore is not None)),
         "tenants": tenant_rows,
     }
+    if explore is not None:
+        stats["explore"] = explore
     if offered_qps is not None:
         stats["offered_qps"] = round(offered_qps, 2)
     return stats
 
 
+def _impl_sources(samples: Sequence[Sample], cache_stats: dict[str, Any],
+                  matmul_impl: str, device_kind: str, *,
+                  explore_active: bool) -> dict[str, str]:
+    """Per-bucket routing-tier provenance for the ledger:
+
+    - ``artifact`` — the bucket's executable was deserialized from the
+      tune/artifacts store (acquisition provenance wins: no compile
+      happened in this process);
+    - ``online``  — a shadow-routed explorer bucket, or an incumbent
+      resolved from a ``measured-online`` DB cell;
+    - ``db`` / ``table`` — the tuning-DB cell vs baked-table tiers;
+    - ``flag``    — an explicit --matmul-impl pinned the impl.
+    """
+    by_entry = cache_stats.get("by_entry", {})
+    out: dict[str, str] = {}
+    for label in {s.bucket for s in samples}:
+        entry = by_entry.get(label, {})
+        if entry.get("source") == "artifact":
+            out[label] = "artifact"
+            continue
+        impl_token = label.rsplit("/", 1)[1]
+        if explore_active and impl_token != matmul_impl:
+            out[label] = "online"  # the explorer's shadow executable
+            continue
+        if matmul_impl != "auto":
+            out[label] = "flag"
+            continue
+        try:
+            dims, dtype = label.split("/")[:2]
+            m, k, n = (int(v) for v in dims.split("x"))
+        except ValueError:
+            out[label] = "table"
+            continue
+        from tpu_matmul_bench.ops.impl_select import resolve_route
+
+        choice, _cell = resolve_route(m, n, k, device_kind, dtype)
+        out[label] = choice.source
+    return out
+
+
 def _bucket_breakdown(
     samples: Sequence[Sample],
     bucket_flops: dict[str, tuple[float, float]] | None = None,
+    sources: dict[str, str] | None = None,
 ) -> dict[str, Any]:
     by: dict[str, list[float]] = {}
     for s in samples:
@@ -445,6 +565,8 @@ def _bucket_breakdown(
     out: dict[str, Any] = {}
     for label, lat in sorted(by.items()):
         row = {"count": len(lat), **_percentiles_ms(lat)}
+        if sources and label in sources:
+            row["impl_source"] = sources[label]
         req_exe = (bucket_flops or {}).get(label)
         if req_exe and req_exe[1] > 0:
             # padded-vs-requested efficiency: the share of this bucket's
@@ -510,8 +632,18 @@ def _report_summary(stats: dict[str, Any]) -> None:
         f"({cache['hit_rate_pct']}% hit rate, "
         f"{cache['evictions']} evictions)",
         *([f"  - Preload: {cache['preload']['count']} executable(s) "
-           f"warm-started in {cache['preload']['total_ms']} ms"]
+           f"warm-started in {cache['preload']['total_ms']} ms "
+           f"({cache['preload']['compiled']} compiled "
+           f"{cache['preload']['compile_ms']} ms / "
+           f"{cache['preload']['deserialized']} deserialized "
+           f"{cache['preload']['deserialize_ms']} ms)"]
           if cache.get("preload", {}).get("count") else []),
+        *([f"  - Explore: {stats['explore']['explored']} of "
+           f"{stats['explore']['seen']} requests shadow-routed "
+           f"({stats['explore']['explored_pct']}% ≤ "
+           f"eps={stats['explore']['epsilon']:g}), blocked "
+           f"{stats['explore']['blocked']}"]
+          if stats.get("explore") else []),
         f"  - Padding overhead: {stats['padding_overhead_pct']}% extra FLOPs",
     ]
     for label, e in cache["by_entry"].items():
@@ -591,16 +723,20 @@ def _setup(config: ServeConfig,
     if tenants is None:
         tenants = config.tenant_specs
     q = _make_admission(config, grid, tenants)
-    return devices, info, pool, cache, q, tenants
+    explorer = _make_explorer(config, info.device_kind, q)
+    return devices, info, pool, cache, q, tenants, explorer
 
 
 def _prewarm(config: ServeConfig, grid: ShapeGrid, cache: ExecutableCache,
              world: int,
-             tenants: Sequence[TenantSpec] = DEFAULT_TENANTS) -> int:
-    """Compile every mix bucket before load so the measured window is
-    steady-state (the campaign gate's serve spec uses this — a p99 that
-    sometimes contains a cold compile gates nothing). Tenant-local mixes
-    contribute their buckets too."""
+             tenants: Sequence[TenantSpec] = DEFAULT_TENANTS,
+             device_kind: str = "") -> int:
+    """Acquire every mix bucket's executable before load so the measured
+    window is steady-state (the campaign gate's serve spec uses this — a
+    p99 that sometimes contains a cold compile gates nothing).
+    Tenant-local mixes contribute their buckets too; with the explorer
+    on, each bucket's runner-up executable is preloaded as well, so a
+    shadow-routed request never pays the alternate's cold compile."""
     entries = list(config.mix_entries)
     for t in tenants:
         if t.mix:
@@ -608,6 +744,13 @@ def _prewarm(config: ServeConfig, grid: ShapeGrid, cache: ExecutableCache,
     keys = {ExecKey(*grid.bucket(e.m, e.k, e.n), dtype=config.dtype_name,
                     impl=config.matmul_impl, mesh_shape=(world,))
             for e in entries}
+    if config.explore:
+        from tpu_matmul_bench.tune.online import _ALTERNATE
+
+        for key in list(keys):
+            impl, _blocks = _resolve_key_impl(key, device_kind)
+            keys.add(dataclasses.replace(
+                key, impl=_ALTERNATE.get(impl, "xla")))
     with telemetry.span("prewarm", buckets=len(keys)):
         return cache.warm_start(keys)
 
@@ -665,6 +808,7 @@ def _run_load(
     tenants: Sequence[TenantSpec],
     world: int,
     stream: JsonWriter | None = None,
+    explorer=None,
 ) -> tuple[list[Sample], float, dict[int, tuple[int, int, int]]]:
     """One producer+worker load run against an already-built admission
     path: (samples, wall_s, rid → requested shape)."""
@@ -689,7 +833,7 @@ def _run_load(
             _worker_drain(q, cache, pool, samples,
                           impl=config.matmul_impl, mesh_shape=(world,),
                           on_complete=lambda _r: sem.release(),
-                          stream=stream)
+                          stream=stream, explorer=explorer)
         else:
             schedule = tenant_open_loop_schedule(
                 tenants, qps=config.qps, duration_s=config.duration_s,
@@ -706,15 +850,37 @@ def _run_load(
             producer.start()
             _worker_drain(q, cache, pool, samples,
                           impl=config.matmul_impl, mesh_shape=(world,),
-                          stream=stream)
+                          stream=stream, explorer=explorer)
         producer.join()
         wall_s = time.perf_counter() - t0
     return samples, wall_s, schedule_shapes
 
 
+def _explore_block(config: ServeConfig, explorer) -> dict[str, Any] | None:
+    """The explorer's ledger block, with promotion applied when a target
+    DB and a citable ledger path are configured. Promotion is explicit
+    opt-in (`--explore-db`): shadow evidence never mutates the committed
+    DB as a side effect of serving."""
+    if explorer is None:
+        return None
+    block = explorer.summary()
+    if config.explore_db and config.json_out \
+            and ".jsonl" in config.json_out:
+        from tpu_matmul_bench.tune.db import TuningDB
+
+        db = TuningDB.load(config.explore_db)
+        result = explorer.promote(db, ledger_ref=config.json_out)
+        block["promoted"] = [
+            f"{c.dtype}@{c.m}x{c.k}x{c.n}/{c.device_kind} -> {c.impl}"
+            for c in result["promoted"]]
+        block["skipped"] = result["skipped"]
+        block["db"] = config.explore_db
+    return block
+
+
 def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
     """The `serve bench` program: one load run → one ledger."""
-    devices, info, pool, cache, q, tenants = _setup(config)
+    devices, info, pool, cache, q, tenants, explorer = _setup(config)
     world = len(devices)
     _bench_header(config, config.scheduler, tenants)
     # the ledger opens BEFORE load (manifest first, then per-batch
@@ -725,17 +891,21 @@ def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
                        manifest=telemetry.build_manifest(
                            extra={"serve_config": _config_manifest(config)}),
                        append=config.append_ledger) as writer:
-        prewarmed = _prewarm(config, q.grid, cache, world, tenants) \
+        prewarmed = _prewarm(config, q.grid, cache, world, tenants,
+                             info.device_kind) \
             if config.prewarm else 0
         samples, wall_s, schedule_shapes = _run_load(
-            config, pool, cache, q, tenants, world, stream=writer)
+            config, pool, cache, q, tenants, world, stream=writer,
+            explorer=explorer)
         requested_f, executed_f, bucket_f = _flops(samples, schedule_shapes)
         stats = serve_stats(
             samples, q, cache, load_mode=config.load_mode,
             offered_qps=None if config.concurrency else config.qps,
             wall_s=wall_s, requested_flops=requested_f,
             executed_flops=executed_f, tenants=tenants,
-            bucket_flops=bucket_f)
+            bucket_flops=bucket_f, matmul_impl=config.matmul_impl,
+            device_kind=info.device_kind,
+            explore=_explore_block(config, explorer))
         rec = _serve_record(config, stats, samples, info.device_kind, world,
                             mode=config.load_mode,
                             executed_flops=executed_f, wall_s=wall_s,
@@ -784,17 +954,22 @@ def run_ab(config: ServeConfig) -> list[BenchmarkRecord]:
             pool = _OperandPool(config.seed)
             cache = _make_cache(config, info.device_kind, pool)
             q = _make_admission(config, grid, tenants, scheduler=arm)
-            prewarmed = _prewarm(config, grid, cache, world, tenants) \
+            explorer = _make_explorer(config, info.device_kind, q)
+            prewarmed = _prewarm(config, grid, cache, world, tenants,
+                                 info.device_kind) \
                 if config.prewarm else 0
             samples, wall_s, shapes = _run_load(
-                config, pool, cache, q, tenants, world, stream=writer)
+                config, pool, cache, q, tenants, world, stream=writer,
+                explorer=explorer)
             requested_f, executed_f, bucket_f = _flops(samples, shapes)
             stats = serve_stats(
                 samples, q, cache, load_mode=config.load_mode,
                 offered_qps=None if config.concurrency else config.qps,
                 wall_s=wall_s, requested_flops=requested_f,
                 executed_flops=executed_f, tenants=tenants,
-                bucket_flops=bucket_f)
+                bucket_flops=bucket_f, matmul_impl=config.matmul_impl,
+                device_kind=info.device_kind,
+                explore=explorer.summary() if explorer else None)
             rec = _serve_record(config, stats, samples, info.device_kind,
                                 world, mode=config.load_mode,
                                 executed_flops=executed_f, wall_s=wall_s,
@@ -871,6 +1046,9 @@ def _config_manifest(config: ServeConfig,
         "seed": config.seed,
         "matmul_impl": config.matmul_impl,
         "prewarm": config.prewarm,
+        "explore": config.explore,
+        "explore_db": config.explore_db,
+        "artifacts": config.artifacts,
     }
 
 
@@ -896,7 +1074,8 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
     invariant — the CI hook that keeps the serving path honest without a
     load run."""
     tenants = config.tenant_specs if config.tenants else SELFTEST_TENANTS
-    devices, info, pool, cache, q, tenants = _setup(config, tenants)
+    devices, info, pool, cache, q, tenants, _explorer = _setup(config,
+                                                               tenants)
     world = len(devices)
     report(header("Serve selftest (no load)", {
         "Requests": SELFTEST_REQUESTS,
@@ -931,7 +1110,9 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
                             offered_qps=None, wall_s=wall_s,
                             requested_flops=requested_f,
                             executed_flops=executed_f, tenants=tenants,
-                            bucket_flops=bucket_f)
+                            bucket_flops=bucket_f,
+                            matmul_impl=config.matmul_impl,
+                            device_kind=info.device_kind)
         rec = _serve_record(config, stats, samples, info.device_kind, world,
                             mode="selftest", executed_flops=executed_f,
                             wall_s=wall_s, prewarmed=preloaded)
@@ -946,6 +1127,29 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
         problems.append(
             f"warm-start failed: {s['cold_requests']} of {len(samples)} "
             "requests paid a cold compile after the preload phase")
+    # the preload split contract: every preloaded executable was either
+    # compiled or deserialized (and only deserialized when an artifact
+    # store was configured), and the phase wall times sum to the total
+    pre = s["cache"]["preload"]
+    if pre["count"] != pre["compiled"] + pre["deserialized"]:
+        problems.append(
+            f"preload split does not reconcile: {pre['count']} preloaded "
+            f"!= {pre['compiled']} compiled + {pre['deserialized']} "
+            "deserialized")
+    if config.artifacts is None and pre["deserialized"]:
+        problems.append(
+            f"{pre['deserialized']} executable(s) claim deserialization "
+            "with no artifact store configured")
+    if abs(pre["total_ms"]
+           - (pre["compile_ms"] + pre["deserialize_ms"])) > 0.01:
+        problems.append(
+            f"preload wall time split does not sum: {pre['total_ms']} "
+            f"!= {pre['compile_ms']} + {pre['deserialize_ms']} ms")
+    # every served bucket row must carry its routing-tier provenance
+    for label, row in s["buckets"].items():
+        if "impl_source" not in row:
+            problems.append(f"bucket {label} lacks impl_source — "
+                            "routing provenance must be auditable")
     # the scheduler's stats contract: whichever admission path ran must
     # say which one it was, and the per-tenant SLO rows must cover every
     # configured tenant with a live attainment figure

@@ -1,0 +1,395 @@
+"""Content-addressed serialized-executable store — zero-cold-compile startup.
+
+The tuning DB (tune/db.py) remembers *which* program wins a routing
+question; this store remembers the *compiled executable itself*, so a
+fresh serving process can reach warm dispatch without paying a single
+AOT compile. Executables are serialized via
+``jax.experimental.serialize_executable`` (payload + in/out pytree
+defs, pickled as one blob) and stored content-addressed:
+
+- **blobs/** — one file per payload, named by the SHA-256 of its bytes,
+  so a blob can never silently change under its manifest record;
+- **manifest.jsonl** — append-only, one fsync'd line per artifact
+  (`campaign/state.py` durability; registered in the PR-11
+  `faults/audit.WRITER_REGISTRY`), last record per key wins.
+
+The **artifact key** reuses the DRIFT hashing convention
+(`analysis/fingerprint.digest`) over exactly the identity that makes a
+serialized executable reusable: the tune-DB problem fingerprint, the
+jax version, the routed program's structural digest (tune/db.py's
+DRIFT-shaped staleness axis), the backend, and the mesh shape. Drift in
+any of these hashes to a *different* key, so a stale artifact is simply
+never looked up — and the ART-002 lint surfaces it for pruning, while
+ART-001 guards the integrity chain (key ← fields, blob ← digest).
+
+A corrupted or truncated blob is rejected at read time (digest
+mismatch → the caller recompiles); a torn manifest tail is tolerated on
+load and repaired before append, the same crash discipline as every
+durable JSONL store in the repo.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+from typing import Any, Iterable
+
+from tpu_matmul_bench.utils.durable import repair_torn_tail
+
+ARTIFACT_RECORD_TYPE = "exec_artifact"
+ARTIFACT_SCHEMA = 1
+
+MANIFEST_NAME = "manifest.jsonl"
+BLOBS_DIRNAME = "blobs"
+
+#: repo-relative default store (committed — the shipped warm-start set)
+STORE_RELPATH = os.path.join("measurements", "artifacts")
+
+
+def default_root(root: str | None = None) -> str:
+    """Absolute store root; `root` defaults to the repo root inferred
+    from this package's location (same inference as tune.db)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    return os.path.join(root, STORE_RELPATH)
+
+
+def artifact_key(fingerprint: str, jax_version: str, program_digest: str,
+                 backend: str, mesh_shape: tuple[int, ...]) -> str:
+    """Stable digest of one artifact identity. Every axis that makes a
+    serialized executable non-reusable is part of the key, so staleness
+    is a *miss*, never a wrong hit."""
+    from tpu_matmul_bench.analysis.fingerprint import digest
+
+    return digest({
+        "kind": ARTIFACT_RECORD_TYPE,
+        "fingerprint": fingerprint,
+        "jax_version": jax_version,
+        "program_digest": program_digest,
+        "backend": backend,
+        "mesh_shape": list(mesh_shape),
+    })
+
+
+def blob_digest(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()
+
+
+def pack_executable(compiled: Any) -> bytes:
+    """Serialize one AOT-compiled executable into a self-contained blob:
+    (payload, in_tree, out_tree) from jax's serializer, pickled together
+    so a single file round-trips the whole callable."""
+    from jax.experimental import serialize_executable
+
+    payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+    return pickle.dumps((payload, in_tree, out_tree),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def unpack_executable(blob: bytes) -> Any:
+    """Deserialize-and-load a blob back into a dispatchable executable.
+    Raises on any malformed input — callers treat every failure as a
+    store miss and recompile."""
+    from jax.experimental import serialize_executable
+
+    payload, in_tree, out_tree = pickle.loads(blob)
+    return serialize_executable.deserialize_and_load(
+        payload, in_tree, out_tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class ArtifactMeta:
+    """The identity + provenance fields of one stored executable."""
+
+    m: int
+    k: int
+    n: int
+    dtype: str                 # canonical dtype name (tune.db convention)
+    impl: str                  # resolved impl ("xla" | "pallas")
+    blocks: tuple[int, int, int] | None
+    device_kind: str
+    backend: str               # jax.default_backend() at export time
+    mesh_shape: tuple[int, ...]
+    fingerprint: str           # tune-DB problem fingerprint
+    program_digest: str        # tune.db.program_digest of the routed program
+    jax_version: str
+
+    @classmethod
+    def build(cls, m: int, k: int, n: int, dtype: Any, *, impl: str,
+              blocks: tuple[int, int, int] | None = None,
+              device_kind: str = "", backend: str | None = None,
+              mesh_shape: tuple[int, ...] = (1,)) -> "ArtifactMeta":
+        """Compute the full identity for one executable (one trace for
+        the program digest — the same recompute lint's DRIFT gate does)."""
+        import jax
+
+        from tpu_matmul_bench.tune.db import (
+            canonical_dtype,
+            problem_fingerprint,
+            program_digest,
+        )
+
+        dt = canonical_dtype(dtype)
+        return cls(
+            m=int(m), k=int(k), n=int(n), dtype=dt, impl=impl,
+            blocks=tuple(blocks) if blocks else None,
+            device_kind=device_kind,
+            backend=backend or jax.default_backend(),
+            mesh_shape=tuple(mesh_shape),
+            fingerprint=problem_fingerprint(m, k, n, dt),
+            program_digest=program_digest(m, k, n, dt, impl, blocks,
+                                          device_kind or "TPU v5e"),
+            jax_version=jax.__version__,
+        )
+
+    @property
+    def key(self) -> str:
+        return artifact_key(self.fingerprint, self.jax_version,
+                            self.program_digest, self.backend,
+                            self.mesh_shape)
+
+
+class ArtifactStore:
+    """The executable store: blobs on disk, a superseding manifest dict
+    in memory. `put` appends (fsync blob, then fsync manifest line — a
+    crash in between leaves an orphan blob, never a dangling record);
+    `get_blob` verifies content digests on every read."""
+
+    def __init__(self, root: str | None = None) -> None:
+        self.root = root or default_root()
+        self.manifest_path = os.path.join(self.root, MANIFEST_NAME)
+        self.blobs_dir = os.path.join(self.root, BLOBS_DIRNAME)
+        self._records: dict[str, dict[str, Any]] = {}
+        self.records_read = 0
+        self.parse_errors: list[str] = []
+        self.rejected: list[str] = []  # digest-failed blob reads
+
+    # -------------------------------------------------------------- load
+
+    @classmethod
+    def load(cls, root: str | None = None) -> "ArtifactStore":
+        """Read the manifest (missing store → empty: every lookup is a
+        miss and warm_start falls back to compiling)."""
+        store = cls(root)
+        if not os.path.exists(store.manifest_path):
+            return store
+        with open(store.manifest_path) as fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    # torn trailing line from a crash — same tolerance
+                    # as the tune DB / campaign journal readers
+                    store.parse_errors.append(f"line {lineno}: unparseable")
+                    continue
+                if not isinstance(rec, dict) \
+                        or rec.get("record_type") != ARTIFACT_RECORD_TYPE:
+                    continue  # manifest headers ride along fine
+                key = rec.get("key")
+                if not key:
+                    store.parse_errors.append(f"line {lineno}: no key")
+                    continue
+                store.records_read += 1
+                store._records[str(key)] = rec
+        return store
+
+    # ------------------------------------------------------------- write
+
+    def put(self, meta: ArtifactMeta, blob: bytes, *,
+            fsync: bool = True) -> dict[str, Any]:
+        """Store one serialized executable: content-addressed blob first
+        (tmp + rename + fsync — the manifest must never cite bytes that
+        could still vanish), then the fsync'd manifest line."""
+        import datetime
+
+        digest = blob_digest(blob)
+        os.makedirs(self.blobs_dir, exist_ok=True)
+        blob_rel = os.path.join(BLOBS_DIRNAME, f"{digest}.bin")
+        blob_path = os.path.join(self.root, blob_rel)
+        if not os.path.exists(blob_path):  # content-addressed: idempotent
+            tmp = blob_path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                if fsync:
+                    os.fsync(fh.fileno())
+            os.replace(tmp, blob_path)
+        rec = {
+            "record_type": ARTIFACT_RECORD_TYPE,
+            "schema": ARTIFACT_SCHEMA,
+            "key": meta.key,
+            "fingerprint": meta.fingerprint,
+            "problem": {"m": meta.m, "k": meta.k, "n": meta.n,
+                        "dtype": meta.dtype},
+            "impl": meta.impl,
+            "blocks": list(meta.blocks) if meta.blocks else None,
+            "device_kind": meta.device_kind,
+            "backend": meta.backend,
+            "mesh_shape": list(meta.mesh_shape),
+            "jax_version": meta.jax_version,
+            "program_digest": meta.program_digest,
+            "blob_digest": digest,
+            "blob": blob_rel,
+            "size_bytes": len(blob),
+            "created_at": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds"),
+        }
+        # crash hygiene: never append after a torn (newline-less) tail
+        repair_torn_tail(self.manifest_path)
+        with open(self.manifest_path, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+            fh.flush()
+            if fsync:
+                os.fsync(fh.fileno())
+        self._records[rec["key"]] = rec
+        return rec
+
+    # ------------------------------------------------------------ lookup
+
+    def lookup(self, meta: ArtifactMeta) -> dict[str, Any] | None:
+        """The live manifest record for this identity, or None. A stale
+        executable (jax/program drift) hashes to a different key, so it
+        can only miss here."""
+        return self._records.get(meta.key)
+
+    def get_blob(self, rec: dict[str, Any]) -> bytes | None:
+        """The record's blob bytes, digest-verified. A missing,
+        truncated, or corrupted blob returns None (and is remembered in
+        `rejected`) — the caller recompiles; it never loads bad bytes."""
+        rel = rec.get("blob") or ""
+        path = os.path.join(self.root, rel)
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except OSError:
+            self.rejected.append(f"{rel}: unreadable")
+            return None
+        if blob_digest(blob) != rec.get("blob_digest"):
+            self.rejected.append(
+                f"{rel}: content digest mismatch (corrupt or truncated)")
+            return None
+        return blob
+
+    def records(self) -> list[dict[str, Any]]:
+        """Live (non-superseded) manifest records, deterministic order."""
+        return [self._records[k] for k in sorted(self._records)]
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    # ---------------------------------------------------------- validate
+
+    def validate(self) -> list[tuple[str, str]]:
+        """ART-001-class integrity problems: (where, message) pairs,
+        empty = every shipped record's digest chain closes. Checks the
+        key against its recorded fields, the problem fingerprint against
+        the problem block, and the blob bytes against their digest."""
+        from tpu_matmul_bench.tune.db import problem_fingerprint
+
+        problems: list[tuple[str, str]] = []
+        for lineno_err in self.parse_errors:
+            problems.append((self.manifest_path, lineno_err))
+        for rec in self.records():
+            where = f"artifact:{rec.get('key', '?')[:12]}"
+            prob = rec.get("problem") or {}
+            try:
+                fp = problem_fingerprint(prob["m"], prob["k"], prob["n"],
+                                         prob["dtype"])
+            except (KeyError, TypeError):
+                problems.append((where, "malformed problem block"))
+                continue
+            if fp != rec.get("fingerprint"):
+                problems.append(
+                    (where, f"stored fingerprint {rec.get('fingerprint')} "
+                            f"!= recomputed {fp}"))
+            expect = artifact_key(
+                str(rec.get("fingerprint", "")),
+                str(rec.get("jax_version", "")),
+                str(rec.get("program_digest", "")),
+                str(rec.get("backend", "")),
+                tuple(rec.get("mesh_shape") or ()))
+            if expect != rec.get("key"):
+                problems.append(
+                    (where, f"manifest key {rec.get('key')} does not "
+                            f"recompute from its fields ({expect})"))
+            path = os.path.join(self.root, rec.get("blob") or "")
+            if not os.path.exists(path):
+                problems.append(
+                    (where, f"blob {rec.get('blob')!r} missing on disk"))
+            elif self.get_blob(rec) is None:
+                problems.append(
+                    (where, f"blob {rec.get('blob')!r} does not hash to "
+                            f"its recorded digest"))
+        return problems
+
+    # --------------------------------------------------------- staleness
+
+    def stale_reasons(self, rec: dict[str, Any], *,
+                      jax_version: str | None = None,
+                      digests: dict[tuple, str] | None = None) -> list[str]:
+        """Why this artifact can no longer be imported (empty = fresh) —
+        the ART-002 axes, identical in shape to tune.db.stale_reasons:
+        jax moved, or the routed program's structure re-digests
+        differently. `digests` lets batch audits inject recomputed
+        digests keyed by (m, k, n, dtype, impl, blocks, device_kind)."""
+        import jax
+
+        reasons: list[str] = []
+        current_jax = jax_version if jax_version is not None \
+            else jax.__version__
+        if rec.get("jax_version") and rec["jax_version"] != current_jax:
+            reasons.append(
+                f"jax {rec['jax_version']} → {current_jax} since export "
+                "(the store will miss; re-export under the current jax)")
+        prob = rec.get("problem") or {}
+        dkey = (prob.get("m"), prob.get("k"), prob.get("n"),
+                prob.get("dtype"), rec.get("impl"),
+                tuple(rec.get("blocks") or ()) or None,
+                rec.get("device_kind"))
+        if rec.get("program_digest"):
+            if digests is not None:
+                current = digests.get(dkey)
+            else:
+                current = _recompute_program_digest(dkey)
+            if current is not None and current != rec["program_digest"]:
+                reasons.append(
+                    f"program digest {rec['program_digest']} → {current}: "
+                    "the routed program's compiled structure changed "
+                    "(DRIFT-style invalidation)")
+        return reasons
+
+
+def _recompute_program_digest(dkey: tuple) -> str | None:
+    from tpu_matmul_bench.tune.db import program_digest
+
+    m, k, n, dtype, impl, blocks, device_kind = dkey
+    try:
+        return program_digest(m, k, n, dtype, impl, blocks,
+                              device_kind or "TPU v5e")
+    except Exception:  # noqa: BLE001 — audit probe, not a crash site
+        return None
+
+
+def recomputed_digests(
+        recs: Iterable[dict[str, Any]]) -> dict[tuple, str]:
+    """Batch program-digest recompute (one trace per distinct program)
+    for `stale_reasons(digests=...)` — the audit-facing fast path."""
+    out: dict[tuple, str] = {}
+    for rec in recs:
+        prob = rec.get("problem") or {}
+        dkey = (prob.get("m"), prob.get("k"), prob.get("n"),
+                prob.get("dtype"), rec.get("impl"),
+                tuple(rec.get("blocks") or ()) or None,
+                rec.get("device_kind"))
+        if dkey not in out:
+            digest = _recompute_program_digest(dkey)
+            if digest is not None:
+                out[dkey] = digest
+    return out

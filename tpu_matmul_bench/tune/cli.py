@@ -1,22 +1,32 @@
-"""`python -m tpu_matmul_bench tune {show,prune,fill,promote,selftest}`.
+"""`python -m tpu_matmul_bench tune
+{show,prune,fill,promote,selftest,online,artifacts}`.
 
 The autotuning-DB front end. The measurement sweep itself is still
 `benchmarks/pallas_tune.py` — any invocation whose first argument is not
-one of the five subcommands falls through to it verbatim, so every
+one of the subcommands falls through to it verbatim, so every
 pre-existing `tune --size ... --candidates ...` spelling (and every
 campaign spec that uses it) keeps working.
 
-- `show`     — the live cells: problem, winner, provenance, staleness
-- `prune`    — rank a candidate space with the cost models and print
-               what would be measured (trials-before → trials-after)
-- `fill`     — run the specs/tune.toml measurement campaign over the
-               pruned candidates, then promote the winners into the DB
-- `promote`  — promote winners from existing tune ledgers into the DB
-- `selftest` — DB schema + provenance consistency (+ drift recompute)
+- `show`      — the live cells: problem, winner, provenance, staleness
+                (`--stale-only`, `--provenance KIND` filter the listing)
+- `prune`     — rank a candidate space with the cost models and print
+                what would be measured (trials-before → trials-after)
+- `fill`      — run the specs/tune.toml measurement campaign over the
+                pruned candidates, then promote the winners into the DB
+- `promote`   — promote winners from existing tune ledgers into the DB
+- `selftest`  — DB schema + provenance consistency (+ drift recompute)
+- `online`    — the serve-time shadow-traffic explorer (tune/online.py):
+                `online selftest` certifies the ε budget and the
+                SLO-debt/breaker guards against a seeded adversarial
+                stream
+- `artifacts` — the serialized-executable store (tune/artifacts.py):
+                `artifacts show` lists the manifest, `artifacts verify`
+                exits 1 on any integrity (ART-001-class) problem
 
-Exit codes: `selftest` exits 1 on any problem; `fill`/`promote` exit 1
-when the campaign failed or nothing was promotable; `show`/`prune` are
-informational and exit 0.
+Exit codes: `selftest`/`online selftest`/`artifacts verify` exit 1 on
+any problem; `fill`/`promote` exit 1 when the campaign failed or nothing
+was promotable; `show`/`prune`/`artifacts show` are informational and
+exit 0.
 """
 
 from __future__ import annotations
@@ -24,7 +34,8 @@ from __future__ import annotations
 import argparse
 from typing import Sequence
 
-SUBCOMMANDS = ("show", "prune", "fill", "promote", "selftest")
+SUBCOMMANDS = ("show", "prune", "fill", "promote", "selftest",
+               "online", "artifacts")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -40,6 +51,13 @@ def build_parser() -> argparse.ArgumentParser:
     show.add_argument("--check-drift", action="store_true",
                       help="also recompute every cell's program digest "
                            "(traces each routed program once)")
+    show.add_argument("--stale-only", action="store_true",
+                      help="list only stale cells (implies nothing about "
+                           "drift depth — combine with --check-drift for "
+                           "the digest recompute)")
+    show.add_argument("--provenance", default=None, metavar="KIND",
+                      help="list only cells of this provenance kind "
+                           "(measured, analytic, measured-online)")
 
     prune = sub.add_parser(
         "prune", help="cost-model rank a candidate space (no device time)")
@@ -91,6 +109,34 @@ def build_parser() -> argparse.ArgumentParser:
     self_.add_argument("--no-drift", action="store_true",
                        help="skip the program-digest recompute (schema + "
                             "provenance checks only)")
+
+    online = sub.add_parser(
+        "online", help="serve-time shadow-traffic explorer checks")
+    online_sub = online.add_subparsers(dest="online_command", required=True)
+    online_self = online_sub.add_parser(
+        "selftest", help="certify ε budget + SLO/breaker guards against "
+                         "a seeded adversarial stream (CI hook)")
+    online_self.add_argument("--epsilon", type=float, default=0.1,
+                             help="exploration budget under test "
+                                  "(default %(default)s)")
+    online_self.add_argument("--requests", type=int, default=4000,
+                             help="stream length (default %(default)s)")
+    online_self.add_argument("--seed", type=int, default=0)
+
+    arts = sub.add_parser(
+        "artifacts", help="serialized-executable store maintenance")
+    arts_sub = arts.add_subparsers(dest="artifacts_command", required=True)
+    for name, helptext in (
+            ("show", "list the manifest: problem, impl, size, staleness"),
+            ("verify", "exit 1 on any integrity problem (ART-001 class); "
+                       "staleness is reported but does not fail")):
+        ap = arts_sub.add_parser(name, help=helptext)
+        ap.add_argument("--store", default=None,
+                        help="store root (default: the committed "
+                             "measurements/artifacts)")
+        ap.add_argument("--check-drift", action="store_true",
+                        help="also recompute each artifact's program "
+                             "digest (traces each program once)")
     return p
 
 
@@ -112,11 +158,16 @@ def _cmd_show(args) -> int:
         for err in db.parse_errors:
             print(f"  PARSE: {err}")
     digests = recomputed_digests(db.cells()) if args.check_drift else None
-    stale_total = 0
+    stale_total = shown = 0
     for cell in db.cells():
         reasons = db.stale_reasons(
             cell, digests=digests if digests is not None else {})
         stale_total += bool(reasons)
+        if args.provenance and cell.provenance_kind != args.provenance:
+            continue
+        if args.stale_only and not reasons:
+            continue
+        shown += 1
         blocks = "x".join(str(b) for b in cell.blocks) if cell.blocks \
             else "-"
         flag = " STALE" if reasons else ""
@@ -126,6 +177,12 @@ def _cmd_show(args) -> int:
               f"[{cell.provenance_kind}]{flag}")
         for r in reasons:
             print(f"      stale: {r}")
+    if args.stale_only or args.provenance:
+        filters = " ".join(
+            f for f in (("stale-only" if args.stale_only else ""),
+                        (f"provenance={args.provenance}"
+                         if args.provenance else "")) if f)
+        print(f"{shown} of {len(db)} cells match [{filters}]")
     drift_note = "" if args.check_drift else \
         " (jax-version check only; --check-drift recomputes digests)"
     print(f"{stale_total} stale under jax {jax.__version__}{drift_note}")
@@ -241,6 +298,59 @@ def _cmd_selftest(args) -> int:
     return 0
 
 
+def _cmd_online(args) -> int:
+    from tpu_matmul_bench.tune.online import run_selftest
+
+    return run_selftest(epsilon=args.epsilon, requests=args.requests,
+                        seed=args.seed)
+
+
+def _cmd_artifacts(args) -> int:
+    import jax
+
+    from tpu_matmul_bench.tune.artifacts import (
+        ArtifactStore,
+        recomputed_digests,
+    )
+
+    store = ArtifactStore.load(args.store)
+    print(f"artifact store {store.root}: {len(store)} live artifacts "
+          f"({store.records_read} records)")
+    digests = recomputed_digests(store.records()) if args.check_drift \
+        else None
+    stale_total = 0
+    for rec in store.records():
+        reasons = store.stale_reasons(
+            rec, digests=digests if digests is not None else {})
+        stale_total += bool(reasons)
+        prob = rec.get("problem") or {}
+        blocks = "x".join(str(b) for b in rec["blocks"]) \
+            if rec.get("blocks") else "-"
+        flag = " STALE" if reasons else ""
+        print(f"  {rec.get('key', '?')[:16]}  {prob.get('dtype', '?'):>8} "
+              f"{prob.get('m')}x{prob.get('k')}x{prob.get('n'):<6} "
+              f"→ {rec.get('impl', '?'):<6} blocks={blocks:<14} "
+              f"{rec.get('size_bytes', 0) / 1024:.0f} KiB "
+              f"jax={rec.get('jax_version')}{flag}")
+        for r in reasons:
+            print(f"      stale: {r}")
+    drift_note = "" if args.check_drift else \
+        " (jax-version check only; --check-drift recomputes digests)"
+    print(f"{stale_total} stale under jax {jax.__version__}{drift_note}")
+    if args.artifacts_command != "verify":
+        return 0
+    problems = store.validate()
+    if problems:
+        print(f"tune artifacts verify FAILED — {len(problems)} "
+              f"problem(s):")
+        for where, message in problems:
+            print(f"  {where}: {message}")
+        return 1
+    print(f"tune artifacts verify ok: {len(store)} artifacts, digest "
+          "chain closes (key ← fields, blob ← digest)")
+    return 0
+
+
 def main(argv: Sequence[str] | None = None):
     import sys
 
@@ -252,7 +362,9 @@ def main(argv: Sequence[str] | None = None):
         return pallas_tune.main(argv)
     args = build_parser().parse_args(argv)
     rc = {"show": _cmd_show, "prune": _cmd_prune, "fill": _cmd_fill,
-          "promote": _cmd_promote, "selftest": _cmd_selftest}[args.command](args)
+          "promote": _cmd_promote, "selftest": _cmd_selftest,
+          "online": _cmd_online,
+          "artifacts": _cmd_artifacts}[args.command](args)
     if rc:
         raise SystemExit(rc)
     return rc

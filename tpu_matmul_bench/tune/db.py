@@ -17,11 +17,15 @@ or kernel refactor that changes the compiled structure marks exactly the
 affected cells stale (DRIFT-001 semantics) instead of dropping the DB.
 
 Provenance is mandatory and typed: every cell is either ``measured``
-(cites a committed ledger artifact under measurements/) or ``analytic``
+(cites a committed ledger artifact under measurements/), ``analytic``
 (cites an explicit prior — VMEM feasibility + roofline intensity from
-`tune/prune.py`, plus any supporting artifact). A cell that can cite
-neither does not get written — that is the REG-002 gap this subsystem
-retires, and the lint rules TUNE-001/TUNE-002 keep it retired.
+`tune/prune.py`, plus any supporting artifact), or ``measured-online``
+(promoted by the shadow-traffic explorer in `tune/online.py`, citing
+the serve ledger its shadow samples came from — TUNE-003 fails any
+online cell whose artifact names no ``.jsonl`` ledger). A cell that can
+cite nothing does not get written — that is the REG-002 gap this
+subsystem retires, and the lint rules TUNE-001/TUNE-002/TUNE-003 keep
+it retired.
 
 Durability follows `campaign/state.py`: JSONL, one fsync'd line per
 cell, append-only — later records supersede earlier ones for the same
@@ -48,7 +52,7 @@ from typing import Any, Iterable
 
 from tpu_matmul_bench.utils.durable import repair_torn_tail
 
-PROVENANCE_KINDS = ("measured", "analytic")
+PROVENANCE_KINDS = ("measured", "analytic", "measured-online")
 
 CELL_SCHEMA = 1
 
@@ -146,7 +150,7 @@ class Cell:
     dtype: str                 # canonical name (bfloat16/float32/int8)
     device_kind: str           # kind token (see kind_token)
     impl: str                  # "xla" | "pallas"
-    provenance_kind: str       # "measured" | "analytic"
+    provenance_kind: str       # "measured" | "analytic" | "measured-online"
     artifact: str              # committed evidence path(s)
     detail: str = ""           # prior / margin / sweep context
     blocks: tuple[int, int, int] | None = None
@@ -412,6 +416,12 @@ class TuningDB:
                 problems.append(
                     f"{label}: analytic cell without an explicit prior "
                     "in detail — 'analytic' must name its model")
+            if cell.provenance_kind == "measured-online" \
+                    and ".jsonl" not in cell.artifact:
+                problems.append(
+                    f"{label}: measured-online cell cites no serve "
+                    f"ledger (.jsonl): {cell.artifact!r} — an online "
+                    "promotion must reference the stream it measured")
             for path in _artifact_paths(cell.artifact):
                 if not os.path.exists(os.path.join(root, path)):
                     problems.append(f"{label}: artifact {path!r} does not "

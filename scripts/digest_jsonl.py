@@ -503,6 +503,97 @@ def _digest_campaign(d: Path) -> None:
         print(line)
 
 
+#: metrics where down is good — mirrors obs/history.LOWER_BETTER_METRICS
+#: (standalone script: no package import)
+_HIST_LOWER_BETTER = {"p99_latency_ms"}
+#: the drift band's static parts, mirroring obs/detect defaults: the 5%
+#: gate threshold and the ±1.5% instrument floor
+_HIST_THRESHOLD_PCT = 5.0
+_HIST_NOISE_FLOOR_PCT = 1.5
+_HIST_STALE_ROUNDS = 3
+
+
+def _digest_history(recs: list[dict]) -> None:
+    """Metric-history digest (measurements/history.jsonl): one line per
+    series fingerprint — run count, ingest rounds, last value, and a
+    best-effort drift verdict. The verdict reimplements only the static
+    band (threshold/floor/2x point noise); the half-split series noise
+    and the findings contract live in `obs detect`, which stays the
+    authority."""
+    series: dict[str, list[dict]] = {}
+    for r in recs:
+        if r.get("record_type") != "history_point":
+            continue
+        series.setdefault(str(r.get("series")), []).append(r)
+    max_round = max((int(p.get("ingest_seq") or 0)
+                     for pts in series.values() for p in pts), default=0)
+    verdicts: dict[str, int] = {}
+    print(f"  {'series':<16} {'runs':>4} {'rounds':>6} {'last':>10} "
+          f"{'unit':<7} {'verdict':<12} label")
+    for sid in sorted(series):
+        pts = series[sid]
+        labels = pts[-1].get("labels") or {}
+        metric = str(pts[-1].get("metric"))
+        lower = metric in _HIST_LOWER_BETTER
+        by_round: dict[int, dict] = {}
+        for p in pts:
+            if p.get("status") != "ok" \
+                    or not isinstance(p.get("value"), (int, float)):
+                continue
+            seq = int(p.get("ingest_seq") or 0)
+            cur = by_round.get(seq)
+            if cur is None or ((p["value"] < cur["value"]) if lower
+                               else (p["value"] > cur["value"])):
+                by_round[seq] = p
+        rounds = sorted(by_round)
+        last = by_round[rounds[-1]] if rounds else pts[-1]
+        if labels.get("kind") == "tune":
+            verdict = "exploratory"
+        elif not rounds:
+            verdict = "dark"
+        elif len({int(p.get("ingest_seq") or 0) for p in pts}) >= 2 \
+                and max_round - rounds[-1] >= _HIST_STALE_ROUNDS:
+            verdict = "stale"
+        elif len(rounds) < 2:
+            verdict = "single-round"
+        else:
+            latest, prior = by_round[rounds[-1]], \
+                [by_round[r] for r in rounds[:-1]]
+            pick = min if lower else max
+            lkg = pick(prior, key=lambda p: p["value"])
+            noise = max((p.get("noise_pct") or 0.0 for p in (latest, lkg)
+                         if isinstance(p.get("noise_pct"), (int, float))),
+                        default=0.0)
+            tol = max(_HIST_THRESHOLD_PCT, _HIST_NOISE_FLOOR_PCT,
+                      2.0 * noise)
+            delta = 100.0 * (latest["value"] - lkg["value"]) / lkg["value"] \
+                if lkg["value"] else 0.0
+            bad = delta > tol if lower else delta < -tol
+            good = delta < -tol if lower else delta > tol
+            verdict = "REGRESSED" if bad else \
+                "improved" if good else "steady"
+        verdicts[verdict] = verdicts.get(verdict, 0) + 1
+        val = last.get("value")
+        val_s = f"{val:.4g}" if isinstance(val, (int, float)) else "—"
+        bits = [str(labels.get("kind", "?"))]
+        for key in ("harness", "benchmark", "mode", "size", "dtype",
+                    "backend", "comm_quant", "blocks", "mix",
+                    "scheduler", "cell"):
+            v = labels.get(key)
+            if v not in (None, "", "none"):
+                bits.append(str(v))
+        print(f"  {sid:<16} {len(pts):>4} "
+              f"{(rounds[-1] if rounds else 0):>6} {val_s:>10} "
+              f"{str(last.get('unit') or ''):<7} {verdict:<12} "
+              f"{' '.join(bits)} [{metric}]")
+    total = sum(len(v) for v in series.values())
+    print(f"  -- {len(series)} series, {total} points, "
+          f"round {max_round}; "
+          + "  ".join(f"{k}={v}" for k, v in sorted(verdicts.items()))
+          + " (authoritative verdicts: python -m tpu_matmul_bench obs "
+            "detect)")
+
+
 def main(paths: list[str]) -> None:
     # a directory argument (incl. the no-args default) digests its JSONLs;
     # a CAMPAIGN directory digests its job ledgers as one combined table
@@ -578,6 +669,9 @@ def main(paths: list[str]) -> None:
             continue
         if any(r.get("record_type") == "obs_snapshot" for r in recs):
             _digest_obs(recs)
+            continue
+        if any(r.get("record_type") == "history_point" for r in recs):
+            _digest_history(recs)
             continue
         recs.sort(key=_rank_key)
         for r in recs:

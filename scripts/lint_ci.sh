@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Eight layers, cheapest first:
+# Nine layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -49,6 +49,16 @@
 #      adversarial stream, then the serialized-executable store's
 #      integrity chain (manifest keys recompute, blobs hash to their
 #      digests; an absent store verifies vacuously).
+#   9. python -m tpu_matmul_bench obs history selftest + obs detect
+#      --fail-on error — the perf observatory: the committed
+#      metric-history store (measurements/history.jsonl) must validate
+#      (schema, fingerprint recompute, live sources) and cover every
+#      measurement in the tree (re-ingest adds nothing), and the
+#      noise-aware drift pass must find no error-severity HIST-*
+#      verdict (a measured regression beyond noise vs last-known-good,
+#      or an attribution residual the analytic model stopped
+#      explaining). Fix: scripts/regen_history.py, then chase the
+#      regression, never the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -83,3 +93,9 @@ JAX_PLATFORMS=cpu python -m tpu_matmul_bench tune online selftest
 
 echo "== tune artifacts verify (executable store integrity chain) =="
 JAX_PLATFORMS=cpu python -m tpu_matmul_bench tune artifacts verify
+
+echo "== obs history selftest (metric-history store integrity) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench obs history selftest
+
+echo "== obs detect (noise-aware drift gate over the history store) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench obs detect --fail-on error

@@ -644,11 +644,31 @@ def _build_ledger(tmp_path):
     return path, lambda p: len(ledger_have(p))
 
 
+def _build_history(tmp_path):
+    from tpu_matmul_bench.obs.history import HistoryStore, _make_point
+
+    path = tmp_path / "history.jsonl"
+    store = HistoryStore(str(path))
+    store.append(
+        [_make_point({"kind": "bench", "metric": "tflops_per_device",
+                      "size": str(4096 * (i + 1))},
+                     value=100.0 + i, unit="TFLOP/s", status="ok",
+                     source=f"measurements/r{i + 1}/demo.jsonl",
+                     digest_=f"{i:016x}", round_=i + 1)
+         for i in range(3)], seq=1)
+
+    def count(p):
+        return len(HistoryStore.load(str(p)))
+
+    return path, count
+
+
 _ARTIFACTS = {
     "campaign_journal": _build_journal,
     "tune_db": _build_tune_db,
     "obs_snapshots": _build_obs,
     "faults_ledger": _build_ledger,
+    "history_store": _build_history,
 }
 
 

@@ -1,0 +1,546 @@
+"""Perf observatory: the metric-history store, noise-aware drift
+detection, and the analytic-vs-measured attribution trail.
+
+Covers the committed store (digest pin + regen determinism + clean
+drift pass), ingest idempotency (twice → byte-identical), seeded
+HIST-001/002/003/004 fixtures pinning rule IDs and severities, the
+injected-slow-ledger acceptance fixture (`obs detect` must flip
+non-zero), `campaign gate --history`, the [history] spec lint, and the
+report renderer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpu_matmul_bench.obs import detect as det
+from tpu_matmul_bench.obs import history as hist
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: sha256 of the committed store — scripts/regen_history.py prints the
+#: new value after a regen; a mismatch means the store and the tree
+#: drifted apart (commit the regenerated file AND update this pin)
+COMMITTED_STORE_SHA256 = (
+    "7404c6dd671b1a85aae59e998fb41befe5159312c545d57979eddd6a862d0540")
+
+
+def _mk(labels, value, *, seq, status="ok", noise_pct=None, digest=None,
+        unit="TFLOPS", residual_pct=None, source=None):
+    attribution = None
+    if residual_pct is not None:
+        attribution = {"measured": value, "predicted": None,
+                       "residual_pct": residual_pct}
+    point = hist._make_point(
+        labels, value=value, unit=unit, status=status,
+        source=source or f"measurements/r{seq}/seeded.jsonl",
+        digest_=digest or hashlib.sha256(
+            f"{seq}/{value}/{labels}".encode()).hexdigest()[:16],
+        noise_pct=noise_pct, attribution=attribution)
+    point["ingest_seq"] = seq
+    return point
+
+
+def _seed_store(tmp_path, values, *, labels=None, metric="tflops_per_device",
+                noise_pct=None, residuals=None, extra_points=()):
+    """One point per ingest round for one series, plus extras."""
+    labels = labels or {"kind": "bench", "metric": metric, "mode": "single",
+                        "size": 8192, "dtype": "bf16"}
+    store = hist.HistoryStore(str(tmp_path / "history.jsonl"))
+    points = [_mk(labels, v, seq=i + 1, noise_pct=noise_pct,
+                  residual_pct=(residuals[i] if residuals else None))
+              for i, v in enumerate(values)]
+    points.extend(extra_points)
+    for p in sorted(points, key=lambda p: p["ingest_seq"]):
+        store.append([p], seq=p["ingest_seq"])
+    return store
+
+
+def _rules(findings):
+    return [(f.rule, f.severity) for f in findings]
+
+
+# ------------------------------------------------------- committed store
+
+
+class TestCommittedStore:
+    def test_digest_pinned(self):
+        data = (REPO / hist.HISTORY_RELPATH).read_bytes()
+        assert hashlib.sha256(data).hexdigest() == COMMITTED_STORE_SHA256, (
+            "measurements/history.jsonl changed — regen via "
+            "scripts/regen_history.py and update COMMITTED_STORE_SHA256")
+
+    def test_validates_and_covers_tree(self):
+        store = hist.HistoryStore.load()
+        assert len(store) > 0
+        assert store.validate() == []
+        # every measurement already ingested: dry-run re-ingest adds 0
+        added, skipped = hist.ingest(hist.default_sources(), store,
+                                     dry_run=True)
+        assert added == 0
+        assert skipped > 0
+
+    def test_detect_clean_at_error_severity(self):
+        from tpu_matmul_bench.analysis.findings import should_fail
+
+        store = hist.HistoryStore.load()
+        findings = det.detect_findings(store)
+        assert not should_fail(findings, "error"), _rules(findings)
+
+    def test_regen_check_matches_committed(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "regen_history.py"),
+             "--check"], cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert COMMITTED_STORE_SHA256 in proc.stdout
+
+
+# ---------------------------------------------------- ingest idempotency
+
+
+class TestIngestIdempotent:
+    def test_reingest_is_byte_identical(self, tmp_path):
+        sources = hist.default_sources()[:8]
+        store = hist.HistoryStore.load(str(tmp_path / "h.jsonl"))
+        added, skipped = hist.ingest(sources, store, seq=1)
+        assert added > 0 and skipped == 0
+        first = Path(store.path).read_bytes()
+        store2 = hist.HistoryStore.load(store.path)
+        added2, skipped2 = hist.ingest(sources, store2, seq=2)
+        assert added2 == 0
+        assert skipped2 == added
+        assert Path(store.path).read_bytes() == first
+
+    def test_append_dedupes_within_batch(self, tmp_path):
+        labels = {"kind": "bench", "metric": "tflops_per_device"}
+        p = _mk(labels, 100.0, seq=1, digest="a" * 16)
+        store = hist.HistoryStore(str(tmp_path / "h.jsonl"))
+        assert store.append([p, dict(p)]) == 1
+        assert store.append([dict(p)]) == 0
+        assert len(hist.HistoryStore.load(store.path)) == 1
+
+    def test_correction_is_append_last_wins(self, tmp_path):
+        labels = {"kind": "bench", "metric": "tflops_per_device"}
+        store = hist.HistoryStore(str(tmp_path / "h.jsonl"))
+        store.append([_mk(labels, 100.0, seq=1, digest="b" * 16)])
+        # same identity, corrected value: appended raw, load keeps last
+        corrected = _mk(labels, 120.0, seq=1, digest="b" * 16)
+        with open(store.path, "a") as fh:
+            fh.write(json.dumps(corrected, sort_keys=True) + "\n")
+        loaded = hist.HistoryStore.load(store.path)
+        assert len(loaded) == 1
+        assert loaded.points()[0]["value"] == 120.0
+
+
+# ------------------------------------------------- seeded drift verdicts
+
+
+class TestSeededDrift:
+    def test_hist_001_regression_is_error(self, tmp_path):
+        store = _seed_store(tmp_path, [100.0, 101.0, 100.5, 80.0])
+        findings = det.detect_findings(store)
+        assert _rules(findings) == [("HIST-001", "error")]
+        d = findings[0].details
+        assert d["last_known_good"] == 101.0
+        assert d["latest_round"] == 4
+        assert d["delta_pct"] == pytest.approx(-20.79, abs=0.01)
+
+    def test_hist_002_improvement_is_warn(self, tmp_path):
+        store = _seed_store(tmp_path, [100.0, 101.0, 100.5, 130.0])
+        findings = det.detect_findings(store)
+        assert _rules(findings) == [("HIST-002", "warn")]
+
+    def test_steady_series_is_clean(self, tmp_path):
+        store = _seed_store(tmp_path, [100.0, 101.0, 99.5, 100.2])
+        assert det.detect_findings(store) == []
+
+    def test_noise_widens_the_band(self, tmp_path):
+        # −4% with 3% recorded jitter: band = max(5, 1.5, 6) = 6 → clean;
+        # −10% punches through the widened band → HIST-001
+        clean = _seed_store(tmp_path / "a", [100.0, 96.0], noise_pct=3.0)
+        assert det.detect_findings(clean) == []
+        bad = _seed_store(tmp_path / "b", [100.0, 90.0], noise_pct=3.0)
+        assert _rules(det.detect_findings(bad)) == [("HIST-001", "error")]
+
+    def test_lower_better_metric_regresses_up(self, tmp_path):
+        labels = {"kind": "serve", "metric": "p99_latency_ms", "mix": "64"}
+        store = _seed_store(tmp_path, [10.0, 10.1, 14.0], labels=labels,
+                            metric="p99_latency_ms")
+        findings = det.detect_findings(store)
+        assert _rules(findings) == [("HIST-001", "error")]
+        # and an improvement (p99 down) is HIST-002, not a regression
+        store2 = _seed_store(tmp_path / "dn", [10.0, 10.1, 7.0],
+                             labels=labels, metric="p99_latency_ms")
+        assert _rules(det.detect_findings(store2)) == [("HIST-002", "warn")]
+
+    def test_hist_003_stale_series_is_warn(self, tmp_path):
+        # series A measured in rounds 1-2, then the store advances to
+        # round 6 on series B alone → A went stale
+        b_labels = {"kind": "bench", "metric": "tflops_per_device",
+                    "mode": "other", "size": 4096}
+        extras = [_mk(b_labels, 50.0 + 0.1 * i, seq=i) for i in range(1, 7)]
+        store = _seed_store(tmp_path, [100.0, 100.5], extra_points=extras)
+        findings = det.detect_findings(store)
+        assert _rules(findings) == [("HIST-003", "warn")]
+        assert findings[0].details["last_ok_round"] == 2
+        assert findings[0].details["store_round"] == 6
+
+    def test_single_round_series_never_stale(self, tmp_path):
+        # a one-off measurement is not "the repo stopped measuring" —
+        # staleness needs a series that recurred at least twice
+        b_labels = {"kind": "bench", "metric": "tflops_per_device",
+                    "mode": "other", "size": 4096}
+        extras = [_mk(b_labels, 50.0, seq=i) for i in range(1, 7)]
+        store = _seed_store(tmp_path, [100.0], extra_points=extras)
+        assert det.detect_findings(store) == []
+
+    def test_hist_004_residual_shift_is_error(self, tmp_path):
+        store = _seed_store(tmp_path, [100.0, 100.1, 99.9, 100.2],
+                            residuals=[3.0, 3.4, 2.8, 30.0])
+        findings = det.detect_findings(store)
+        assert _rules(findings) == [("HIST-004", "error")]
+        d = findings[0].details
+        assert d["latest_residual_pct"] == 30.0
+        assert d["prior_median_pct"] == 3.0
+
+    def test_residual_within_band_is_clean(self, tmp_path):
+        store = _seed_store(tmp_path, [100.0, 100.1, 99.9, 100.2],
+                            residuals=[3.0, 3.4, 2.8, 6.0])
+        assert det.detect_findings(store) == []
+
+    def test_tune_candidates_are_exploratory(self, tmp_path):
+        # wild candidate-sweep swings never produce drift verdicts — the
+        # tune DB's promotion gate owns ranking them
+        labels = {"kind": "tune", "metric": "tflops_per_device",
+                  "blocks": "512x512x512"}
+        store = _seed_store(tmp_path, [100.0, 20.0, 180.0, 5.0],
+                            labels=labels)
+        assert det.detect_findings(store) == []
+
+    def test_within_round_points_are_concurrent_not_trajectory(
+            self, tmp_path):
+        # two readings of one series in ONE round (a rerun pair): the
+        # worse one must not read as a regression — best-of wins
+        labels = {"kind": "bench", "metric": "tflops_per_device",
+                  "mode": "single", "size": 8192, "dtype": "bf16"}
+        low = _mk(labels, 80.0, seq=2, digest="c" * 16)
+        store = _seed_store(tmp_path, [100.0, 100.3], labels=labels,
+                            extra_points=[low])
+        assert det.detect_findings(store) == []
+
+    def test_min_rounds_gate(self, tmp_path):
+        store = _seed_store(tmp_path, [100.0])
+        assert det.detect_findings(store) == []
+
+    def test_unavailable_points_never_last_known_good(self, tmp_path):
+        labels = {"kind": "bench", "metric": "tflops_per_device",
+                  "mode": "single", "size": 8192, "dtype": "bf16"}
+        # round 1: quarantined implausible 2600; round 2: honest 100;
+        # round 3: honest 99 — clean (2600 never became the baseline)
+        quarantined = _mk(labels, 2600.0, seq=1, status="unavailable")
+        store = hist.HistoryStore(str(tmp_path / "h2.jsonl"))
+        store.append([quarantined], seq=1)
+        store.append([_mk(labels, 100.0, seq=2)], seq=2)
+        store.append([_mk(labels, 99.0, seq=3)], seq=3)
+        assert det.detect_findings(store) == []
+
+    def test_detect_window_bounds_lookback(self, tmp_path):
+        # an ancient high reading outside the window must not flag the
+        # settled present as a regression
+        values = [200.0] + [100.0 + 0.1 * i for i in range(8)]
+        store = _seed_store(tmp_path, values)
+        cfg = det.DetectConfig(detect_window=8)
+        assert det.detect_findings(store, cfg) == []
+        wide = det.DetectConfig(detect_window=20)
+        assert _rules(det.detect_findings(store, wide)) \
+            == [("HIST-001", "error")]
+
+
+class TestNoiseStats:
+    def test_half_split_needs_four_rounds(self):
+        assert det.series_noise_pct([100.0, 50.0, 100.0]) == 0.0
+
+    def test_half_split_estimate_and_cap(self):
+        # halves' medians 100 vs 104 around anchor ~102 → ~2%
+        assert det.series_noise_pct([100.0, 100.0, 104.0, 104.0]) \
+            == pytest.approx(100.0 * 4.0 / 102.0 / 2.0)
+        assert det.series_noise_pct([100.0, 100.0, 1e4, 1e4]) \
+            == det.SERIES_NOISE_CAP_PCT
+
+    def test_tolerance_is_gate_shaped(self):
+        cfg = det.DetectConfig()
+        assert det.tolerance_pct(cfg, point_noise=0.0, series_noise=0.0) \
+            == cfg.threshold_pct
+        assert det.tolerance_pct(cfg, point_noise=4.0, series_noise=1.0) \
+            == 8.0
+
+
+# ------------------------------------- injected-slow-ledger (acceptance)
+
+
+def _slowable_source():
+    """First committed ledger yielding an ok bench point — the cell the
+    injected-slow fixture degrades."""
+    for src in hist.default_sources():
+        if not src.endswith(".jsonl"):
+            continue
+        for p in hist.points_from_source(src):
+            if (p.get("labels") or {}).get("kind") == "bench" \
+                    and p.get("status") == "ok":
+                return src, p
+    raise AssertionError("no ok bench ledger in the committed tree")
+
+
+class TestInjectedSlowLedger:
+    def test_detect_flips_nonzero_with_hist_001(self, tmp_path, capsys):
+        from tpu_matmul_bench.obs.cli import main as obs_main
+
+        src, _ = _slowable_source()
+        slow = tmp_path / "slow.jsonl"
+        with open(slow, "w") as out:
+            for line in Path(src).read_text().splitlines():
+                rec = json.loads(line)
+                if rec.get("record_type") == "manifest":
+                    # a new digest identity — this is a *new* run, not a
+                    # correction of the committed one
+                    rec["run_id"] = "injected-slow"
+                elif isinstance(rec.get("tflops_per_device"), (int, float)):
+                    rec["tflops_per_device"] *= 0.4
+                out.write(json.dumps(rec) + "\n")
+
+        store_path = tmp_path / "history.jsonl"
+        store_path.write_bytes(
+            (REPO / hist.HISTORY_RELPATH).read_bytes())
+        store = hist.HistoryStore.load(str(store_path))
+        added, _ = hist.ingest([slow], store)
+        assert added > 0
+
+        with pytest.raises(SystemExit) as exc:
+            obs_main(["detect", "--store", str(store_path),
+                      "--fail-on", "error"])
+        assert exc.value.code == 1
+        out = capsys.readouterr().out
+        assert "HIST-001" in out
+        assert "FAIL" in out
+
+    def test_clean_committed_store_passes_cli(self, capsys):
+        from tpu_matmul_bench.obs.cli import main as obs_main
+
+        assert obs_main(["detect", "--fail-on", "error"]) == 0
+        assert "-> ok" in capsys.readouterr().out
+
+
+# -------------------------------------------------- campaign gate --history
+
+
+def _run_campaign(campaign_dir, values, run_id):
+    from tpu_matmul_bench.campaign import executor
+    from tpu_matmul_bench.campaign.spec import spec_from_dict
+
+    spec = spec_from_dict({
+        "campaign": {"name": "hist"},
+        "job": [{"id": "j64", "program": "matmul",
+                 "flags": ["--sizes", "64", "--iterations", "2"]},
+                {"id": "j32", "program": "matmul",
+                 "flags": ["--sizes", "32", "--iterations", "2"]}]})
+
+    def launch(cmd, *, log, timeout_s, env):
+        ledger = cmd[cmd.index("--json-out") + 1]
+        size = int(cmd[cmd.index("--sizes") + 1])
+        with open(ledger, "w") as fh:
+            fh.write(json.dumps({"record_type": "manifest",
+                                 "schema_version": 2,
+                                 "run_id": f"{run_id}-{size}"}) + "\n")
+            fh.write(json.dumps({
+                "benchmark": "matmul", "mode": "single", "size": size,
+                "tflops_per_device": values[size]}) + "\n")
+        return executor.LaunchResult(rc=0)
+
+    executor.run_campaign(spec, campaign_dir, env={}, launch=launch,
+                          sleep=lambda s: None)
+    return spec
+
+
+class TestGateHistory:
+    def test_regression_vs_history_baseline(self, tmp_path):
+        from tpu_matmul_bench.campaign import gate as gate_mod
+        from tpu_matmul_bench.campaign.store import CampaignStore
+
+        _run_campaign(tmp_path / "prior", {64: 100.0, 32: 50.0}, "prior")
+        _run_campaign(tmp_path / "cur", {64: 80.0, 32: 50.2}, "cur")
+        store_path = str(tmp_path / "h.jsonl")
+        store = hist.HistoryStore.load(store_path)
+        hist.ingest(sorted((tmp_path / "prior" / "jobs").glob("*.jsonl")),
+                    store, seq=1)
+
+        baseline = gate_mod.history_baseline(tmp_path / "cur", store_path)
+        report = gate_mod.run_gate(
+            CampaignStore.load(tmp_path / "cur").summary(), baseline)
+        verdicts = {r.job_id: r.verdict for r in report.rows}
+        assert verdicts == {"j64": "regression", "j32": "ok"}
+        assert report.exit_code == gate_mod.EXIT_REGRESSION
+
+    def test_own_round_excluded_from_baseline(self, tmp_path):
+        # a campaign already ingested must still gate against PRIOR
+        # rounds — its own points must not become their own baseline
+        from tpu_matmul_bench.campaign import gate as gate_mod
+
+        _run_campaign(tmp_path / "prior", {64: 100.0, 32: 50.0}, "prior")
+        _run_campaign(tmp_path / "cur", {64: 80.0, 32: 50.2}, "cur")
+        store_path = str(tmp_path / "h.jsonl")
+        store = hist.HistoryStore.load(store_path)
+        hist.ingest(sorted((tmp_path / "prior" / "jobs").glob("*.jsonl")),
+                    store, seq=1)
+        hist.ingest(sorted((tmp_path / "cur" / "jobs").glob("*.jsonl")),
+                    store, seq=2)
+        baseline = gate_mod.history_baseline(tmp_path / "cur", store_path)
+        assert {row["job_id"]: row.get("tflops_per_device")
+                for row in baseline.values()} \
+            == {"j64": 100.0, "j32": 50.0}
+
+    def test_no_history_gates_as_new_and_unusable(self, tmp_path):
+        from tpu_matmul_bench.campaign import gate as gate_mod
+        from tpu_matmul_bench.campaign.store import CampaignStore
+
+        _run_campaign(tmp_path / "cur", {64: 80.0, 32: 50.2}, "cur")
+        store_path = str(tmp_path / "h.jsonl")
+        store = hist.HistoryStore(store_path)
+        store.append([_mk({"kind": "bench",
+                           "metric": "tflops_per_device"}, 1.0, seq=1)])
+        baseline = gate_mod.history_baseline(tmp_path / "cur", store_path)
+        assert baseline == {}
+        report = gate_mod.run_gate(
+            CampaignStore.load(tmp_path / "cur").summary(), baseline)
+        assert report.exit_code == gate_mod.EXIT_UNUSABLE
+
+    def test_empty_store_is_a_loud_error(self, tmp_path):
+        from tpu_matmul_bench.campaign import gate as gate_mod
+
+        with pytest.raises(RuntimeError, match="empty or missing"):
+            gate_mod.history_baseline(tmp_path, str(tmp_path / "no.jsonl"))
+
+    def test_cli_requires_exactly_one_baseline_source(self, tmp_path,
+                                                      capsys):
+        from tpu_matmul_bench.campaign import gate as gate_mod
+        from tpu_matmul_bench.campaign.cli import main as campaign_main
+
+        _run_campaign(tmp_path / "cur", {64: 80.0, 32: 50.2}, "cur")
+        with pytest.raises(SystemExit) as exc:
+            campaign_main(["gate", str(tmp_path / "cur")])
+        assert exc.value.code == gate_mod.EXIT_UNUSABLE
+        assert "exactly one of" in capsys.readouterr().out
+
+
+# ------------------------------------------------------ spec + CLI lint
+
+
+class TestHistorySpecLint:
+    def test_shipped_history_spec_is_clean(self):
+        from tpu_matmul_bench.analysis import spec_lint
+
+        assert spec_lint.lint_spec_file(REPO / "specs" / "history.toml") \
+            == []
+
+    def test_unknown_key_is_spec_002(self, tmp_path):
+        from tpu_matmul_bench.analysis import spec_lint
+
+        spec = tmp_path / "h.toml"
+        spec.write_text("[history]\ndetect_windw = 8\n")
+        findings = spec_lint.lint_spec_file(spec)
+        assert _rules(findings) == [("SPEC-002", "error")]
+        assert findings[0].details["key"] == "detect_windw"
+
+    def test_bad_values_are_spec_001(self, tmp_path):
+        from tpu_matmul_bench.analysis import spec_lint
+
+        spec = tmp_path / "h.toml"
+        spec.write_text("[history]\nthreshold_pct = -2.0\n")
+        assert _rules(spec_lint.lint_spec_file(spec)) \
+            == [("SPEC-001", "error")]
+        spec.write_text("[history]\ndetect_window = 0\n")
+        assert _rules(spec_lint.lint_spec_file(spec)) \
+            == [("SPEC-001", "error")]
+
+    def test_obs_job_argv_lint(self, tmp_path):
+        from tpu_matmul_bench.analysis import spec_lint
+
+        def _lint(flags):
+            spec = tmp_path / "obs_job.toml"
+            spec.write_text(
+                '[campaign]\nname = "seeded"\n\n'
+                '[[job]]\nid = "j1"\nprogram = "obs"\n'
+                f'flags = {json.dumps(flags)}\n')
+            return spec_lint.lint_spec_file(spec)
+
+        assert _lint(["detect", "--detect-window", "8",
+                      "--fail-on", "error"]) == []
+        assert _rules(_lint(["detect", "--detect-window", "0"])) \
+            == [("SPEC-001", "error")]
+        assert _rules(_lint(["detect", "--fail-on", "fatal"])) \
+            == [("SPEC-001", "error")]
+        assert _rules(_lint(["detect", "--windw", "8"])) \
+            == [("SPEC-002", "error")]
+        assert _rules(_lint(["dtect"])) == [("SPEC-001", "error")]
+
+    def test_loader_rejects_what_lint_rejects(self, tmp_path):
+        spec = tmp_path / "h.toml"
+        spec.write_text("[history]\nstale_rounds = -1\n")
+        with pytest.raises(ValueError, match="stale_rounds"):
+            det.load_config(str(spec))
+
+    def test_cli_overrides_win_over_spec(self, tmp_path):
+        spec = tmp_path / "h.toml"
+        spec.write_text("[history]\ndetect_window = 8\n"
+                        "threshold_pct = 5.0\n")
+        cfg = det.load_config(str(spec),
+                              overrides={"detect_window": 3})
+        assert cfg.detect_window == 3
+        assert cfg.threshold_pct == 5.0
+
+
+# ------------------------------------------------------------- reporting
+
+
+class TestReport:
+    def test_sparkline_shape(self):
+        from tpu_matmul_bench.obs.report import sparkline
+
+        assert len(sparkline([1.0, None, 3.0])) == 3
+        assert sparkline([None, None]) == "··"
+        line = sparkline([0.0, 50.0, 100.0])
+        assert line[0] == "▁" and line[-1] == "█"
+
+    def test_render_sections_on_seeded_store(self, tmp_path):
+        from tpu_matmul_bench.obs.report import render
+
+        store = _seed_store(tmp_path, [100.0, 101.0, 100.5, 80.0],
+                            residuals=[3.0, 3.1, 2.9, 3.2])
+        text = render(store)
+        assert "# Perf trajectory" in text
+        assert "## Bench throughput per mode" in text
+        assert "## Attribution residuals" in text
+        assert "## Drift verdicts" in text
+        assert "HIST-001" in text
+
+    def test_render_committed_store_smoke(self):
+        from tpu_matmul_bench.obs.report import render
+
+        text = render(hist.HistoryStore.load())
+        for section in ("## Round headline", "## Serve p99 latency",
+                        "## Tune candidate sweeps"):
+            assert section in text
+
+    def test_history_selftest_cli(self, capsys):
+        from tpu_matmul_bench.obs.cli import main as obs_main
+
+        assert obs_main(["history", "selftest"]) == 0
+        assert "tree fully ingested" in capsys.readouterr().out

@@ -190,6 +190,71 @@ def test_exporter_stop_flushes_final_snapshot(tmp_path):
     assert last is not None and last["counters"]["late_total"] == 1
 
 
+def _http_get(port, path):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_health_endpoints(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("probe_total").inc()
+    exp = obs_export.SnapshotExporter(tmp_path / "obs", registry=reg,
+                                      run_id="hz", interval_s=60.0)
+    try:
+        port = exp.start_http(0)
+
+        # liveness answers before any flush; readiness must not
+        code, body = _http_get(port, obs_export.HEALTHZ_PATH)
+        assert (code, body) == (200, "ok\n")
+        code, body = _http_get(port, obs_export.READYZ_PATH)
+        assert code == 503
+        assert "no snapshot flushed" in body
+
+        exp.write_once()
+        code, body = _http_get(port, obs_export.READYZ_PATH)
+        assert code == 200
+        assert body.startswith("ready: flushed")
+
+        code, body = _http_get(port, obs_export.METRICS_PATH)
+        assert code == 200
+        assert "# TYPE probe_total counter" in body
+
+        code, _ = _http_get(port, "/nope")
+        assert code == 404
+
+        # a wedged exporter (stale flush) must fail its probe even
+        # though the process still answers /healthz
+        exp._last_flush_unix = time.time() - 3600.0
+        code, body = _http_get(port, obs_export.READYZ_PATH)
+        assert code == 503
+        assert "exceeds" in body
+        assert _http_get(port, obs_export.HEALTHZ_PATH)[0] == 200
+    finally:
+        exp.stop_http()
+
+
+def test_readiness_bound_scales_with_interval(tmp_path):
+    exp = obs_export.SnapshotExporter(tmp_path, registry=MetricsRegistry(),
+                                      interval_s=0.05)
+    assert exp.readiness()[0] is False
+    exp.write_once()
+    ready, reason = exp.readiness()
+    assert ready, reason
+    # bound = max(READY_MIN_AGE_S, factor*interval) → the floor here
+    exp._last_flush_unix = time.time() - (obs_export.READY_MIN_AGE_S + 0.5)
+    assert exp.readiness()[0] is False
+    # stop() closes the HTTP server too (idempotent when never started)
+    exp.stop()
+    assert exp._http is None
+
+
 def test_read_snapshots_tolerates_torn_tail(tmp_path):
     f = tmp_path / obs_export.SNAPSHOT_NAME
     good = json.dumps({"record_type": "obs_snapshot", "run_id": "r",

@@ -160,6 +160,21 @@ RULES: dict[str, tuple[Severity, str]] = {
                           "ledger (.jsonl) — an online promotion must "
                           "reference the shadow-traffic stream that "
                           "measured it"),
+    "HIST-001": ("error", "metric-history regression: the latest ingest "
+                          "round's best reading fell beyond the noise "
+                          "band vs the series' last-known-good "
+                          "(obs detect; store measurements/history.jsonl)"),
+    "HIST-002": ("warn", "metric-history improvement beyond noise not "
+                         "reflected in the recorded last-known-good — "
+                         "update the gate baseline / tune DB or the "
+                         "evidence rots"),
+    "HIST-003": ("warn", "recurring history series gone stale: no "
+                         "successful ingest for N rounds — the repo "
+                         "stopped measuring a cell it used to measure"),
+    "HIST-004": ("error", "analytic-vs-measured attribution residual "
+                          "moved beyond noise for a (mode × wire-format "
+                          "× shape) cell — the compute+comm model "
+                          "stopped explaining the machine"),
 }
 
 

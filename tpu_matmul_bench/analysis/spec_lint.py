@@ -201,6 +201,118 @@ def _lint_serve_job(job: Any, where: str,
     return findings
 
 
+# obs-CLI flag vocabulary, mirroring obs/cli.py — campaign specs may
+# schedule observatory steps (ingest after a sweep, detect as a gate),
+# and an unknown flag crashes that job at spawn time like any other
+_OBS_SUBCOMMANDS = ("status", "selftest", "ingest", "history", "detect",
+                    "report")
+_OBS_FLAGS_BY_SUB = {
+    "status": {"--json", "--follow", "--interval", "--timeout"},
+    "selftest": {"--dir", "--keep"},
+    "ingest": {"--store", "--seq", "--dry-run"},
+    "history": {"--store"},
+    "detect": {"--store", "--spec", "--detect-window", "--threshold-pct",
+               "--stale-rounds", "--fail-on", "--json-out"},
+    "report": {"--store", "--spec", "--out"},
+}
+_OBS_BOOL_FLAGS = {"--json", "--follow", "--keep", "--dry-run"}
+#: flags that must parse as a strictly positive integer
+_OBS_POSITIVE_INT_FLAGS = {"--detect-window", "--stale-rounds", "--seq"}
+#: flags that must parse as a strictly positive number
+_OBS_POSITIVE_FLAGS = {"--threshold-pct", "--interval", "--timeout"}
+#: subcommands whose positional operands are legitimate
+_OBS_POSITIONAL_OK = {"status", "ingest"}
+_OBS_HISTORY_ACTIONS = ("show", "selftest")
+
+
+def _lint_obs_job(job: Any, where: str) -> list[Finding]:
+    """The observatory analog of `_lint_serve_job`: subcommand check
+    (SPEC-001), per-subcommand flag vocabulary (SPEC-002), and value
+    validity for the detection windows (SPEC-001) — so a campaign that
+    schedules `obs detect --detect-window 0` dies at lint, not an hour
+    into the sweep."""
+    from tpu_matmul_bench.analysis.findings import SEVERITIES
+
+    argv = list(job.argv)
+    if not argv or argv[0] not in _OBS_SUBCOMMANDS:
+        return [Finding(
+            "SPEC-001", where,
+            f"obs job must start with a subcommand {_OBS_SUBCOMMANDS}, "
+            f"got {argv[:1] or '[]'}",
+            details={"argv": argv})]
+    sub = argv[0]
+    known = _OBS_FLAGS_BY_SUB[sub]
+    findings: list[Finding] = []
+    # reuse the serve tokenizer; it only knows serve's bool flags, so an
+    # obs bool flag that captured the next token gives that token back
+    # as a positional
+    items, strays = _serve_flag_items(argv[1:])
+    fixed_items: list[tuple[str, str | None]] = []
+    for flag, val in items:
+        if flag in _OBS_BOOL_FLAGS and val is not None:
+            fixed_items.append((flag, None))
+            strays.append(val)
+        else:
+            fixed_items.append((flag, val))
+    if sub == "history":
+        # optional positional action
+        actions = [s for s in strays]
+        strays = []
+        for act in actions:
+            if act not in _OBS_HISTORY_ACTIONS:
+                findings.append(Finding(
+                    "SPEC-001", where,
+                    f"obs history action must be one of "
+                    f"{_OBS_HISTORY_ACTIONS}, got {act!r}",
+                    details={"action": act}))
+    elif sub not in _OBS_POSITIONAL_OK:
+        for tok in strays:
+            findings.append(Finding(
+                "SPEC-001", where,
+                f"stray positional token {tok!r} in obs {sub} flags",
+                details={"token": tok}))
+        strays = []
+    values: dict[str, str | None] = {}
+    for flag, val in fixed_items:
+        if flag not in known:
+            findings.append(Finding(
+                "SPEC-002", where,
+                f"unknown obs {sub} flag {flag!r} (the job would crash "
+                "at spawn time)",
+                details={"flag": flag, "known": sorted(known)}))
+            continue
+        values[flag] = val
+    for flag in sorted(_OBS_POSITIVE_INT_FLAGS & set(values)):
+        val = values[flag]
+        try:
+            ok = val is not None and int(val) > 0
+        except ValueError:
+            ok = False
+        if not ok:
+            findings.append(Finding(
+                "SPEC-001", where,
+                f"{flag} must be a positive integer, got {val!r}",
+                details={"flag": flag, "value": val}))
+    for flag in sorted(_OBS_POSITIVE_FLAGS & set(values)):
+        val = values[flag]
+        try:
+            ok = val is not None and float(val) > 0
+        except ValueError:
+            ok = False
+        if not ok:
+            findings.append(Finding(
+                "SPEC-001", where,
+                f"{flag} must be a positive number, got {val!r}",
+                details={"flag": flag, "value": val}))
+    if "--fail-on" in values and values["--fail-on"] not in SEVERITIES:
+        findings.append(Finding(
+            "SPEC-001", where,
+            f"--fail-on must be one of {SEVERITIES}, "
+            f"got {values['--fail-on']!r}",
+            details={"fail_on": values["--fail-on"]}))
+    return findings
+
+
 def _lint_tenants_data(data: Any, where: str) -> list[Finding]:
     """All findings for a parsed ``{"tenants": {...}}`` root: unknown
     keys per block (SPEC-002), bounds/profile validity (SPEC-005),
@@ -409,6 +521,14 @@ def lint_spec_file(path: str | Path) -> list[Finding]:
 
         return lint_chaos_data(data, where)
 
+    # a perf-observatory detection-window spec (root is exactly
+    # [history], e.g. specs/history.toml): vocabulary + value ranges for
+    # `obs detect`, not a campaign
+    if set(data) == {"history"}:
+        from tpu_matmul_bench.obs.detect import lint_history_data
+
+        return lint_history_data(data, where)
+
     findings = _unknown_key_findings(data, where)
 
     try:
@@ -436,6 +556,8 @@ def lint_spec_file(path: str | Path) -> list[Finding]:
         if job.program == "serve":
             findings.extend(_lint_serve_job(job, f"{where}:{job.job_id}",
                                             spec_dir=p.parent))
+        elif job.program == "obs":
+            findings.extend(_lint_obs_job(job, f"{where}:{job.job_id}"))
 
     # SPEC-007: --comm-quant wire-format validity, statically — the value
     # must parse against the wire-format grammar, and for block formats

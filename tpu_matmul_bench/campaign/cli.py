@@ -66,9 +66,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     gt = sub.add_parser("gate", help="pass/fail vs a baseline")
     gt.add_argument("campaign_dir")
-    gt.add_argument("--baseline", required=True,
+    gt.add_argument("--baseline", default=None,
                     help="baseline campaign directory, or a snapshot JSON "
-                         "written by --write-baseline")
+                         "written by --write-baseline (alternative: "
+                         "--history)")
+    gt.add_argument("--history", nargs="?", const="", default=None,
+                    metavar="STORE",
+                    help="gate against the metric-history store's "
+                         "last-known-good per job instead of a lone "
+                         "baseline file (optional value: a store path; "
+                         "default measurements/history.jsonl). Jobs whose "
+                         "series has no prior round gate as 'new'; lost "
+                         "jobs are only detectable with --baseline")
     gt.add_argument("--threshold-pct", type=float,
                     default=gate_mod.DEFAULT_THRESHOLD_PCT,
                     help="regression threshold (default %(default)s%%; "
@@ -162,9 +171,17 @@ def _cmd_status(args: argparse.Namespace) -> int:
 
 
 def _cmd_gate(args: argparse.Namespace) -> int:
+    if (args.baseline is None) == (args.history is None):
+        print("campaign gate: need exactly one of --baseline or "
+              "--history")
+        return gate_mod.EXIT_UNUSABLE
     try:
         current = gate_mod.load_summary(args.campaign_dir)
-        baseline = gate_mod.load_summary(args.baseline)
+        if args.history is not None:
+            baseline = gate_mod.history_baseline(args.campaign_dir,
+                                                 args.history or None)
+        else:
+            baseline = gate_mod.load_summary(args.baseline)
     except (RuntimeError, FileNotFoundError) as e:
         print(f"campaign gate: {e}")
         return gate_mod.EXIT_UNUSABLE

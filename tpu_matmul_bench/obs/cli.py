@@ -1,4 +1,4 @@
-"""`python -m tpu_matmul_bench obs {status,selftest}`.
+"""`python -m tpu_matmul_bench obs {status,selftest,ingest,history,detect,report}`.
 
 `status` reads the snapshot stream an instrumented run exports
 (``--obs-dir`` on serve, automatic under ``campaign run``) and prints
@@ -13,6 +13,20 @@ counters reconcile with the ledger's ``extras["serve"]`` stats — the
 registry and the compat views must be two views of one truth — and
 (3) the ledger's ``cost_analysis`` block agrees with the hand FLOPs
 model within tolerance (OBS-001). Exit 0 = the bus is live and honest.
+
+The perf-observatory quartet (DESIGN §19):
+
+- `ingest [SOURCES...]` — append every new measurement in the given
+  ledgers/round files (default: the whole repo) to
+  ``measurements/history.jsonl`` as one ingest round. Idempotent:
+  already-ingested (series, source-digest) identities are skipped, so a
+  re-run leaves the store byte-identical.
+- `history [show|selftest]` — store summary / CI validation (schema,
+  fingerprint recompute, live sources, idempotency vs the tree).
+- `detect` — noise-aware drift verdicts (HIST-001..004) over the store;
+  ``--fail-on error`` is CI layer 9's regression gate.
+- `report` — the markdown perf trajectory with per-mode sparklines that
+  replaces hand-diffing BENCH_r*.json files.
 """
 
 from __future__ import annotations
@@ -61,6 +75,70 @@ def build_parser() -> argparse.ArgumentParser:
                                "and snapshots (default: a temp dir)")
     selftest.add_argument("--keep", action="store_true",
                           help="with --dir: leave the artifacts in place")
+
+    def add_store(sp: argparse.ArgumentParser) -> None:
+        sp.add_argument("--store", default=None,
+                        help="history store path (default: "
+                             "measurements/history.jsonl at the repo "
+                             "root)")
+
+    ingest = sub.add_parser(
+        "ingest", help="append new measurements to the history store "
+                       "(idempotent)")
+    ingest.add_argument("sources", nargs="*",
+                        help="ledgers / BENCH_r*.json round files / "
+                             "directories to sweep (default: every "
+                             "measurement artifact in the repo)")
+    add_store(ingest)
+    ingest.add_argument("--seq", type=int, default=None,
+                        help="ingest-round number to stamp (default: "
+                             "store max + 1)")
+    ingest.add_argument("--dry-run", action="store_true",
+                        help="report what would be appended, write "
+                             "nothing")
+
+    history = sub.add_parser(
+        "history", help="summarize or validate the history store")
+    history.add_argument("action", nargs="?", default="show",
+                         choices=("show", "selftest"),
+                         help="show: per-series summary; selftest: CI "
+                              "validation (schema + identity recompute "
+                              "+ idempotency vs the tree)")
+    add_store(history)
+
+    detect = sub.add_parser(
+        "detect", help="noise-aware drift verdicts (HIST-*) over the "
+                       "store")
+    add_store(detect)
+    detect.add_argument("--spec", default=None,
+                        help="detection-window spec (default: "
+                             "specs/history.toml when present)")
+    detect.add_argument("--detect-window", type=int, default=None,
+                        help="most recent ingest rounds considered")
+    detect.add_argument("--threshold-pct", type=float, default=None,
+                        help="static regression threshold before noise "
+                             "widening")
+    detect.add_argument("--stale-rounds", type=int, default=None,
+                        help="rounds without an ok reading before "
+                             "HIST-003")
+    detect.add_argument("--fail-on", default="error",
+                        choices=("info", "warn", "error"),
+                        help="exit non-zero at this severity "
+                             "(default: %(default)s)")
+    detect.add_argument("--json-out", default=None,
+                        help="also write a schema-v2 findings ledger "
+                             "here")
+
+    report = sub.add_parser(
+        "report", help="markdown perf trajectory with per-mode "
+                       "sparklines")
+    add_store(report)
+    report.add_argument("--spec", default=None,
+                        help="detection-window spec for the verdict "
+                             "section (default: specs/history.toml "
+                             "when present)")
+    report.add_argument("--out", default=None,
+                        help="write the markdown here instead of stdout")
     return p
 
 
@@ -236,13 +314,171 @@ def _cmd_selftest(args: argparse.Namespace) -> int:
     return 0
 
 
+# ------------------------------------------------------ perf observatory
+
+def _history_sources(args: argparse.Namespace) -> list[str]:
+    from tpu_matmul_bench.obs import history as hist
+
+    if not args.sources:
+        return hist.default_sources()
+    out: list[str] = []
+    for src in args.sources:
+        p = Path(src)
+        if p.is_dir():
+            out.extend(sorted(str(f) for f in p.rglob("*.jsonl")
+                              if f.name not in
+                              hist._NON_MEASUREMENT_NAMES))
+            out.extend(sorted(str(f) for f in p.glob("*.json")
+                              if hist._ROUND_FILE_RE.search(f.name)))
+        else:
+            out.append(str(p))
+    return out
+
+
+def _cmd_ingest(args: argparse.Namespace) -> int:
+    from tpu_matmul_bench.obs import history as hist
+
+    store = hist.HistoryStore.load(args.store)
+    sources = _history_sources(args)
+    added, skipped = hist.ingest(sources, store, seq=args.seq,
+                                 dry_run=args.dry_run)
+    verb = "would append" if args.dry_run else "appended"
+    print(f"obs ingest: {verb} {added} point(s) from "
+          f"{len(sources)} source(s) ({skipped} already present) -> "
+          f"{store.path}")
+    return 0
+
+
+def _cmd_history(args: argparse.Namespace) -> int:
+    from tpu_matmul_bench.obs import history as hist
+
+    store = hist.HistoryStore.load(args.store)
+    if args.action == "selftest":
+        problems = store.validate()
+        if len(store) == 0:
+            problems.append(f"{store.path}: store is empty or missing "
+                            "(run scripts/regen_history.py)")
+        # idempotency against the tree: every committed measurement must
+        # already be ingested, and re-ingest must add nothing
+        fresh, _ = hist.ingest(hist.default_sources(), store,
+                               dry_run=True)
+        if fresh:
+            problems.append(
+                f"{fresh} measurement point(s) in the tree are not in "
+                "the store — run `obs ingest` (or "
+                "scripts/regen_history.py) and commit")
+        for msg in problems:
+            print(f"[error] {msg}", file=sys.stderr)
+        if problems:
+            print(f"obs history selftest FAILED: {len(problems)} "
+                  f"problem(s)", file=sys.stderr)
+            return 1
+        print(f"obs history selftest ok: {len(store)} point(s), "
+              f"{len(store.series())} series, {store.max_seq()} ingest "
+              "round(s); identities recompute, sources live, tree fully "
+              "ingested")
+        return 0
+    print(f"store: {store.path}")
+    print(f"points: {len(store)}  series: {len(store.series())}  "
+          f"rounds: {store.max_seq()}")
+    for sid, pts in store.series().items():
+        ok = [p for p in pts if p.get("status") == "ok"]
+        last = pts[-1]
+        val = last.get("value")
+        val_s = f"{val:.4g}" if isinstance(val, (int, float)) else "—"
+        print(f"  {sid}  n={len(pts)} ok={len(ok)} "
+              f"last_round={last.get('ingest_seq')} "
+              f"last={val_s} {last.get('unit')}  "
+              f"[{(last.get('labels') or {}).get('kind')}] "
+              f"{last.get('metric')}")
+    return 0
+
+
+def _detect_config(args: argparse.Namespace):
+    from tpu_matmul_bench.obs import detect as det
+    from tpu_matmul_bench.obs import history as hist
+
+    overrides: dict[str, Any] = {}
+    for key in ("detect_window", "threshold_pct", "stale_rounds"):
+        val = getattr(args, key, None)
+        if val is not None:
+            overrides[key] = val
+    spec = getattr(args, "spec", None)
+    if spec is None:
+        default_spec = Path(hist.repo_root()) / "specs" / "history.toml"
+        spec = str(default_spec) if default_spec.exists() else None
+    if spec:
+        return det.load_config(spec, overrides=overrides)
+    return det.config_from_table(overrides)
+
+
+def _resolve_store(cli_store: str | None, cfg) -> str | None:
+    """--store wins; else the spec's store (repo-root-relative); else
+    the default store path."""
+    from tpu_matmul_bench.obs import history as hist
+
+    if cli_store:
+        return cli_store
+    if cfg.store:
+        p = Path(cfg.store)
+        return str(p if p.is_absolute()
+                   else Path(hist.repo_root()) / p)
+    return None
+
+
+def _cmd_detect(args: argparse.Namespace) -> int:
+    from tpu_matmul_bench.analysis.findings import should_fail
+    from tpu_matmul_bench.obs import detect as det
+    from tpu_matmul_bench.obs import history as hist
+
+    try:
+        cfg = _detect_config(args)
+    except (ValueError, OSError) as e:
+        print(f"obs detect: bad spec: {e}", file=sys.stderr)
+        return 2
+    store = hist.HistoryStore.load(_resolve_store(args.store, cfg))
+    findings = det.detect_findings(store, cfg)
+    for f in findings:
+        print(f"[{f.severity:5s}] {f.rule} {f.where}: {f.message}")
+    if args.json_out:
+        from tpu_matmul_bench.analysis.findings import write_ledger
+
+        write_ledger(args.json_out, findings, argv=list(sys.argv))
+    failed = should_fail(findings, args.fail_on)
+    print(f"obs detect: {len(findings)} finding(s) over "
+          f"{len(store.series())} series / {store.max_seq()} round(s) "
+          f"-> {'FAIL' if failed else 'ok'} (--fail-on {args.fail_on})")
+    return 1 if failed else 0
+
+
+def _cmd_report(args: argparse.Namespace) -> int:
+    from tpu_matmul_bench.obs import history as hist
+    from tpu_matmul_bench.obs import report as rep
+
+    try:
+        cfg = _detect_config(args)
+    except (ValueError, OSError) as e:
+        print(f"obs report: bad spec: {e}", file=sys.stderr)
+        return 2
+    store = hist.HistoryStore.load(_resolve_store(args.store, cfg))
+    text = rep.render(store, cfg)
+    if args.out:
+        Path(args.out).write_text(text)
+        print(f"obs report: wrote {args.out}")
+    else:
+        print(text, end="")
+    return 0
+
+
 def main(argv: Sequence[str] | None = None):
     # obs runs from campaign parents and bare shells alike — reporting on
     from tpu_matmul_bench.utils.reporting import force_reporting_process
 
     force_reporting_process(True)
     args = build_parser().parse_args(argv)
-    rc = {"status": _cmd_status, "selftest": _cmd_selftest}[args.command](args)
+    rc = {"status": _cmd_status, "selftest": _cmd_selftest,
+          "ingest": _cmd_ingest, "history": _cmd_history,
+          "detect": _cmd_detect, "report": _cmd_report}[args.command](args)
     if rc:
         raise SystemExit(rc)
     return rc

@@ -8,6 +8,12 @@
 # MULTIHOST_PROGRAM selects the benchmark module (scaling | distributed |
 # overlap | collectives | curve | summa | hybrid; default scaling).
 #
+# Hierarchical meshes: pass --mesh=dcn:R,ici:C (or export MULTIHOST_MESH)
+# to factorize the world for summa/hybrid. The process boundary IS the
+# DCN hop — each host's local devices sit on ICI — so R should equal the
+# process count; the script warns when they disagree but still forwards
+# the flag (single-host virtual-mesh rehearsals legitimately mismatch).
+#
 # Local demo mode (default): spawns NPROCS processes on this machine joined
 # through a localhost coordinator. With --device=cpu each process simulates
 # a 2-device host (virtual CPU mesh), so world = 2*NPROCS.
@@ -30,13 +36,25 @@ MODE=${2:-$DEFAULT_MODE}
 DTYPE=${3:-bfloat16}
 EXTRA=()
 CPU=0
+MESH="${MULTIHOST_MESH:-}"
 for arg in "${@:4}"; do
   case "$arg" in
     --device=cpu) CPU=1 ;;
     --device=*) ;;  # device selection is implied by the cluster's backend
+    --mesh=*) MESH="${arg#--mesh=}" ;;
     *) EXTRA+=("$arg") ;;
   esac
 done
+if [[ -n "$MESH" ]]; then
+  # the DCN axis crosses the process boundary: its size should match the
+  # number of hosts (warn-only — virtual single-host rehearsals differ)
+  DCN_SIZE=$(sed -n 's/^dcn:\([0-9]*\).*/\1/p' <<<"$MESH")
+  if [[ -n "$DCN_SIZE" && "$DCN_SIZE" != "$NPROCS" ]]; then
+    echo "WARNING: --mesh dcn axis is $DCN_SIZE but NPROCS=$NPROCS —" \
+         "the DCN hop is the process boundary" >&2
+  fi
+  EXTRA+=(--mesh "$MESH")
+fi
 
 # pick a verified-free port for the local demo (an occupied port would make
 # the cluster rendezvous hang until the distributed-init timeout)

@@ -161,7 +161,12 @@ def _comm_quant_bits(r) -> str:
     if not isinstance(cq, dict):
         return ""
     bits = f" cq={cq.get('format')}"
-    if "wire_bytes" in cq:
+    if isinstance(cq.get("per_link"), dict):
+        # hierarchical split (PR 15): the one-liner carries the mesh and
+        # the slowest-link verdict; the per-link byte table follows below
+        bits += (f" wire={cq.get('wire_bytes')}B "
+                 f"bottleneck={cq.get('bottleneck_link')}")
+    elif "wire_bytes" in cq:
         bits += (f" wire={cq['wire_bytes']}B "
                  f"({cq.get('payload_reduction_x')}x payload, "
                  f"{cq.get('wire_reduction_x')}x wire)")
@@ -192,6 +197,14 @@ def _row(r) -> str:
             extra_bits += f" {k}={ex[k]}"
     if "validation_max_rel_err" in ex:
         extra_bits += f" relerr={ex['validation_max_rel_err']:g}"
+    if ex.get("mesh"):
+        extra_bits += f" mesh={ex['mesh']}"
+    sk = ex.get("stream_k")
+    if isinstance(sk, dict):  # out-of-core certificate (PR 15)
+        extra_bits += (f" stream_k={sk.get('panels')}p/w{sk.get('window')} "
+                       f"resident={sk.get('resident_gib')}"
+                       f"/{sk.get('budget_gib')}GiB"
+                       + (" [OUT-OF-CORE]" if sk.get("out_of_core") else ""))
     extra_bits += _comm_quant_bits(r)
     if "superseded_by" in ex:
         # e.g. pallas_ring: kept for pedagogy/budget validation,
@@ -387,6 +400,8 @@ def _frontier_lines(rows: list[tuple[str, dict]]) -> list[str]:
         mode = str(r.get("mode"))
         if err is None:
             continue
+        if isinstance(cq, dict) and isinstance(cq.get("per_link"), dict):
+            continue  # hierarchical split: _per_link_lines owns those rows
         if isinstance(cq, dict) and "wire_bytes" in cq:
             pts.append((mode, cq["wire_bytes"], str(cq.get("format")),
                         cq.get("wire_reduction_x"), err))
@@ -405,6 +420,47 @@ def _frontier_lines(rows: list[tuple[str, dict]]) -> list[str]:
     for mode, wb, fmt, wr, err in sorted(pts):
         lines.append(f"  {mode:<18} {fmt:<16} {wb:>10} {wr:>8.4g}x "
                      f"{err:>9.4f}")
+    return lines
+
+
+def _per_link_lines(rows: list[tuple[str, dict]]) -> list[str]:
+    """Per-link-class wire-byte table for hierarchical campaigns
+    (specs/hier.toml): one line per (mode, mesh, format, link class)
+    splitting the static wire price into payload + scale bytes on that
+    link, with its reduction factor, relative wire-seconds, and the
+    slowest-link-dominates bottleneck marked. Shows where a per-link
+    format actually spends — e.g. dcn=fp8-block:32,ici=none must charge
+    its reduction to DCN only. Empty when no row carries a per_link
+    split."""
+    cells: dict[tuple, dict] = {}
+    for _job, r in rows:
+        cq = (r.get("extras") or {}).get("comm_quant")
+        if not isinstance(cq, dict) \
+                or not isinstance(cq.get("per_link"), dict):
+            continue
+        key = (str(r.get("mode")), str(cq.get("mesh")),
+               str(cq.get("format")))
+        cells.setdefault(key, cq)
+    if not cells:
+        return []
+    lines = ["  per-link wire bytes (payload+scale per link class; "
+             "* = bottleneck link):",
+             f"  {'mode':<8} {'mesh':<12} {'link':<5} {'format':<14} "
+             f"{'baseline':>9} {'payload':>9} {'scale':>6} {'wire':>9} "
+             f"{'reduce':>7} {'rel-s':>10}"]
+    for (mode, mesh, _fmt), cq in sorted(cells.items()):
+        for link in sorted(cq["per_link"]):
+            row = cq["per_link"][link]
+            mark = "*" if link == cq.get("bottleneck_link") else ""
+            lines.append(
+                f"  {mode:<8} {mesh:<12} {link + mark:<5} "
+                f"{str(row.get('wire_format') or 'none'):<14} "
+                f"{row.get('baseline_bytes'):>9} "
+                f"{row.get('wire_payload_bytes'):>9} "
+                f"{row.get('wire_scale_bytes'):>6} "
+                f"{row.get('wire_bytes'):>9} "
+                f"{row.get('wire_reduction_x'):>6}x "
+                f"{row.get('wire_seconds_rel'):>10}")
     return lines
 
 
@@ -500,6 +556,8 @@ def _digest_campaign(d: Path) -> None:
         for line in _serve_sublines(r):
             print(line)
     for line in _frontier_lines(rows):
+        print(line)
+    for line in _per_link_lines(rows):
         print(line)
 
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Nine layers, cheapest first:
+# Ten layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -59,6 +59,14 @@
 #      or an attribution residual the analytic model stopped
 #      explaining). Fix: scripts/regen_history.py, then chase the
 #      regression, never the gate.
+#  10. python -m tpu_matmul_bench parallel hier selftest — the
+#      hierarchical DCN×ICI layer: traced per-axis collective
+#      inventories of both 2-D modes must match the two-level comms
+#      model at two transposed factorizations (COLL-H-*, exact and
+#      per-link quantized), the out-of-core MEM-003 gate must trip on an
+#      over-budget streaming window and certify a fitting one, and a
+#      small streamed matmul must validate numerically on a factorized
+#      mesh.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -99,3 +107,7 @@ JAX_PLATFORMS=cpu python -m tpu_matmul_bench obs history selftest
 
 echo "== obs detect (noise-aware drift gate over the history store) =="
 JAX_PLATFORMS=cpu python -m tpu_matmul_bench obs detect --fail-on error
+
+echo "== parallel hier selftest (DCN x ICI inventory + out-of-core gate) =="
+JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m tpu_matmul_bench parallel hier selftest

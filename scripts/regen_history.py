@@ -10,7 +10,9 @@ the repo's actual history:
   and tune fills, r4's headline/compare/tune ledgers);
 - round 6: everything measured since the round harness — the
   comm-quant frontier campaign, the multi-tenant serve campaign, and
-  the serialized-executable serve proof.
+  the serialized-executable serve proof;
+- round 7: the hierarchical DCN×ICI campaign (factorized meshes,
+  per-link wire formats, and the out-of-core K-streaming rider).
 
 The output is byte-deterministic (no wall-clock anywhere in a point:
 timestamps come only from ledger manifests), so
@@ -38,9 +40,12 @@ from tpu_matmul_bench.obs import history as hist  # noqa: E402
 #: rounds the BENCH_r*/MULTICHIP_r* harness actually ran
 ROUNDS = (1, 2, 3, 4, 5)
 
-#: post-round-harness measurement campaigns, one ingest round together
-ROUND6_DIRS = ("measurements/comm_quant", "measurements/serve_tenants",
-               "measurements/serve_artifacts")
+#: post-round-harness measurement campaigns, one ingest round per tuple
+POST_ROUND_DIRS = (
+    ("measurements/comm_quant", "measurements/serve_tenants",
+     "measurements/serve_artifacts"),
+    ("measurements/hier",),
+)
 
 
 def _round_sources(n: int) -> list[Path]:
@@ -56,9 +61,9 @@ def _round_sources(n: int) -> list[Path]:
     return out
 
 
-def _round6_sources() -> list[Path]:
+def _campaign_sources(dirs: tuple[str, ...]) -> list[Path]:
     out: list[Path] = []
-    for rel in ROUND6_DIRS:
+    for rel in dirs:
         base = REPO / rel
         if base.is_dir():
             out.extend(sorted(p for p in base.rglob("*.jsonl")
@@ -75,9 +80,11 @@ def regen(path: Path) -> hist.HistoryStore:
         added, _ = hist.ingest(_round_sources(n), store, seq=n,
                                root=str(REPO))
         print(f"  round {n}: +{added} point(s)")
-    added, _ = hist.ingest(_round6_sources(), store, seq=len(ROUNDS) + 1,
-                           root=str(REPO))
-    print(f"  round {len(ROUNDS) + 1}: +{added} point(s)")
+    for i, dirs in enumerate(POST_ROUND_DIRS):
+        seq = len(ROUNDS) + 1 + i
+        added, _ = hist.ingest(_campaign_sources(dirs), store, seq=seq,
+                               root=str(REPO))
+        print(f"  round {seq}: +{added} point(s)")
     return store
 
 

@@ -29,7 +29,7 @@ REPO = Path(__file__).resolve().parent.parent
 #: new value after a regen; a mismatch means the store and the tree
 #: drifted apart (commit the regenerated file AND update this pin)
 COMMITTED_STORE_SHA256 = (
-    "7404c6dd671b1a85aae59e998fb41befe5159312c545d57979eddd6a862d0540")
+    "e07c0b390f58157560ce00d94e9af1b5f744bc23c6a76d8a0962b619b4407a02")
 
 
 def _mk(labels, value, *, seq, status="ok", noise_pct=None, digest=None,
@@ -544,3 +544,57 @@ class TestReport:
 
         assert obs_main(["history", "selftest"]) == 0
         assert "tree fully ingested" in capsys.readouterr().out
+
+
+# ------------------------------------------- hierarchical series identity
+
+
+class TestHierLabels:
+    """PR 15: factorized-mesh / per-link / stream runs must never alias
+    the flat series of the same shape — and flat series fingerprints
+    must stay byte-identical to their pre-hier values."""
+
+    FLAT_REC = {"benchmark": "hybrid", "mode": "hybrid", "size": 256,
+                "dtype": "bfloat16", "world": 8,
+                "extras": {"comm_quant": {"spec": "none", "format": None}}}
+
+    def test_flat_labels_carry_no_hier_keys(self):
+        labels = hist._bench_labels(self.FLAT_REC, None, "cpu")
+        assert "mesh" not in labels
+        assert "link_formats" not in labels
+        assert "stream_k" not in labels
+
+    def test_hier_variants_never_alias_flat(self):
+        flat = hist.series_fingerprint(
+            hist._bench_labels(self.FLAT_REC, None, "cpu"))
+        meshed = dict(self.FLAT_REC, extras={"mesh": "dcn:2,ici:4"})
+        per_link = dict(self.FLAT_REC, extras={
+            "mesh": "dcn:2,ici:4",
+            "comm_quant": {"spec": "dcn=fp8-block:32,ici=none",
+                           "per_link": {
+                               "dcn": {"wire_format": "fp8-block:32"},
+                               "ici": {"wire_format": None}}}})
+        streamed = dict(self.FLAT_REC, extras={
+            "mesh": "dcn:2,ici:4", "stream_k": {"panels": 32}})
+        prints = [flat] + [hist.series_fingerprint(
+            hist._bench_labels(r, None, "cpu"))
+            for r in (meshed, per_link, streamed)]
+        assert len(set(prints)) == len(prints), prints
+
+    def test_transposed_factorizations_are_distinct_series(self):
+        a = dict(self.FLAT_REC, extras={"mesh": "dcn:2,ici:4"})
+        b = dict(self.FLAT_REC, extras={"mesh": "dcn:4,ici:2"})
+        assert (hist.series_fingerprint(hist._bench_labels(a, None, "cpu"))
+                != hist.series_fingerprint(
+                    hist._bench_labels(b, None, "cpu")))
+
+    def test_committed_store_has_hier_series(self):
+        store = hist.HistoryStore.load()
+        meshed = [p for p in store.points()
+                  if (p.get("labels") or {}).get("mesh")]
+        assert meshed, "round 7 hier campaign missing from the store"
+        links = {p["labels"].get("link_formats") for p in meshed}
+        assert "dcn=fp8-block:32,ici=none" in links
+        streams = [p for p in store.points()
+                   if (p.get("labels") or {}).get("stream_k")]
+        assert streams and streams[0]["labels"]["stream_k"] == 32

@@ -512,3 +512,107 @@ def test_rule_catalog_is_stable():
     for rule, (sev, blurb) in RULES.items():
         assert sev in ("info", "warn", "error"), rule
         assert blurb, rule
+
+
+# ------------------------------------------- hierarchical seeds (PR 15)
+
+def _hier_jaxpr(mode, spec, devices, fmt=None):
+    """Trace a 2-D mode's full program on one dcn×ici factorization."""
+    import dataclasses
+
+    cfg = auditor._audit_config("bfloat16", "xla")
+    if fmt:
+        cfg = dataclasses.replace(cfg, comm_quant=fmt)
+    build = dict(auditor._hier_cases(spec, devices[:8]))[mode]
+    setup = build(cfg)
+    return jax.make_jaxpr(setup.full)(*setup.operands)
+
+
+def test_hier_audit_clean_on_shipped_tree(devices):
+    findings = auditor.audit_hier()
+    assert findings == [], [(f.rule, f.where, f.message) for f in findings]
+
+
+@pytest.mark.parametrize("spec", ["dcn:2,ici:4", "dcn:4,ici:2"])
+def test_seeded_transposed_factorization_flags_collh002(spec, devices):
+    # trace summa on one factorization, audit against its transpose: the
+    # (kind, axis) sets coincide but the panel payloads swap between the
+    # links, so COLL-H-002 must fire — a clean pass here would mean the
+    # model ignores the factorization entirely. (hybrid is no good as
+    # this seed: its gather bytes are transposition-invariant at the
+    # audit batch.)
+    other = "dcn:4,ici:2" if spec == "dcn:2,ici:4" else "dcn:2,ici:4"
+    jx = _hier_jaxpr("summa", spec, devices)
+    findings = auditor._hier_inventory_findings(
+        jx, "summa", other, None, "seed:transposed")
+    assert _rule_sevs(findings) == [("COLL-H-002", "error")]
+
+
+def test_seeded_wrong_mode_flags_collh001(devices):
+    # summa's two psums audited against hybrid's gather+reduce model
+    jx = _hier_jaxpr("summa", "dcn:2,ici:4", devices)
+    findings = auditor._hier_inventory_findings(
+        jx, "hybrid", "dcn:2,ici:4", None, "seed:wrong-mode")
+    assert ("COLL-H-001", "error") in _rule_sevs(findings)
+
+
+def test_seeded_wrong_quant_link_flags_collh003(devices):
+    # trace with DCN quantized, audit as if ICI were the quantized link:
+    # both routing directions of COLL-H-003 must fire — wire dtypes on
+    # an axis the spec leaves exact AND a quantized link with no wire
+    # traffic
+    jx = _hier_jaxpr("hybrid", "dcn:2,ici:4", devices,
+                     fmt="dcn=fp8-block:32,ici=none")
+    findings = auditor._hier_routing_findings(
+        jx, "dcn=none,ici=fp8-block:32", "seed:swapped-link")
+    rules = [f.rule for f in findings]
+    assert rules and set(rules) == {"COLL-H-003"}
+    assert len(rules) >= 2  # both directions
+    # and the correctly-routed spec audits clean
+    assert auditor._hier_routing_findings(
+        jx, "dcn=fp8-block:32,ici=none", "seed:routed") == []
+
+
+def test_seeded_stream_over_budget_flags_mem003():
+    from tpu_matmul_bench.analysis.memory_model import check_stream_budget
+
+    over = check_stream_budget(4096, "bfloat16", 8, panels=4, window=2,
+                               budget_gib=0.001)
+    assert _rule_sevs(over) == [("MEM-003", "error")]
+    assert check_stream_budget(1024, "bfloat16", 8, panels=8, window=2,
+                               budget_gib=1.0) == []
+
+
+def test_seeded_hier_spec_violations(tmp_path):
+    # every SPEC-008 trigger in one spec: a bad factorization grammar, a
+    # mesh/world mismatch, per-link formats without a mesh, a per-link
+    # format naming the legacy tier, and a non-dividing --stream-k
+    spec = tmp_path / "hier_bad.toml"
+    spec.write_text(
+        '[campaign]\nname = "seeded"\n\n'
+        '[[job]]\nid = "bad-mesh"\nprogram = "hybrid"\n'
+        'flags = ["--sizes", "256", "--num-devices", "8",'
+        ' "--mesh", "dcn:2,ici:3,x:1"]\n\n'
+        '[[job]]\nid = "mesh-world"\nprogram = "hybrid"\n'
+        'flags = ["--sizes", "256", "--num-devices", "8",'
+        ' "--mesh", "dcn:2,ici:2"]\n\n'
+        '[[job]]\nid = "link-no-mesh"\nprogram = "summa"\n'
+        'flags = ["--sizes", "256", "--num-devices", "8",'
+        ' "--comm-quant", "dcn=fp8-block:32,ici=none"]\n\n'
+        '[[job]]\nid = "legacy-link"\nprogram = "summa"\n'
+        'flags = ["--sizes", "256", "--num-devices", "8",'
+        ' "--mesh", "dcn:2,ici:4", "--comm-quant", "dcn=int8,ici=none"]\n\n'
+        '[[job]]\nid = "bad-stream"\nprogram = "parallel"\n'
+        'flags = ["stream", "--sizes", "256", "--num-devices", "8",'
+        ' "--stream-k", "7"]\n')
+    findings = spec_lint.lint_spec_file(spec)
+    assert findings and {f.rule for f in findings} == {"SPEC-008"}
+    assert all(f.severity == "error" for f in findings)
+    wheres = sorted({f.where.rsplit(":", 1)[-1] for f in findings})
+    assert wheres == ["bad-mesh", "bad-stream", "legacy-link",
+                      "link-no-mesh", "mesh-world"]
+
+
+def test_hier_rules_in_catalog():
+    assert set(RULES) >= {"COLL-H-001", "COLL-H-002", "COLL-H-003",
+                          "MEM-003", "SPEC-008"}

@@ -392,3 +392,50 @@ def test_problem_fingerprint_canonicalizes_dtype():
         problem_fingerprint(64, 64, 64, "bfloat16")
     assert canonical_dtype(jnp.float16) == "bfloat16"
     assert kind_token("TPU v5 lite") == kind_token("TPU v5e") == "v5e"
+
+# ----------------------------------- hierarchical / out-of-core keying
+
+def test_flat_fingerprints_unchanged_by_hier_axes():
+    """Direction 1 of the PR-15 compatibility pin: every pre-hier
+    fingerprint (mesh=None, stream_k=None — the whole committed DB) is
+    byte-identical to what problem_fingerprint always produced, so no
+    existing cell is invalidated."""
+    base = problem_fingerprint(512, 1024, 2048, "bfloat16")
+    assert base == problem_fingerprint(512, 1024, 2048, "bfloat16",
+                                       mesh=None, stream_k=None)
+    # a flat cell round-trips to the same key with the new fields absent
+    cell = _cell()
+    assert cell.mesh is None and cell.stream_k is None
+    assert cell.fingerprint == base
+    rec = cell.to_record()
+    assert "mesh" not in rec["problem"]
+    assert "stream_k" not in rec["problem"]
+    assert Cell.from_record(rec) == cell
+
+
+def test_hier_fingerprints_never_alias_flat(tmp_path):
+    """Direction 2: a mesh factorization, a stream plan, and their
+    combination each hash to distinct NEW fingerprints — hierarchical
+    problems start with no cells and inherit no flat winners."""
+    flat = problem_fingerprint(512, 1024, 2048, "bfloat16")
+    hier = problem_fingerprint(512, 1024, 2048, "bfloat16",
+                               mesh="dcn:2,ici:4")
+    stream = problem_fingerprint(512, 1024, 2048, "bfloat16", stream_k=8)
+    both = problem_fingerprint(512, 1024, 2048, "bfloat16",
+                               mesh="dcn:2,ici:4", stream_k=8)
+    assert len({flat, hier, stream, both}) == 4
+    # transposed factorizations are distinct problems too
+    assert hier != problem_fingerprint(512, 1024, 2048, "bfloat16",
+                                       mesh="dcn:4,ici:2")
+
+    # a hier cell round-trips with its axes intact and its own key
+    path = str(tmp_path / "db.jsonl")
+    db = TuningDB(path=path)
+    put = db.put(_cell(mesh="dcn:2,ici:4", stream_k=8))
+    assert put.fingerprint == both
+    reloaded = TuningDB.load(path)
+    assert len(reloaded) == 1 and not reloaded.parse_errors
+    got = reloaded.cells()[0]
+    assert got.mesh == "dcn:2,ici:4" and got.stream_k == 8
+    # the flat lookup must NOT see the hierarchical cell
+    assert reloaded.lookup(512, 1024, 2048, "bfloat16", V5E) is None

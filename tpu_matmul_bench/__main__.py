@@ -47,6 +47,11 @@ _PROGRAMS = {
     # audit`, specs/chaos.toml), and the in-process selftest CI runs
     # (faults/cli.py). Campaign specs may name `faults` as a job program.
     "faults": "tpu_matmul_bench.faults.cli",
+    # the hierarchical-mesh front end: the out-of-core K-streaming
+    # benchmark (`parallel stream`, MEM-003-gated) and CI layer 10's
+    # two-level inventory-vs-model certification (`parallel hier
+    # selftest`) — mesh/collective machinery lives in parallel/
+    "parallel": "tpu_matmul_bench.parallel.cli",
 }
 
 

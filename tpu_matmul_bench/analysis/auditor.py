@@ -890,6 +890,158 @@ def audit_faults() -> list[Finding]:
     return static_findings()
 
 
+# ---------------------------------------------------------------------------
+# COLL-H-*: the hierarchical (DCN×ICI) mesh contract (PR 15)
+# ---------------------------------------------------------------------------
+
+#: the two audit factorizations of the 8-device world — transposed axis
+#: sizes, so a model (or mesh constructor) that swaps dcn/ici roles
+#: cannot match both
+_HIER_FACTORIZATIONS = ("dcn:2,ici:4", "dcn:4,ici:2")
+#: the per-link spec the routing check traces: DCN quantized, ICI exact —
+#: the asymmetric case where wrong-axis routing is visible
+_HIER_QUANT = "dcn=fp8-block:32,ici=none"
+
+
+def _hier_cases(spec: str, devices):
+    """(mode, build(config) -> ModeSetup) for the 2-D-mesh modes on one
+    factorization."""
+    from tpu_matmul_bench.parallel.hybrid import hybrid_mode
+    from tpu_matmul_bench.parallel.mesh import make_factorized_mesh
+    from tpu_matmul_bench.parallel.summa import summa_mode
+
+    mesh = make_factorized_mesh(devices, spec)
+    return [
+        ("hybrid", lambda cfg, m=mesh: hybrid_mode(
+            cfg, m, AUDIT_SIZE, batch=AUDIT_BATCH)),
+        ("summa", lambda cfg, m=mesh: summa_mode(cfg, m, AUDIT_SIZE)),
+    ]
+
+
+def _observed_axis_inventory(jaxpr: Any) -> list[tuple[str, str, int]]:
+    """Traced collectives as ``(kind, axis_name, payload_bytes)`` — the
+    observed side of the COLL-H diff (multi-axis collectives keep their
+    joined name so a fused two-axis psum can't masquerade as either)."""
+    return [(u.kind, ",".join(u.axis_names) or "?", u.payload_bytes)
+            for u in jt.collective_inventory(jaxpr)]
+
+
+def _hier_inventory_findings(jaxpr: Any, mode: str, spec: str,
+                             comm_quant: str | None,
+                             where: str) -> list[Finding]:
+    """COLL-H-001/COLL-H-002: traced per-axis inventory vs the two-level
+    comms model."""
+    from tpu_matmul_bench.analysis.comms_model import (
+        hier_expected_collectives,
+    )
+
+    observed = sorted(_observed_axis_inventory(jaxpr))
+    expected = sorted(hier_expected_collectives(
+        mode, spec, AUDIT_SIZE, jnp.bfloat16, comm_quant,
+        batch=AUDIT_BATCH))
+    obs_ka = sorted((k, a) for k, a, _ in observed)
+    exp_ka = sorted((k, a) for k, a, _ in expected)
+    if obs_ka != exp_ka:
+        return [Finding(
+            "COLL-H-001", where,
+            f"per-axis collective inventory {obs_ka or '[]'} does not "
+            f"match the two-level model {exp_ka or '[]'} for {mode} on "
+            f"{spec}",
+            details={"observed": observed, "expected": expected})]
+    if observed != expected:
+        return [Finding(
+            "COLL-H-002", where,
+            f"per-axis payload bytes differ from the two-level model for "
+            f"{mode} on {spec}",
+            details={"observed": observed, "expected": expected})]
+    return []
+
+
+def _hier_routing_findings(jaxpr: Any, comm_quant: str,
+                           where: str) -> list[Finding]:
+    """COLL-H-003: wire dtypes may appear ONLY on axes whose link class the
+    per-link spec quantizes, and every quantized link's collectives must
+    actually carry a wire dtype."""
+    from tpu_matmul_bench.parallel.collectives import (
+        WIRE_DTYPES,
+        link_format_spec,
+        parse_wire_format,
+    )
+
+    findings: list[Finding] = []
+    quantized_axes: set[str] = set()
+    for u in jt.collective_inventory(jaxpr):
+        if not any(dt in WIRE_DTYPES for dt in u.operand_dtypes):
+            continue
+        quantized_axes.update(u.axis_names)
+        for ax in u.axis_names:
+            if parse_wire_format(link_format_spec(comm_quant, ax)) is None:
+                findings.append(Finding(
+                    "COLL-H-003", where,
+                    f"wire dtype {u.operand_dtypes} on axis {ax!r}, whose "
+                    f"link class {comm_quant!r} leaves exact — per-link "
+                    "routing sent quantization to the wrong wire",
+                    details={"prim": u.prim, "axis": ax,
+                             "dtypes": list(u.operand_dtypes)}))
+    # the converse: a link the spec quantizes must show wire traffic on at
+    # least one of its axes (an all-exact trace means the format was
+    # silently dropped)
+    from tpu_matmul_bench.parallel.collectives import parse_link_formats
+
+    for link, fmt in parse_link_formats(comm_quant).items():
+        if fmt is not None and link not in quantized_axes:
+            findings.append(Finding(
+                "COLL-H-003", where,
+                f"--comm-quant names {link}={fmt.spec} but no collective "
+                f"on the {link!r} axis carries a wire dtype — the "
+                "quantized link runs full precision",
+                details={"link": link, "format": fmt.spec}))
+    return findings
+
+
+def audit_hier(factorizations: Iterable[str] = _HIER_FACTORIZATIONS,
+               ) -> list[Finding]:
+    """Certify the hierarchical-mesh contract statically: for both 2-D
+    modes at TWO transposed dcn×ici factorizations of the 8-device world,
+    trace the FULL program and check
+
+    - COLL-H-001: the per-axis (kind, axis) inventory matches the
+      two-level comms model (`hier_expected_collectives`);
+    - COLL-H-002: the per-axis payload bytes match it exactly;
+    - COLL-H-003: under the asymmetric per-link spec
+      ``dcn=fp8-block:32,ici=none`` wire dtypes ride ONLY the dcn axis
+      and the dcn axis actually carries them.
+    """
+    import dataclasses as _dc
+
+    findings: list[Finding] = []
+    devices = jax.devices()
+    if len(devices) < 8:
+        return [Finding(
+            "COLL-H-001", "mesh:hier",
+            f"cannot audit factorized meshes: only {len(devices)} devices "
+            "(run under XLA_FLAGS=--xla_force_host_platform_device_count)",
+            severity="warn", details={"available": len(devices)})]
+    exact_cfg = _audit_config("bfloat16", "xla")
+    for spec in factorizations:
+        for mode, build in _hier_cases(spec, devices[:8]):
+            where = f"hier:{mode}@{spec}"
+            setup = build(exact_cfg)
+            jaxpr = jax.make_jaxpr(setup.full)(*setup.operands)
+            findings.extend(_hier_inventory_findings(
+                jaxpr, mode, spec, None, where))
+
+            q_cfg = _dc.replace(exact_cfg, comm_quant=_HIER_QUANT)
+            q_setup = build(q_cfg)
+            q_jaxpr = jax.make_jaxpr(q_setup.full)(*q_setup.operands)
+            q_where = f"{where}+{_HIER_QUANT}"
+            findings.extend(_hier_inventory_findings(
+                q_jaxpr, mode, spec, _HIER_QUANT, q_where))
+            findings.extend(_hier_routing_findings(
+                q_jaxpr, _HIER_QUANT, q_where))
+    return findings
+
+
 AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "modes": audit_modes,
     "impls": audit_impls,
@@ -900,6 +1052,7 @@ AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "artifacts": audit_artifacts,
     "obs": audit_obs,
     "comm_quant": audit_comm_quant,
+    "hier": audit_hier,
     "sched": _audit_sched,
     "memory": _audit_memory,
     "fingerprint": _audit_fingerprint,

@@ -59,8 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--skip", nargs="*", default=(),
                         choices=("modes", "impls", "donation", "pallas",
                                  "registry", "tune", "obs", "comm_quant",
-                                 "specs", "sched", "memory", "fingerprint",
-                                 "faults"),
+                                 "hier", "specs", "sched", "memory",
+                                 "fingerprint", "faults"),
                         help="audit groups to skip")
     parser.add_argument("--no-hlo", action="store_true",
                         help="skip the HLO pass family (sched + memory + "

@@ -153,17 +153,52 @@ _SCALE_ITEMSIZE = 4  # scales are always fp32
 _WIRE_ITEMSIZE = 1   # int8 and float8_e4m3fn payloads are both 1 byte
 
 
+def _one_wire_entries(kind: str, axis: int, shape: tuple[int, ...], fmt,
+                      where: str = "") -> list[tuple[str, int, int, str]]:
+    """One quantized collective's wire inventory as ``(kind, axis_size,
+    payload_bytes, role)`` entries with role ∈ {payload, scale}. Mirrors
+    `wire_psum`/`wire_all_gather` exactly: an all_reduce becomes the
+    (d−1)-hop ppermute ring + final all_gather, each hop carrying a
+    payload chunk and its scale chunk; an all_gather carries the whole
+    shard + scales; size-1 axes short-circuit to no traffic at all."""
+    if axis == 1:
+        return []  # the d==1 short-circuit emits no collective at all
+    n_rows = int(np.prod(shape[:-1]))
+    cols = int(shape[-1])
+    nb = fmt.scale_blocks(cols)
+    out: list[tuple[str, int, int, str]] = []
+    if kind == "all_reduce":
+        if n_rows % axis:
+            raise ValueError(
+                f"{where}: flattened rows {n_rows} must divide the "
+                f"{axis}-device axis for the quantized ring")
+        chunk = n_rows // axis
+        for _ in range(axis - 1):  # reduce-scatter phase, per hop
+            out.append(("ppermute", axis,
+                        chunk * cols * _WIRE_ITEMSIZE, "payload"))
+            out.append(("ppermute", axis,
+                        chunk * nb * _SCALE_ITEMSIZE, "scale"))
+        out.append(("all_gather", axis,
+                    chunk * cols * _WIRE_ITEMSIZE, "payload"))
+        out.append(("all_gather", axis,
+                    chunk * nb * _SCALE_ITEMSIZE, "scale"))
+    elif kind == "all_gather":
+        out.append(("all_gather", axis,
+                    n_rows * cols * _WIRE_ITEMSIZE, "payload"))
+        out.append(("all_gather", axis,
+                    n_rows * nb * _SCALE_ITEMSIZE, "scale"))
+    else:
+        raise ValueError(f"no wire model for collective kind {kind!r}")
+    return out
+
+
 def _wire_entries(mode: str, world: int, size: int, dtype, comm_quant,
                   batch: int = 4, dp: int | None = None,
                   rows: int | None = None,
                   ) -> list[tuple[str, int, int, str]]:
     """The quantized FULL program's collectives as
-    ``(kind, axis_size, payload_bytes, role)`` with role ∈ {payload,
-    scale}. Mirrors `wire_psum`/`wire_all_gather` exactly: an all_reduce
-    becomes the (d−1)-hop ppermute ring + final all_gather, each hop
-    carrying a payload chunk and its scale chunk; an all_gather carries
-    the whole shard + scales; size-1 axes and integer operands
-    short-circuit to the exact collective.
+    ``(kind, axis_size, payload_bytes, role)`` (see `_one_wire_entries`);
+    integer operands short-circuit to the exact collective.
     """
     from tpu_matmul_bench.parallel.collectives import parse_wire_format
 
@@ -176,33 +211,7 @@ def _wire_entries(mode: str, world: int, size: int, dtype, comm_quant,
                 for kind, axis, shape in base]
     out: list[tuple[str, int, int, str]] = []
     for kind, axis, shape in base:
-        if axis == 1:
-            continue  # the d==1 short-circuit emits no collective at all
-        n_rows = int(np.prod(shape[:-1]))
-        cols = int(shape[-1])
-        nb = fmt.scale_blocks(cols)
-        if kind == "all_reduce":
-            if n_rows % axis:
-                raise ValueError(
-                    f"{mode}: flattened rows {n_rows} must divide the "
-                    f"{axis}-device axis for the quantized ring")
-            chunk = n_rows // axis
-            for _ in range(axis - 1):  # reduce-scatter phase, per hop
-                out.append(("ppermute", axis,
-                            chunk * cols * _WIRE_ITEMSIZE, "payload"))
-                out.append(("ppermute", axis,
-                            chunk * nb * _SCALE_ITEMSIZE, "scale"))
-            out.append(("all_gather", axis,
-                        chunk * cols * _WIRE_ITEMSIZE, "payload"))
-            out.append(("all_gather", axis,
-                        chunk * nb * _SCALE_ITEMSIZE, "scale"))
-        elif kind == "all_gather":
-            out.append(("all_gather", axis,
-                        n_rows * cols * _WIRE_ITEMSIZE, "payload"))
-            out.append(("all_gather", axis,
-                        n_rows * nb * _SCALE_ITEMSIZE, "scale"))
-        else:
-            raise ValueError(f"no wire model for collective kind {kind!r}")
+        out.extend(_one_wire_entries(kind, axis, shape, fmt, where=mode))
     return out
 
 
@@ -258,3 +267,180 @@ def wire_bytes_summary(mode: str, world: int, size: int, dtype, comm_quant,
         out["payload_reduction_x"] = round(baseline / payload_b, 4)
         out["wire_reduction_x"] = round(baseline / (payload_b + scale_b), 4)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical (DCN×ICI) pricing: the two-level analogue of the model above.
+#
+# A factorized mesh's axis NAMES are its link classes (parallel/mesh.py), so
+# "which axis does this collective run over" IS "which wire does it travel
+# on". Relative wire-seconds per byte by link class: ICI is the unit; DCN is
+# ~8× slower per byte (a deliberately round planning factor in the spirit of
+# the pod-scaling paper's link hierarchy, not a measured constant — the
+# observatory measures, this model only has to rank links and attribute
+# bytes). Multi-axis programs are priced slowest-link-dominates: the comm
+# time estimate is the max over links of (link bytes × link wire-seconds),
+# because the two axes' collectives of one step overlap at best and
+# serialize at worst onto different wires.
+# ---------------------------------------------------------------------------
+
+LINK_WIRE_SECONDS = {"ici": 1.0, "dcn": 8.0}
+
+
+def mode_axis_collectives(
+        mode: str, mesh_spec: str, size: int, batch: int = 4,
+) -> list[tuple[str, str, int, tuple[int, ...]]]:
+    """The float collectives of one mode's FULL program on a factorized
+    mesh as ``(kind, axis_name, axis_size, per_device_operand_shape)`` —
+    the per-axis refinement of `mode_collective_shapes`.
+
+    On a one-axis factorization the flat model applies with the axis's
+    name attached. On a two-axis ``dcn:R,ici:C`` mesh: hybrid puts data
+    parallelism on the outer (dcn) axis and tensor parallelism on the
+    inner (ici) axis; SUMMA puts grid rows on dcn and columns on ici, so
+    its A-panel broadcast (over columns, 'j') rides ICI and its B-panel
+    broadcast (over rows, 'i') rides DCN.
+    """
+    from tpu_matmul_bench.parallel.mesh import parse_mesh_spec
+
+    axes = parse_mesh_spec(mesh_spec)
+    n = size
+    if len(axes) == 1:
+        name, d = axes[0]
+        return [(kind, name, axis, shape)
+                for kind, axis, shape in mode_collective_shapes(
+                    mode, d, size, batch=batch)]
+    (dp_ax, d0), (tp_ax, d1) = axes
+    if mode == "hybrid":
+        lb = max(batch // d0, 1)
+        return [("all_gather", tp_ax, d1, (lb, n, n // d1)),
+                ("all_reduce", dp_ax, d0, (n, n))]
+    if mode == "summa":
+        r, c = d0, d1
+        s = math.lcm(r, c)
+        return [("all_reduce", tp_ax, c, (n // r, n // s)),  # A panel over 'j'
+                ("all_reduce", dp_ax, r, (n // s, n // c))]  # B panel over 'i'
+    raise ValueError(
+        f"no two-level comms model for mode {mode!r} (hybrid and summa map "
+        "onto a dcn×ici factorization; the 1-D modes take a one-axis mesh)")
+
+
+def hier_mode_steps(mode: str, mesh_spec: str) -> int:
+    """`mode_steps` for a factorized mesh (summa's scan length is the lcm
+    of the grid sides, which on a two-axis mesh are the axis sizes)."""
+    from tpu_matmul_bench.parallel.mesh import parse_mesh_spec
+
+    axes = parse_mesh_spec(mesh_spec)
+    if mode != "summa":
+        return 1
+    if len(axes) == 1:
+        return mode_steps(mode, axes[0][1])
+    return math.lcm(axes[0][1], axes[1][1])
+
+
+def hier_expected_collectives(
+        mode: str, mesh_spec: str, size: int, dtype, comm_quant=None,
+        batch: int = 4) -> list[tuple[str, str, int]]:
+    """Expected per-axis collective inventory of the FULL program on a
+    factorized mesh as ``(kind, axis_name, payload_bytes)`` — what the
+    COLL-H rules diff the traced programs' per-axis inventories against.
+
+    `comm_quant` may be uniform or per-link; each axis's collectives are
+    rewritten on the wire under the format its link class resolves to
+    (`link_format_spec` — the same door the modes route through, so model
+    and program can only disagree when one of them is wrong).
+    """
+    from tpu_matmul_bench.parallel.collectives import (
+        link_format_spec, parse_wire_format)
+
+    item = matmul_out_itemsize(dtype)
+    integer = np.issubdtype(np.dtype(dtype), np.integer)
+    out: list[tuple[str, str, int]] = []
+    for kind, name, axis, shape in mode_axis_collectives(
+            mode, mesh_spec, size, batch=batch):
+        fmt = None if integer else parse_wire_format(
+            link_format_spec(comm_quant, name))
+        if fmt is None:
+            # exact collectives trace even over size-1 axes (lax.psum has
+            # no d==1 short-circuit; only the wire tier returns x early)
+            out.append((kind, name, int(np.prod(shape)) * item))
+        else:
+            for k, _, payload, _ in _one_wire_entries(
+                    kind, axis, shape, fmt, where=f"{mode}/{name}"):
+                out.append((k, name, payload))
+    return out
+
+
+def hier_wire_bytes_summary(mode: str, mesh_spec: str, size: int, dtype,
+                            comm_quant, batch: int = 4) -> dict:
+    """Static per-link-class wire-byte prices for one (mode, mesh, size,
+    format) cell — `wire_bytes_summary` split by link class, plus the
+    slowest-link-dominates comm-seconds attribution.
+
+    Each present link class gets its own {baseline, payload, scale, total,
+    reduction} block, so a per-link spec like ``dcn=fp8-block:32,ici=none``
+    shows its reduction charged only to the dcn entry. `bottleneck_link`
+    is the link with the largest (bytes × wire-seconds/byte) product and
+    `comm_seconds_rel` that product — a relative ranking, not a latency
+    prediction.
+    """
+    from tpu_matmul_bench.parallel.collectives import (
+        link_format_spec, parse_wire_format)
+    from tpu_matmul_bench.parallel.mesh import (
+        axis_link_class, canonical_mesh_spec)
+
+    steps = hier_mode_steps(mode, mesh_spec)
+    item = matmul_out_itemsize(dtype)
+    integer = np.issubdtype(np.dtype(dtype), np.integer)
+    per_link: dict[str, dict] = {}
+
+    def link_bucket(link: str, fmt_spec) -> dict:
+        return per_link.setdefault(link, {
+            "wire_format": fmt_spec, "baseline_bytes": 0.0,
+            "wire_payload_bytes": 0.0, "wire_scale_bytes": 0.0,
+        })
+
+    for kind, name, axis, shape in mode_axis_collectives(
+            mode, mesh_spec, size, batch=batch):
+        link = axis_link_class(name)
+        sub = link_format_spec(comm_quant, name)
+        fmt = None if integer else parse_wire_format(sub)
+        bucket = link_bucket(link, sub if not integer else None)
+        base = int(np.prod(shape)) * item * RING_WIRE_FACTOR[kind](axis)
+        bucket["baseline_bytes"] += steps * base
+        if fmt is None:
+            bucket["wire_payload_bytes"] += steps * base
+        else:
+            for k, _, payload, role in _one_wire_entries(
+                    kind, axis, shape, fmt, where=f"{mode}/{name}"):
+                key = ("wire_payload_bytes" if role == "payload"
+                       else "wire_scale_bytes")
+                bucket[key] += steps * payload * RING_WIRE_FACTOR[k](axis)
+
+    bottleneck, bottleneck_secs = None, -1.0
+    for link, bucket in per_link.items():
+        payload_b = bucket["wire_payload_bytes"]
+        scale_b = bucket["wire_scale_bytes"]
+        baseline = bucket["baseline_bytes"]
+        for key in ("baseline_bytes", "wire_payload_bytes",
+                    "wire_scale_bytes"):
+            bucket[key] = int(round(bucket[key]))
+        bucket["wire_bytes"] = int(round(payload_b + scale_b))
+        if payload_b:
+            bucket["payload_reduction_x"] = round(baseline / payload_b, 4)
+            bucket["wire_reduction_x"] = round(
+                baseline / (payload_b + scale_b), 4)
+        secs = (payload_b + scale_b) * LINK_WIRE_SECONDS[link]
+        bucket["wire_seconds_rel"] = round(secs, 1)
+        if secs > bottleneck_secs:
+            bottleneck, bottleneck_secs = link, secs
+
+    return {
+        "wire_format": comm_quant,
+        "mesh": canonical_mesh_spec(mesh_spec),
+        "per_link": per_link,
+        "baseline_bytes": sum(b["baseline_bytes"] for b in per_link.values()),
+        "wire_bytes": sum(b["wire_bytes"] for b in per_link.values()),
+        "bottleneck_link": bottleneck,
+        "comm_seconds_rel": round(bottleneck_secs, 1),
+    }

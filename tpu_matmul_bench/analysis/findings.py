@@ -175,6 +175,30 @@ RULES: dict[str, tuple[Severity, str]] = {
                           "moved beyond noise for a (mode × wire-format "
                           "× shape) cell — the compute+comm model "
                           "stopped explaining the machine"),
+    "COLL-H-001": ("error", "per-axis collective inventory mismatch on a "
+                            "factorized mesh: a traced program's "
+                            "(kind, axis) multiset differs from the "
+                            "two-level comms model — a collective moved "
+                            "to the wrong link class"),
+    "COLL-H-002": ("error", "per-axis collective payload mismatch on a "
+                            "factorized mesh: right (kind, axis), wrong "
+                            "bytes vs the two-level comms model's "
+                            "prediction"),
+    "COLL-H-003": ("error", "per-link wire-format routing broken: a "
+                            "quantized wire dtype appears on an axis whose "
+                            "link class the --comm-quant spec left exact, "
+                            "or the quantized link's collectives carry no "
+                            "wire dtype at all"),
+    "MEM-003": ("error", "K-streaming resident window exceeds the "
+                         "per-device budget: the analytic window bytes "
+                         "(accumulator + staged panel pairs) do not fit "
+                         "--mem-budget-gib — the out-of-core mode's one "
+                         "job is to bound this"),
+    "SPEC-008": ("error", "invalid hierarchical-mesh flag in a spec's job "
+                          "flags: --mesh not in the dcn:R,ici:C grammar or "
+                          "not covering --num-devices, a malformed "
+                          "per-link --comm-quant, or a non-positive "
+                          "--stream-k / --mem-budget-gib"),
 }
 
 

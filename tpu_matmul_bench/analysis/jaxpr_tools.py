@@ -75,18 +75,37 @@ def trace(fn: Callable[..., Any], *avals: jax.ShapeDtypeStruct) -> Any:
 
 @dataclasses.dataclass(frozen=True)
 class CollectiveUse:
-    """One traced collective: canonical kind + per-shard payload bytes."""
+    """One traced collective: canonical kind + per-shard payload bytes.
+    `axis_names` are the mesh axes the collective runs over — the handle
+    the hierarchical rules use to attribute traffic to a link class."""
 
     kind: str
     prim: str
     payload_bytes: int
     operand_shapes: tuple[tuple[int, ...], ...]
     operand_dtypes: tuple[str, ...]
+    axis_names: tuple[str, ...] = ()
 
 
 def _aval_bytes(var: Any) -> int:
     aval = var.aval
     return int(np.prod(aval.shape, dtype=np.int64)) * np.dtype(aval.dtype).itemsize
+
+
+def _eqn_axis_names(eqn: Any) -> tuple[str, ...]:
+    """The named mesh axes one collective eqn runs over. psum-family prims
+    carry an "axes" tuple; all_gather/ppermute/all_to_all a single
+    "axis_name" (which jax sometimes spells as a tuple already).
+    Positional (unnamed) axes are dropped — the rules only price named
+    mesh axes."""
+    axes = eqn.params.get("axes")
+    if axes is None:
+        axes = eqn.params.get("axis_name")
+    if axes is None:
+        return ()
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
 
 
 def collective_inventory(jaxpr: Any) -> list[CollectiveUse]:
@@ -103,6 +122,7 @@ def collective_inventory(jaxpr: Any) -> list[CollectiveUse]:
             payload_bytes=sum(_aval_bytes(v) for v in eqn.invars),
             operand_shapes=tuple(tuple(v.aval.shape) for v in eqn.invars),
             operand_dtypes=tuple(str(v.aval.dtype) for v in eqn.invars),
+            axis_names=_eqn_axis_names(eqn),
         ))
     return uses
 

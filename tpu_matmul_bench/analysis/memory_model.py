@@ -138,6 +138,70 @@ def check_comms_consistency(mode: str, world: int, size: int,
     return findings
 
 
+def stream_window_bytes(size: int, dtype, world: int, panels: int,
+                        window: int = 2) -> int:
+    """Closed-form per-device resident bytes of the K-streaming program
+    (ops/stream_k.py): the row-sharded accumulator (fp32/int32 — the
+    accumulate-high dtype, NOT the operand dtype) plus BOTH double-buffer
+    windows of staged panel pairs (while window w computes, window w+1 is
+    already transferring) — A panels row-sharded, B panels replicated.
+
+    Analytic on purpose: the MEM-003 gate must be able to certify a run
+    whose FULL operands could never be allocated, so there is no HLO to
+    walk — the formula IS the resident-set proof obligation.
+    """
+    import numpy as np
+
+    from tpu_matmul_bench.ops.stream_k import StreamPlan, acc_dtype
+
+    plan = StreamPlan(size=size, panels=panels, window=window, world=world)
+    item = np.dtype(dtype).itemsize
+    acc_item = np.dtype(acc_dtype(dtype)).itemsize
+    kp = plan.panel_k
+    acc_b = (size // world) * size * acc_item
+    a_win_b = window * (size // world) * kp * item   # row-sharded panels
+    b_win_b = window * kp * size * item              # replicated panels
+    return acc_b + 2 * (a_win_b + b_win_b)           # both buffer windows
+
+
+def check_stream_budget(size: int, dtype, world: int, panels: int,
+                        window: int = 2,
+                        budget_gib: float = DEFAULT_BUDGET_GIB,
+                        ) -> list[Finding]:
+    """MEM-003: the streaming window must fit the per-device budget. An
+    empty return IS the static certificate the out-of-core runner demands
+    before allocating anything."""
+    resident = stream_window_bytes(size, dtype, world, panels, window)
+    budget = int(budget_gib * 2**30)
+    if resident <= budget:
+        return []
+    return [Finding(
+        "MEM-003", f"mem:stream_k@d{world}",
+        f"streaming resident window {resident / 2**30:.3f} GiB exceeds the "
+        f"{budget_gib:g} GiB per-device budget at {panels} panels × window "
+        f"{window} (size {size}) — raise --stream-k or the budget",
+        details={"resident_bytes": resident, "budget_bytes": budget,
+                 "panels": panels, "window": window})]
+
+
+def nonstreaming_over_budget(config, world: int, size: int,
+                             budget_gib: float) -> dict[str, float]:
+    """{mode: estimated per-device GiB} for every non-streaming mode whose
+    operand footprint busts the budget at this shape — the contrast half
+    of the out-of-core certificate (the same matmul MEM-gates everywhere
+    else)."""
+    from tpu_matmul_bench.analysis.auditor import _all_modes
+    from tpu_matmul_bench.parallel.modes import estimate_memory_gib
+
+    over = {}
+    for mode in sorted(_all_modes()):
+        gib = estimate_memory_gib(mode, config, world, size,
+                                  dp=max(world // 2, 1))
+        if gib > budget_gib:
+            over[mode] = round(gib, 3)
+    return over
+
+
 def audit_memory(worlds=MEM_WORLDS, size: int | None = None,
                  budget_gib: float = DEFAULT_BUDGET_GIB) -> list[Finding]:
     """Estimate every mode × world peak, gate against the budget, and

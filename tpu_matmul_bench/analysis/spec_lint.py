@@ -52,8 +52,10 @@ _SERVE_POSITIVE_FLAGS = {"--qps", "--duration", "--concurrency",
 _SERVE_SCHEDULERS = ("fixed", "continuous")
 
 
-def _flag_values(argv: list[str], flag: str) -> list[str]:
-    """Values following `flag` up to the next option, commas split."""
+def _raw_flag_values(argv: list[str], flag: str) -> list[str]:
+    """Raw tokens following `flag` up to the next option, NO comma split —
+    for values whose grammar owns its commas (per-link --comm-quant,
+    --mesh factorizations)."""
     out: list[str] = []
     try:
         i = argv.index(flag)
@@ -62,7 +64,27 @@ def _flag_values(argv: list[str], flag: str) -> list[str]:
     for tok in argv[i + 1:]:
         if tok.startswith("--"):
             break
-        out.extend(t for t in tok.split(",") if t)
+        out.append(tok)
+    return out
+
+
+def _flag_values(argv: list[str], flag: str) -> list[str]:
+    """Values following `flag` up to the next option, commas split."""
+    return [t for tok in _raw_flag_values(argv, flag)
+            for t in tok.split(",") if t]
+
+
+def _comm_quant_values(argv: list[str]) -> list[str]:
+    """--comm-quant values with the per-link grammar respected: a token
+    containing '=' is one per-link spec (its commas separate link
+    classes, not sweep points); plain tokens keep the sweep-list comma
+    split."""
+    out: list[str] = []
+    for tok in _raw_flag_values(argv, "--comm-quant"):
+        if "=" in tok:
+            out.append(tok)
+        else:
+            out.extend(t for t in tok.split(",") if t)
     return out
 
 
@@ -417,7 +439,9 @@ def _comm_quant_findings(job: Any, label: str) -> list[Finding]:
     from tpu_matmul_bench.parallel.collectives import parse_wire_format
 
     argv = list(job.argv)
-    quants = _flag_values(argv, "--comm-quant")
+    # per-link specs ('=' in the value) are SPEC-008's to validate — the
+    # uniform wire grammar below would false-positive on their commas
+    quants = [q for q in _comm_quant_values(argv) if "=" not in q]
     if not quants:
         return []
     findings: list[Finding] = []
@@ -457,6 +481,116 @@ def _comm_quant_findings(job: Any, label: str) -> list[Finding]:
                             f"--num-devices {d}: {e}",
                             details={"comm_quant": q, "mode": mode,
                                      "size": s, "num_devices": d}))
+    return findings
+
+
+#: modes that accept a two-axis --mesh factorization
+_HIER_MODES = {"hybrid", "summa"}
+
+
+def _hier_findings(job: Any, label: str) -> list[Finding]:
+    """SPEC-008 for one job: the hierarchical-mesh flag family. --mesh
+    values must parse the dcn:R,ici:C grammar and factorize the job's
+    --num-devices; per-link --comm-quant values must parse the link
+    grammar and dry-run the two-level wire model over the job's
+    (program, size) grid; --stream-k must be a positive panel count that
+    divides every size; --mem-budget-gib must be a positive number."""
+    import math
+
+    import numpy as np
+
+    from tpu_matmul_bench.analysis.comms_model import (
+        hier_expected_collectives,
+    )
+    from tpu_matmul_bench.parallel.collectives import parse_link_formats
+    from tpu_matmul_bench.parallel.mesh import parse_mesh_spec
+
+    argv = list(job.argv)
+    findings: list[Finding] = []
+    devs = [int(x) for x in _flag_values(argv, "--num-devices")
+            if x.isdigit()]
+    sizes = [int(x) for x in _flag_values(argv, "--sizes") if x.isdigit()]
+    hier_progs = _HIER_MODES & (
+        {job.program} | set(_flag_values(argv, "--mode")))
+
+    meshes = []
+    for m in _raw_flag_values(argv, "--mesh"):
+        try:
+            axes = parse_mesh_spec(m)
+        except ValueError as e:
+            findings.append(Finding(
+                "SPEC-008", label, f"bad --mesh value: {e}",
+                details={"mesh": m}))
+            continue
+        meshes.append(m)
+        total = math.prod(d for _, d in axes)
+        for d in devs:
+            if d != total:
+                findings.append(Finding(
+                    "SPEC-008", label,
+                    f"--mesh {m} factorizes {total} devices but the job "
+                    f"runs --num-devices {d}",
+                    details={"mesh": m, "num_devices": d}))
+
+    per_link = [q for q in _comm_quant_values(argv) if "=" in q]
+    for q in per_link:
+        try:
+            parse_link_formats(q)
+        except ValueError as e:
+            findings.append(Finding(
+                "SPEC-008", label, f"bad per-link --comm-quant value: {e}",
+                details={"comm_quant": q}))
+            continue
+        if not meshes:
+            findings.append(Finding(
+                "SPEC-008", label,
+                f"per-link --comm-quant {q} without a --mesh "
+                "factorization — there is only one (flat) link class to "
+                "route over",
+                details={"comm_quant": q}))
+        # dry-run the two-level wire model: block/ring divisibility
+        # errors surface here instead of mid-campaign
+        for m in meshes:
+            for prog in sorted(hier_progs):
+                for s in sizes:
+                    try:
+                        hier_expected_collectives(prog, m, s, np.float32, q)
+                    except ValueError as e:
+                        findings.append(Finding(
+                            "SPEC-008", label,
+                            f"--comm-quant {q} cannot run {prog} "
+                            f"--mesh {m} --sizes {s}: {e}",
+                            details={"comm_quant": q, "mesh": m,
+                                     "program": prog, "size": s}))
+
+    for tok in _flag_values(argv, "--stream-k"):
+        try:
+            panels = int(tok)
+        except ValueError:
+            panels = 0
+        if panels <= 0:
+            findings.append(Finding(
+                "SPEC-008", label,
+                f"--stream-k must be a positive panel count, got {tok!r}",
+                details={"stream_k": tok}))
+            continue
+        for s in sizes:
+            if s % panels:
+                findings.append(Finding(
+                    "SPEC-008", label,
+                    f"--stream-k {panels} panels do not divide size {s}",
+                    details={"stream_k": panels, "size": s}))
+
+    for tok in _flag_values(argv, "--mem-budget-gib"):
+        try:
+            ok = float(tok) > 0
+        except ValueError:
+            ok = False
+        if not ok:
+            findings.append(Finding(
+                "SPEC-008", label,
+                f"--mem-budget-gib must be a positive number, got {tok!r}",
+                details={"mem_budget_gib": tok}))
     return findings
 
 
@@ -566,6 +700,12 @@ def lint_spec_file(path: str | Path) -> list[Finding]:
     # time that ValueError fires an hour into the sweep
     for job in spec.jobs:
         findings.extend(_comm_quant_findings(job, f"{where}:{job.job_id}"))
+
+    # SPEC-008: the hierarchical-mesh flag family (--mesh, per-link
+    # --comm-quant, --stream-k, --mem-budget-gib), same
+    # fail-at-lint-not-mid-campaign contract
+    for job in spec.jobs:
+        findings.extend(_hier_findings(job, f"{where}:{job.job_id}"))
 
     # mesh divisibility: sharding modes need size % num_devices == 0
     for job in spec.jobs:

@@ -19,7 +19,7 @@ from tpu_matmul_bench.benchmarks.matmul_scaling_benchmark import (
 )
 from tpu_matmul_bench.parallel.collectives import verify_collectives
 from tpu_matmul_bench.parallel.hybrid import hybrid_mode, make_hybrid_mesh
-from tpu_matmul_bench.parallel.mesh import make_mesh
+from tpu_matmul_bench.parallel.mesh import make_factorized_mesh, make_mesh
 from tpu_matmul_bench.parallel.modes import estimate_memory_gib, run_mode_benchmark
 from tpu_matmul_bench.utils.config import BenchConfig, build_parser, config_from_args
 from tpu_matmul_bench.utils.device import (
@@ -37,12 +37,23 @@ def run(config: BenchConfig, dp: int, batch: int) -> list[BenchmarkRecord]:
     maybe_init_multihost()
     devices = resolve_devices(config.device, config.num_devices)
     info = collect_device_info(devices)
-    mesh = make_hybrid_mesh(devices, dp)
+    if config.mesh:
+        # factorized DCN×ICI mesh: dp rides the outer (dcn) axis, tp the
+        # inner (ici) axis — --mesh supersedes --dp
+        mesh = make_factorized_mesh(devices, config.mesh)
+        if len(mesh.axis_names) != 2:
+            report(f"\nERROR: hybrid needs a two-axis --mesh, got "
+                   f"{config.mesh!r}")
+            raise SystemExit(1)
+    else:
+        mesh = make_hybrid_mesh(devices, dp)
+    dp_ax, tp_ax = mesh.axis_names
+    dp = mesh.shape[dp_ax]
     report(device_banner(info))
     report(header(
         "Hybrid 2-D Mesh Benchmark (dp x tp, TPU-native)",
         {
-            "Mesh": f"dp={mesh.shape['dp']} x tp={mesh.shape['tp']}",
+            "Mesh": f"dp={dp} x tp={mesh.shape[tp_ax]} ({dp_ax} x {tp_ax})",
             "Global batch": batch,
             "Data type": config.dtype_name,
             "Iterations per test": config.iterations,

@@ -23,7 +23,7 @@ from tpu_matmul_bench.benchmarks.matmul_scaling_benchmark import (
 )
 from tpu_matmul_bench.benchmarks.runner import run_sizes
 from tpu_matmul_bench.parallel.collectives import verify_collectives
-from tpu_matmul_bench.parallel.mesh import make_mesh
+from tpu_matmul_bench.parallel.mesh import make_factorized_mesh, make_mesh
 from tpu_matmul_bench.parallel.modes import (
     estimate_memory_gib,
     run_mode_benchmark,
@@ -49,13 +49,23 @@ def run(config: BenchConfig, rows: int | None = None) -> list[BenchmarkRecord]:
     maybe_init_multihost()
     devices = resolve_devices(config.device, config.num_devices)
     info = collect_device_info(devices)
-    mesh = make_summa_mesh(devices, rows)
-    r, c = mesh.shape["i"], mesh.shape["j"]
+    if config.mesh:
+        # factorized DCN×ICI mesh: grid rows ride the outer (dcn) axis,
+        # columns the inner (ici) axis — --mesh supersedes --rows
+        mesh = make_factorized_mesh(devices, config.mesh)
+        if len(mesh.axis_names) != 2:
+            report(f"\nERROR: summa needs a two-axis --mesh, got "
+                   f"{config.mesh!r}")
+            raise SystemExit(1)
+    else:
+        mesh = make_summa_mesh(devices, rows)
+    i_ax, j_ax = mesh.axis_names
+    r, c = mesh.shape[i_ax], mesh.shape[j_ax]
     report(device_banner(info))
     report(header(
         "SUMMA 2-D Grid Benchmark (TPU-native)",
         {
-            "Grid": f"{r} x {c}",
+            "Grid": f"{r} ({i_ax}) x {c} ({j_ax})",
             "Data type": config.dtype_name,
             "Iterations per test": config.iterations,
             "Warmup iterations": config.warmup,

@@ -1,0 +1,148 @@
+"""Out-of-core K-streaming matmul: plan, staging, and the jitted consumer.
+
+The scaling modes all assume both operands fit in device memory; this op
+opens the "matrices bigger than the machine" class (ROADMAP direction 2,
+in the spirit of the pod-scaling paper's panel-streamed contractions):
+A and B live on the HOST, the K dimension is split into panels, and the
+device only ever holds
+
+- the C accumulator, row-sharded over every mesh axis (fp32 for float
+  operands — the accumulate-high discipline, one downcast at the end);
+- a bounded WINDOW of staged panel pairs (double-buffered: while the
+  jitted `lax.scan` consumes window w, the host `jax.device_put`s window
+  w+1, so its transfer overlaps the compute).
+
+The resident set is therefore O(n²/d + 2·W·panel) bytes — a closed-form
+`analysis/memory_model.stream_window_bytes` prices it, and MEM-003 gates
+a run statically BEFORE any allocation, which is the certification story:
+the gate proves the window fits `--mem-budget-gib` even when the full
+matrices don't.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamPlan:
+    """A validated K-streaming decomposition for one [n, n]·[n, n] matmul.
+
+    `panels` K-panels of width n/panels are consumed `window` at a time;
+    `world` devices row-shard A panels and the C accumulator while B
+    panels are replicated (each device's row block needs every B row of
+    the panel).
+    """
+
+    size: int
+    panels: int
+    window: int
+    world: int
+
+    def __post_init__(self) -> None:
+        if self.panels <= 0:
+            raise ValueError(f"--stream-k {self.panels} must be positive")
+        if self.size % self.panels:
+            raise ValueError(
+                f"--stream-k {self.panels} panels must divide size "
+                f"{self.size}")
+        if self.window <= 0 or self.panels % self.window:
+            raise ValueError(
+                f"stream window {self.window} must be positive and divide "
+                f"the {self.panels}-panel plan")
+        if self.size % self.world:
+            raise ValueError(
+                f"size {self.size} must divide over the {self.world}-device "
+                "row shard")
+
+    @property
+    def panel_k(self) -> int:
+        return self.size // self.panels
+
+    @property
+    def num_windows(self) -> int:
+        return self.panels // self.window
+
+
+def stream_shardings(mesh: Mesh):
+    """(A-window, B-window, C) shardings: C and the A panels row-shard over
+    EVERY mesh axis (flat or factorized — the streaming mode's one data
+    axis is "all devices"); B panels replicate."""
+    all_axes = tuple(mesh.axis_names)
+    a_sh = NamedSharding(mesh, P(None, all_axes, None))  # [W, n, kp]
+    b_sh = NamedSharding(mesh, P())                      # [W, kp, n]
+    c_sh = NamedSharding(mesh, P(all_axes, None))        # [n, n]
+    return a_sh, b_sh, c_sh
+
+
+def acc_dtype(dtype) -> jnp.dtype:
+    """The streaming accumulator dtype: int32 for integer operands (the
+    suite's matmul contract), fp32 for floats — panel partial sums never
+    round in the operand dtype (DTYPE-Q-001's accumulate-high rule)."""
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        return jnp.dtype(jnp.int32)
+    return jnp.dtype(jnp.float32)
+
+
+def build_consumer(mesh: Mesh):
+    """The jitted window consumer: scan the staged [W, ...] panel stacks
+    into the donated C accumulator. Donation keeps exactly one accumulator
+    buffer live across windows; the scan keeps the staged window resident
+    as ONE buffer pair rather than W dispatches."""
+    _, _, c_sh = stream_shardings(mesh)
+
+    @partial(jax.jit, donate_argnums=0, out_shardings=c_sh)
+    def consume(c, aw, bw):
+        def step(acc, pan):
+            a_p, b_p = pan
+            return acc + jnp.dot(a_p, b_p,
+                                 preferred_element_type=acc.dtype), None
+
+        c, _ = lax.scan(step, c, (aw, bw))
+        return c
+
+    return consume
+
+
+def stage_window(host_a: np.ndarray, host_b: np.ndarray, w: int,
+                 plan: StreamPlan, a_sh, b_sh):
+    """device_put one window's stacked panel pair (async dispatch: the
+    caller stages window w+1 while window w computes)."""
+    kp = plan.panel_k
+    width = plan.window * kp
+    lo = w * width
+    a_win = host_a[:, lo:lo + width].reshape(
+        host_a.shape[0], plan.window, kp).transpose(1, 0, 2)
+    b_win = host_b[lo:lo + width, :].reshape(
+        plan.window, kp, host_b.shape[1])
+    return (jax.device_put(np.ascontiguousarray(a_win), a_sh),
+            jax.device_put(np.ascontiguousarray(b_win), b_sh))
+
+
+def stream_matmul(host_a: np.ndarray, host_b: np.ndarray, mesh: Mesh,
+                  plan: StreamPlan) -> jax.Array:
+    """C = A·B with host-resident operands, streamed K-panel windows, and
+    a row-sharded device accumulator. Returns the sharded accumulator in
+    `acc_dtype` (the caller owns the single downcast if it wants the
+    operand dtype back)."""
+    a_sh, b_sh, c_sh = stream_shardings(mesh)
+    consume = build_consumer(mesh)
+    n = host_a.shape[0]
+    c = jax.device_put(
+        jnp.zeros((n, host_b.shape[1]), acc_dtype(host_a.dtype)), c_sh)
+    nxt = stage_window(host_a, host_b, 0, plan, a_sh, b_sh)
+    for w in range(plan.num_windows):
+        cur = nxt
+        if w + 1 < plan.num_windows:
+            # double buffer: dispatch the next transfer before blocking on
+            # this window's compute
+            nxt = stage_window(host_a, host_b, w + 1, plan, a_sh, b_sh)
+        c = consume(c, *cur)
+    return c

@@ -1,0 +1,183 @@
+"""`python -m tpu_matmul_bench parallel {stream, hier selftest}`.
+
+The hierarchical-mesh front end:
+
+- `stream` — the out-of-core K-streaming benchmark
+  (parallel/stream_k.py): host-resident operands, bounded device window,
+  MEM-003 gate BEFORE any allocation. Takes the shared benchmark flags
+  plus ``--stream-k`` (panel count) and ``--mem-budget-gib``.
+- `hier selftest` — CI layer 10's in-process certification: the traced
+  per-axis collective inventory of both 2-D modes must match the
+  two-level comms model at TWO transposed dcn×ici factorizations
+  (COLL-H-*), a deliberately over-budget out-of-core case must MEM-gate,
+  an in-budget plan must certify clean, and a small streamed matmul must
+  validate numerically. Exit 0 = the hierarchy contract holds.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+_USAGE = ("usage: python -m tpu_matmul_bench parallel {stream,hier} ...\n"
+          "  stream        out-of-core K-streaming benchmark "
+          "(--stream-k, --mem-budget-gib)\n"
+          "  hier selftest two-level inventory-vs-model + MEM-gate "
+          "certification")
+
+
+def _stream_main(argv: Sequence[str]) -> list:
+    from tpu_matmul_bench.benchmarks.runner import run_sizes
+    from tpu_matmul_bench.parallel.mesh import make_factorized_mesh, make_mesh
+    from tpu_matmul_bench.parallel.stream_k import stream_benchmark
+    from tpu_matmul_bench.utils import telemetry
+    from tpu_matmul_bench.utils.config import build_parser, config_from_args
+    from tpu_matmul_bench.utils.device import (
+        collect_device_info,
+        device_banner,
+        resolve_devices,
+    )
+    from tpu_matmul_bench.utils.reporting import header, report
+
+    parser = build_parser(
+        "Out-of-core K-streaming matmul benchmark (parallel/stream_k.py).",
+        extra_dtypes=("int8",))
+    args = parser.parse_args(list(argv))
+    config = config_from_args(args)
+
+    devices = resolve_devices(config.device, config.num_devices)
+    info = collect_device_info(devices)
+    mesh = (make_factorized_mesh(devices, config.mesh) if config.mesh
+            else make_mesh(devices))
+    report(device_banner(info))
+    report(header(
+        "Out-of-core K-streaming Benchmark",
+        {
+            "Mesh": " x ".join(f"{mesh.shape[ax]} ({ax})"
+                               for ax in mesh.axis_names),
+            "K panels": config.stream_k or "default",
+            "Memory budget": (f"{config.mem_budget_gib:g} GiB"
+                              if config.mem_budget_gib is not None
+                              else "16 GiB (default)"),
+            "Data type": config.dtype_name,
+            "Iterations per test": config.iterations,
+        },
+    ))
+
+    with telemetry.session(config.trace_out):
+        # no memory_gib guard on purpose: the runner's own MEM-003 gate is
+        # the admission check, and the in-core estimate would wrongly
+        # reject exactly the shapes this program exists to run
+        records = run_sizes(
+            config, lambda s: stream_benchmark(config, mesh, s))
+    report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)
+    return records
+
+
+def _hier_selftest(argv: Sequence[str]) -> list:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="parallel hier selftest",
+        description="two-level inventory-vs-model certification")
+    parser.add_argument("--quiet", action="store_true",
+                        help="suppress per-finding lines")
+    args = parser.parse_args(list(argv))
+
+    # the audits need the 8-virtual-device CPU mesh, exactly like lint
+    from tpu_matmul_bench.analysis.cli import _force_cpu_backend
+
+    _force_cpu_backend()
+
+    import jax
+    import numpy as np
+
+    from tpu_matmul_bench.analysis.auditor import (
+        _HIER_FACTORIZATIONS,
+        audit_hier,
+    )
+    from tpu_matmul_bench.analysis.memory_model import check_stream_budget
+    from tpu_matmul_bench.ops.stream_k import StreamPlan, stream_matmul
+    from tpu_matmul_bench.parallel.mesh import make_factorized_mesh
+    from tpu_matmul_bench.parallel.stream_k import (
+        _expected_corner_host,
+        host_operands,
+    )
+    from tpu_matmul_bench.utils.config import BenchConfig
+
+    failures: list[str] = []
+
+    # 1) COLL-H-*: traced per-axis inventories vs the two-level model at
+    #    two transposed factorizations (exact + per-link quantized)
+    findings = audit_hier()
+    for f in findings:
+        if not args.quiet:
+            print(f"[{f.severity:5s}] {f.rule} {f.where}: {f.message}")
+        if f.severity == "error":
+            failures.append(f"{f.rule} {f.where}")
+    print(f"hier inventory: {len(findings)} finding(s) across "
+          f"{', '.join(_HIER_FACTORIZATIONS)}")
+
+    # 2) the MEM gate, both directions: an over-budget window must trip
+    #    MEM-003; a fitting one must certify clean
+    over = check_stream_budget(4096, "bfloat16", 8, panels=4, window=2,
+                               budget_gib=0.001)
+    if [f.rule for f in over] != ["MEM-003"]:
+        failures.append(
+            f"over-budget stream case did not MEM-gate (got "
+            f"{[f.rule for f in over]})")
+    fits = check_stream_budget(1024, "bfloat16", 8, panels=8, window=2,
+                               budget_gib=1.0)
+    if fits:
+        failures.append(
+            f"in-budget stream plan failed certification: "
+            f"{[f.rule for f in fits]}")
+    print(f"mem gate: over-budget -> {[f.rule for f in over]}, "
+          f"in-budget -> clean" if not fits else "mem gate: BROKEN")
+
+    # 3) a small end-to-end streamed matmul on a factorized mesh must be
+    #    numerically right (the gate certifies the window; this certifies
+    #    the arithmetic behind it)
+    config = BenchConfig(sizes=[256], iterations=1, warmup=0,
+                         dtype_name="float32", mode=None, device=None,
+                         num_devices=None, json_out=None,
+                         matmul_impl="xla", seed=0)
+    mesh = make_factorized_mesh(jax.devices()[:8], "dcn:2,ici:4")
+    plan = StreamPlan(size=256, panels=8, window=2, world=8)
+    a, b = host_operands(config, 256)
+    got = np.asarray(jax.device_get(stream_matmul(a, b, mesh, plan)))
+    exp = _expected_corner_host(a, b, corner=256)
+    err = float(np.abs(got - exp).max()) / (float(np.abs(exp).max()) or 1.0)
+    if err > 1e-5:
+        failures.append(f"streamed matmul corner error {err:.2e} > 1e-5")
+    print(f"stream numerics: max rel err {err:.2e} on dcn:2,ici:4")
+
+    if failures:
+        print(f"hier selftest: FAILED ({len(failures)} problem(s))")
+        for msg in failures:
+            print(f"  - {msg}")
+        raise SystemExit(1)
+    print("hier selftest: OK")
+    return [f.to_record() for f in findings]
+
+
+def main(argv: Sequence[str] | None = None) -> list:
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "stream" in argv and (not argv or argv[0] != "hier"):
+        # accept the subcommand anywhere: campaign specs prepend their
+        # defaults flags before the job's own tokens
+        argv.remove("stream")
+        return _stream_main(argv)
+    if argv and argv[0] == "hier":
+        if argv[1:2] == ["selftest"]:
+            return _hier_selftest(argv[2:])
+        print(_USAGE, file=sys.stderr)
+        raise SystemExit(2)
+    is_help = bool(argv) and argv[0] in ("-h", "--help")
+    print(_USAGE, file=sys.stdout if is_help else sys.stderr)
+    raise SystemExit(0 if is_help else 2)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,7 +39,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpu_matmul_bench.parallel.mesh import ring_perm, smap as _smap
+from tpu_matmul_bench.parallel.mesh import (
+    LINK_CLASSES,
+    axis_link_class,
+    ring_perm,
+    smap as _smap,
+)
 from tpu_matmul_bench.parallel.quantized import (
     _psum_varying,
     comm_quant_extra,
@@ -51,6 +56,8 @@ from tpu_matmul_bench.utils.compat import axis_size, pcast_varying
 
 __all__ = [
     "WireFormat", "parse_wire_format", "wire_psum", "wire_all_gather",
+    "is_per_link_spec", "parse_link_formats", "link_format_spec",
+    "validate_comm_quant",
     "psum_impl", "allgather_impl", "comm_quant_extra", "uses_quantized_comm",
     "comm_quant_record_extra", "WIRE_DTYPES",
     "psum_over", "pmean_over", "all_gather_over", "verify_collectives",
@@ -117,6 +124,68 @@ def parse_wire_format(spec: str | None) -> WireFormat | None:
     raise ValueError(
         f"unknown comm quantization {spec!r} (expected none, int8, "
         f"int8-tensor, fp8, int8-block:<B> or fp8-block:<B>)")
+
+
+def is_per_link_spec(spec: str | None) -> bool:
+    """Whether a --comm-quant value is the per-link-class form
+    (``dcn=<fmt>,ici=<fmt>``) rather than one uniform wire format."""
+    return bool(spec) and "=" in spec
+
+
+def parse_link_formats(spec: str) -> dict[str, WireFormat | None]:
+    """Parse a per-link --comm-quant value, e.g. ``dcn=fp8-block:32,ici=none``
+    → {"dcn": WireFormat(fp8-block:32), "ici": None}.
+
+    Grammar: comma-separated ``<link>=<format>`` with link ∈ {dcn, ici},
+    each link at most once, format from the uniform grammar minus the
+    legacy tier (``int8``/``int8-tensor`` dequantize at every collective
+    and ignore fuse_f32 — a per-axis mix with them would break the
+    one-downcast contract, so the control tier stays uniform-only).
+    Links not named are exact (None).
+    """
+    if not is_per_link_spec(spec):
+        raise ValueError(f"not a per-link comm-quant spec: {spec!r}")
+    out: dict[str, WireFormat | None] = {}
+    for part in spec.split(","):
+        link, sep, fmt_spec = part.strip().partition("=")
+        if not sep or link not in LINK_CLASSES:
+            raise ValueError(
+                f"--comm-quant {spec!r}: bad entry {part.strip()!r} "
+                f"(expected <link>=<format> with link in {LINK_CLASSES})")
+        if link in out:
+            raise ValueError(f"--comm-quant {spec!r}: link {link!r} repeats")
+        fmt = parse_wire_format(fmt_spec)  # raises on bad grammar
+        if fmt is not None and fmt.legacy:
+            raise ValueError(
+                f"--comm-quant {spec!r}: the legacy {fmt.spec!r} control "
+                "tier is uniform-only; per-link formats use the fused "
+                "block/per-row tier (none, fp8, int8-block:<B>, "
+                "fp8-block:<B>)")
+        out[link] = fmt
+    for link in LINK_CLASSES:
+        out.setdefault(link, None)
+    return out
+
+
+def link_format_spec(spec: str | None, axis_name: str) -> str | None:
+    """The uniform wire-format spec one axis's collectives run under: the
+    axis's link-class entry of a per-link spec, or the spec itself when
+    uniform. The one resolution door — modes, the comms model, and the
+    hier auditor all agree on it by construction."""
+    if not is_per_link_spec(spec):
+        return spec
+    fmt = parse_link_formats(spec)[axis_link_class(axis_name)]
+    return fmt.spec if fmt is not None else None
+
+
+def validate_comm_quant(spec: str | None) -> None:
+    """Raise ValueError unless `spec` is a valid --comm-quant value in
+    either the uniform or the per-link grammar (the argparse/spec-lint
+    validation door)."""
+    if is_per_link_spec(spec):
+        parse_link_formats(spec)
+    else:
+        parse_wire_format(spec)
 
 
 def _wire_quantize(x: jax.Array, fmt: WireFormat) -> tuple[jax.Array, jax.Array]:
@@ -269,7 +338,21 @@ def psum_impl(comm_quant: str | None, varying_out: bool = False,
     `fuse_f32=True` keeps the non-legacy output in fp32 so the consuming
     matmul applies the scales in its fp32 accumulator and the caller owns
     the single downcast (DTYPE-Q-001's "exactly one" contract).
+
+    A per-link spec (``dcn=fp8-block:32,ici=none``) is parsed eagerly (so
+    bad grammar fails at build time) and resolved per AXIS at trace time:
+    each call routes through the format of the axis's link class, so on a
+    factorized mesh quantization spends its accuracy budget only where the
+    spec says bandwidth is scarce.
     """
+    if is_per_link_spec(comm_quant):
+        parse_link_formats(comm_quant)  # fail fast on bad grammar
+
+        def per_link(x: jax.Array, axis_name: str) -> jax.Array:
+            sub = link_format_spec(comm_quant, axis_name)
+            return psum_impl(sub, varying_out, fuse_f32)(x, axis_name)
+
+        return per_link
     fmt = parse_wire_format(comm_quant)
     if fmt is None:
         return _psum_varying if varying_out else lax.psum
@@ -298,8 +381,16 @@ def psum_impl(comm_quant: str | None, varying_out: bool = False,
 
 def allgather_impl(comm_quant: str | None, fuse_f32: bool = False):
     """The all_gather implementation a mode should use for --comm-quant
-    (the AG analogue of `psum_impl`; same format routing and `fuse_f32`
-    contract)."""
+    (the AG analogue of `psum_impl`; same format routing, per-link
+    resolution, and `fuse_f32` contract)."""
+    if is_per_link_spec(comm_quant):
+        parse_link_formats(comm_quant)  # fail fast on bad grammar
+
+        def per_link(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
+            sub = link_format_spec(comm_quant, axis_name)
+            return allgather_impl(sub, fuse_f32)(x, axis_name, axis=axis)
+
+        return per_link
     fmt = parse_wire_format(comm_quant)
     if fmt is None:
         return lambda x, axis_name, axis=0: lax.all_gather(
@@ -318,26 +409,46 @@ def allgather_impl(comm_quant: str | None, fuse_f32: bool = False):
 
 def comm_quant_record_extra(config, world: int, *, mode: str, size: int,
                             batch: int = 4, dp: int | None = None,
-                            rows: int | None = None) -> dict:
+                            rows: int | None = None,
+                            mesh_spec: str | None = None) -> dict:
     """The ledger's `extras["comm_quant"]` value: the inertness-aware
     format label plus the static wire-byte model for this (mode, world,
     size) cell — the bandwidth axis of the accuracy-vs-bandwidth frontier.
+
+    On a factorized mesh (`mesh_spec` set) the summary is the two-level
+    per-link breakdown from `hier_wire_bytes_summary`, so a per-link spec
+    like ``dcn=fp8-block:32,ici=none`` shows its wire-byte reduction
+    charged only to the link class that was quantized.
     """
     tp = (world // dp) if dp else None
     extra: dict = {
         "spec": config.comm_quant,
         "format": comm_quant_extra(config, world, dp=dp, tp=tp),
     }
-    fmt = parse_wire_format(config.comm_quant)
-    inert = (fmt is None or world <= 1
+    if is_per_link_spec(config.comm_quant):
+        quantized = any(f is not None
+                        for f in parse_link_formats(config.comm_quant).values())
+    else:
+        quantized = parse_wire_format(config.comm_quant) is not None
+    inert = (not quantized or world <= 1
              or jnp.issubdtype(jnp.dtype(config.dtype), jnp.integer))
     if not inert:
-        from tpu_matmul_bench.analysis.comms_model import wire_bytes_summary
+        from tpu_matmul_bench.analysis.comms_model import (
+            hier_wire_bytes_summary, wire_bytes_summary)
 
         try:
-            extra.update(wire_bytes_summary(
-                mode, world, size, config.dtype, config.comm_quant,
-                batch=batch, dp=dp, rows=rows))
+            if mesh_spec is not None:
+                extra.update(hier_wire_bytes_summary(
+                    mode, mesh_spec, size, config.dtype, config.comm_quant,
+                    batch=batch))
+            else:
+                # per-link spec on a flat mesh: every axis is single-slice,
+                # so the ici entry governs the whole program
+                uniform = link_format_spec(config.comm_quant, "x")
+                if uniform is not None:
+                    extra.update(wire_bytes_summary(
+                        mode, world, size, config.dtype, uniform,
+                        batch=batch, dp=dp, rows=rows))
         except ValueError:
             pass  # modes the analytic model doesn't cover stay label-only
     return extra

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_matmul_bench.ops.matmul import matmul_2d
-from tpu_matmul_bench.parallel.mesh import mesh_device_kind
+from tpu_matmul_bench.parallel.mesh import mesh_device_kind, mesh_spec_of
 from tpu_matmul_bench.parallel.mesh import sharded_normal, smap
 from tpu_matmul_bench.parallel.modes import (
     ModeSetup,
@@ -57,7 +57,14 @@ def hybrid_programs(mesh: Mesh, impl: str = "xla",
                     comm_quant: str | None = None):
     """(compute, full) shard_map programs for the composed dp×tp step.
     `comm_quant="int8"` routes BOTH collectives over the int8 wire (the
-    tp column gather and the dp gradient-sync psum)."""
+    tp column gather and the dp gradient-sync psum).
+
+    Axis roles come from POSITION, not name: the outer mesh axis is data
+    parallelism, the inner tensor parallelism. On the flat ('dp', 'tp')
+    mesh this is the PR-4 program byte for byte; on a factorized
+    ('dcn', 'ici') mesh the gradient psum rides DCN and the column gather
+    stays on ICI — and a per-link --comm-quant splits accordingly."""
+    dp_ax, tp_ax = mesh.axis_names
     mm = matmul_2d(impl, blocks, mesh_device_kind(mesh))
     # the tp gather feeds the dp reduction, not the ledger: fuse_f32 keeps
     # the block formats' dequantized values in fp32 through the batch sum
@@ -75,36 +82,38 @@ def hybrid_programs(mesh: Mesh, impl: str = "xla",
         y = jax.lax.optimization_barrier(compute_body(x, w))
         out_dt = y.dtype  # the exact program's output dtype
         # tp leg: assemble full output columns on every tp rank
-        y = ag(y, "tp", axis=2)
+        y = ag(y, tp_ax, axis=2)
         # dp leg: gradient-sync-style reduction of the batch shard sum
-        # (psum_impl's varying_out covers the 'dp' axis; the quantized
+        # (psum_impl's varying_out covers the dp axis; the quantized
         # ring's output is varying already, exact psum gets a pcast)
-        g = psum(jnp.sum(y, axis=0), "dp")
+        g = psum(jnp.sum(y, axis=0), dp_ax)
         # the single downcast for the fused wire formats; a no-op (and not
         # traced) for exact, legacy-quantized and integer programs
         g = g.astype(out_dt)
-        return pcast_varying(g, "tp")
+        return pcast_varying(g, tp_ax)
 
     compute = smap(compute_body, mesh,
-                   in_specs=(P("dp"), P(None, "tp")),
-                   out_specs=P("dp", None, "tp"), check_vma=False)
+                   in_specs=(P(dp_ax), P(None, tp_ax)),
+                   out_specs=P(dp_ax, None, tp_ax), check_vma=False)
     full = smap(full_body, mesh,
-                in_specs=(P("dp"), P(None, "tp")),
-                out_specs=P(("dp", "tp")), check_vma=False)
+                in_specs=(P(dp_ax), P(None, tp_ax)),
+                out_specs=P((dp_ax, tp_ax)), check_vma=False)
     return compute, full
 
 
 def hybrid_mode(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
                 benchmark: str = "hybrid") -> ModeSetup:
-    dp, tp = mesh.shape["dp"], mesh.shape["tp"]
+    dp_ax, tp_ax = mesh.axis_names
+    dp, tp = mesh.shape[dp_ax], mesh.shape[tp_ax]
+    mesh_spec = mesh_spec_of(mesh)
     world = dp * tp
     local_batch = max(batch // dp, 1)
     g = local_batch * dp
 
     x, = sharded_normal(config.seed, (g, size, size), config.dtype, mesh,
-                        P("dp"), count=1)
+                        P(dp_ax), count=1)
     w, = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
-                        P(None, "tp"), count=1)
+                        P(None, tp_ax), count=1)
     compute, full = hybrid_programs(mesh, config.matmul_impl, config.blocks,
                                     comm_quant=config.comm_quant)
 
@@ -114,12 +123,16 @@ def hybrid_mode(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
         total = calculate_tflops(size, total_s, num_ops=g)
         extras = {"dp": dp, "tp": tp, "global_batch": g,
                   "local_batch": local_batch}
+        if mesh_spec is not None:
+            extras["mesh"] = mesh_spec
         if uses_quantized_comm(config):
             # per-axis inertness (dp=1 → the psum is a no-op, tp=1 → the
             # gather is) is worded by comm_quant_extra itself; the dict
-            # adds the static wire-byte model for the frontier
+            # adds the static wire-byte model for the frontier (per-link
+            # on a factorized mesh)
             extras["comm_quant"] = comm_quant_record_extra(
-                config, world, mode="hybrid", size=size, batch=batch, dp=dp)
+                config, world, mode="hybrid", size=size, batch=batch, dp=dp,
+                mesh_spec=mesh_spec)
         if g != batch:
             extras["note"] = f"global batch grown from {batch} to {g} to cover dp={dp}"
         return BenchmarkRecord(

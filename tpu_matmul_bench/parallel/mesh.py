@@ -35,6 +35,97 @@ except ImportError:  # jax 0.4.x: experimental module, check_rep spelling
                               out_specs=out_specs, check_rep=check_vma)
 
 
+# mesh-axis link classes, slowest first: 'dcn' is the data-center network
+# between hosts (the process boundary under run_multihost_benchmark.sh),
+# 'ici' the intra-slice interconnect. A factorized mesh's axis NAMES are
+# its link-class metadata — `axis_link_class` maps every axis (including
+# the flat 1-D 'x' and the legacy 'dp'/'tp'/'i'/'j' spellings, all
+# single-slice) back to the class the comms model prices it at.
+LINK_CLASSES = ("dcn", "ici")
+
+
+def parse_mesh_spec(spec: str) -> tuple[tuple[str, int], ...]:
+    """Parse a --mesh factorization, e.g. ``dcn:2,ici:4`` → (("dcn", 2),
+    ("ici", 4)).
+
+    Grammar: comma-separated ``<class>:<size>`` with class ∈ {dcn, ici},
+    each class at most once, sizes positive. When both classes appear,
+    ``dcn`` must come first — the outer (slowest-link) device dimension,
+    matching the multi-process launcher's layout where the process
+    boundary is DCN.
+    """
+    if not spec or not spec.strip():
+        raise ValueError("--mesh spec is empty (expected e.g. dcn:2,ici:4)")
+    axes: list[tuple[str, int]] = []
+    for part in spec.split(","):
+        cls, sep, arg = part.strip().partition(":")
+        if not sep or cls not in LINK_CLASSES:
+            raise ValueError(
+                f"--mesh {spec!r}: bad axis {part.strip()!r} (expected "
+                f"<class>:<size> with class in {LINK_CLASSES})")
+        try:
+            size = int(arg)
+        except ValueError:
+            size = 0
+        if size <= 0:
+            raise ValueError(
+                f"--mesh {spec!r}: axis size {arg!r} must be a positive int")
+        if any(cls == c for c, _ in axes):
+            raise ValueError(f"--mesh {spec!r}: axis class {cls!r} repeats")
+        axes.append((cls, size))
+    if len(axes) > 2:
+        raise ValueError(f"--mesh {spec!r}: at most two axes (dcn, ici)")
+    if len(axes) == 2 and axes[0][0] != "dcn":
+        raise ValueError(
+            f"--mesh {spec!r}: dcn (the outer, slower link) must come first")
+    return tuple(axes)
+
+
+def canonical_mesh_spec(spec: str) -> str:
+    """The normalized --mesh string — the form fingerprints and identity
+    labels fold, so ``dcn:2 , ici:4`` and ``dcn:2,ici:4`` never fork a
+    series."""
+    return ",".join(f"{cls}:{size}" for cls, size in parse_mesh_spec(spec))
+
+
+def make_factorized_mesh(devices: Sequence[jax.Device] | None,
+                         spec: str) -> Mesh:
+    """Build the two-level (or degenerate one-level) mesh a --mesh spec
+    names: axis names ARE the link classes, so every collective routed
+    over an axis is priced at that axis's link by construction."""
+    axes = parse_mesh_spec(spec)
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    shape = tuple(size for _, size in axes)
+    if int(np.prod(shape)) != devs.size:
+        raise ValueError(
+            f"--mesh {spec!r} covers {int(np.prod(shape))} devices but "
+            f"{devs.size} are available")
+    return Mesh(devs.reshape(shape), tuple(cls for cls, _ in axes))
+
+
+def mesh_spec_of(mesh: Mesh) -> str | None:
+    """The canonical --mesh spec a mesh was built from, or None for the
+    flat/legacy meshes (axis names that aren't link classes). The one
+    detection door for "is this a factorized mesh" — ledger extras,
+    fingerprints, and history labels all fold this exact string."""
+    if not all(name in LINK_CLASSES for name in mesh.axis_names):
+        return None
+    return ",".join(f"{name}:{mesh.shape[name]}" for name in mesh.axis_names)
+
+
+def axis_link_class(axis_name: str) -> str:
+    """The link class a mesh axis's collectives travel on. Only the
+    factorized meshes' literal 'dcn' axis crosses the data-center network;
+    every other axis name (flat 'x', hybrid 'dp'/'tp', SUMMA 'i'/'j',
+    and 'ici' itself) stays on the slice interconnect."""
+    return "dcn" if axis_name == "dcn" else "ici"
+
+
+def mesh_link_classes(mesh: Mesh) -> dict[str, str]:
+    """axis name → link class for every axis of a mesh."""
+    return {name: axis_link_class(name) for name in mesh.axis_names}
+
+
 def make_mesh(
     devices: Sequence[jax.Device] | None = None,
     axis_names: tuple[str, ...] = ("x",),

@@ -171,9 +171,22 @@ def quantized_tolerance(comm_quant: str | None, world: int) -> float | None:
     max (so 2·world/254, the PR-2 bound); float8_e4m3fn's 3-bit mantissa
     rounds to at most 1/16 of each value (so 2·world/16 — loose, a sanity
     rail; the seeded accuracy bounds live in tests/test_comm_quant_block).
-    """
-    from tpu_matmul_bench.parallel.collectives import parse_wire_format
 
+    A per-link spec takes the loosest per-step rounding among its named
+    formats (a conservative rail: `world` here is already the caller's
+    widest-reduction estimate, and only some of those hops are quantized).
+    """
+    from tpu_matmul_bench.parallel.collectives import (
+        is_per_link_spec, parse_link_formats, parse_wire_format)
+
+    if is_per_link_spec(comm_quant):
+        fmts = [f for f in parse_link_formats(comm_quant).values()
+                if f is not None]
+        if not fmts:
+            return None
+        per_step = max(2 / 254 if f.qtype == "int8" else 2 / 16
+                       for f in fmts)
+        return max(validation_tolerance(jnp.bfloat16), world * per_step)
     fmt = parse_wire_format(comm_quant)
     if fmt is None:
         return None
@@ -296,13 +309,14 @@ def independent(config: BenchConfig, mesh: Mesh, size: int,
     timed loop. System TFLOPS = SUM over devices; scaling efficiency =
     total / (per-device · world) (reference `:313-315`).
     """
-    d = world_size(mesh)
+    ax = mesh.axis_names[0]
+    d = world_size(mesh, ax)
     mm = matmul_2d(config.matmul_impl, config.blocks,
                    mesh_device_kind(mesh))
-    a, b = sharded_normal(config.seed, (d, size, size), config.dtype, mesh, P("x"))
+    a, b = sharded_normal(config.seed, (d, size, size), config.dtype, mesh, P(ax))
     compute = _smap(
         _stacked_mm(mm),
-        mesh, in_specs=(P("x"), P("x")), out_specs=P("x"),
+        mesh, in_specs=(P(ax), P(ax)), out_specs=P(ax),
     )
 
     def build(t_compute: Timing, t_full: Timing | None, comm_s: float) -> BenchmarkRecord:
@@ -342,20 +356,21 @@ def batch_parallel(config: BenchConfig, mesh: Mesh, size: int, batch: int = 4,
     here local batch is floored at 1 and the global batch grows to
     world·local, keeping every device busy (deviation noted in extras).
     """
-    d = world_size(mesh)
+    ax = mesh.axis_names[0]
+    d = world_size(mesh, ax)
     local_batch = max(batch // d, 1)
     g = local_batch * d
     mm = matmul_2d(config.matmul_impl, config.blocks,
                    mesh_device_kind(mesh))
-    a, b = sharded_normal(config.seed, (g, size, size), config.dtype, mesh, P("x"))
+    a, b = sharded_normal(config.seed, (g, size, size), config.dtype, mesh, P(ax))
     compute = _smap(
         _stacked_mm(mm),
-        mesh, in_specs=(P("x"), P("x")), out_specs=P("x"),
+        mesh, in_specs=(P(ax), P(ax)), out_specs=P(ax),
     )
     psum = psum_impl(config.comm_quant, varying_out=True)
     full = _smap(
-        lambda x, y: psum(_barrier(_stacked_mm(mm)(x, y)), "x"),
-        mesh, in_specs=(P("x"), P("x")), out_specs=P("x"),
+        lambda x, y: psum(_barrier(_stacked_mm(mm)(x, y)), ax),
+        mesh, in_specs=(P(ax), P(ax)), out_specs=P(ax),
     )
 
     def build(t_compute: Timing, t_full: Timing | None, comm_s: float) -> BenchmarkRecord:
@@ -406,7 +421,8 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
     compute+comm time, divided by world (`:233`); the record's total is the
     'actual' figure full-FLOPs/time (`:334`).
     """
-    d = world_size(mesh)
+    ax = mesh.axis_names[0]
+    d = world_size(mesh, ax)
     if d == 1:
         setup = independent(config, mesh, size, benchmark)
         if uses_quantized_comm(config):
@@ -428,7 +444,7 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
     # A replicated (≙ reference's per-rank identical A, :176), B column-sharded
     (a,) = sharded_normal(config.seed, (size, size), config.dtype, mesh, P(), count=1)
     (b,) = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
-                          P(None, "x"), count=1)
+                          P(None, ax), count=1)
 
     mm = matmul_2d(config.matmul_impl, config.blocks,
                    mesh_device_kind(mesh))
@@ -437,11 +453,11 @@ def matrix_parallel(config: BenchConfig, mesh: Mesh, size: int,
     ag = allgather_impl(config.comm_quant)
     compute = _smap(
         mm,
-        mesh, in_specs=(P(), P(None, "x")), out_specs=P(None, "x"),
+        mesh, in_specs=(P(), P(None, ax)), out_specs=P(None, ax),
     )
     full = _smap(
-        lambda x, y: ag(_barrier(mm(x, y)), "x", axis=1),
-        mesh, in_specs=(P(), P(None, "x")), out_specs=P(), check_vma=False,
+        lambda x, y: ag(_barrier(mm(x, y)), ax, axis=1),
+        mesh, in_specs=(P(), P(None, ax)), out_specs=P(), check_vma=False,
     )
 
     def build(t_compute: Timing, t_full: Timing | None, comm_s: float) -> BenchmarkRecord:
@@ -485,18 +501,19 @@ def data_parallel(config: BenchConfig, mesh: Mesh, size: int,
     TFLOPS are computed from the compute leg only (reference `:108`), with
     comm reported separately.
     """
-    d = world_size(mesh)
+    ax = mesh.axis_names[0]
+    d = world_size(mesh, ax)
     mm = matmul_2d(config.matmul_impl, config.blocks,
                    mesh_device_kind(mesh))
-    a, b = sharded_normal(config.seed, (d, size, size), config.dtype, mesh, P("x"))
+    a, b = sharded_normal(config.seed, (d, size, size), config.dtype, mesh, P(ax))
     compute = _smap(
         _stacked_mm(mm),
-        mesh, in_specs=(P("x"), P("x")), out_specs=P("x"),
+        mesh, in_specs=(P(ax), P(ax)), out_specs=P(ax),
     )
     psum = psum_impl(config.comm_quant, varying_out=True)
     full = _smap(
-        lambda x, y: psum(_barrier(_stacked_mm(mm)(x, y)), "x"),
-        mesh, in_specs=(P("x"), P("x")), out_specs=P("x"),
+        lambda x, y: psum(_barrier(_stacked_mm(mm)(x, y)), ax),
+        mesh, in_specs=(P(ax), P(ax)), out_specs=P(ax),
     )
 
     def build(t_compute: Timing, t_full: Timing | None, comm_s: float) -> BenchmarkRecord:
@@ -543,30 +560,31 @@ def model_parallel(config: BenchConfig, mesh: Mesh, size: int,
     whose ring cost matches all_gather's within a factor ~2, and the result
     verifies against a single-device matmul.
     """
-    d = world_size(mesh)
+    ax = mesh.axis_names[0]
+    d = world_size(mesh, ax)
     (a,) = sharded_normal(config.seed, (size, size), config.dtype, mesh,
-                          P(None, "x"), count=1)
+                          P(None, ax), count=1)
     (b,) = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
-                          P("x", None), count=1)
+                          P(ax, None), count=1)
 
     partial_product = matmul_2d(config.matmul_impl, config.blocks,
                                 mesh_device_kind(mesh))
 
     compute = _smap(
         partial_product, mesh,
-        in_specs=(P(None, "x"), P("x", None)), out_specs=P(None, "x"),
+        in_specs=(P(None, ax), P(ax, None)), out_specs=P(None, ax),
     )
 
     psum = psum_impl(config.comm_quant)
 
     def full_body(x, y):
         part = _barrier(partial_product(x, y))
-        return psum(part, "x")  # correct combine (see docstring)
+        return psum(part, ax)  # correct combine (see docstring)
 
     # after the psum every device holds the full C → replicated output
     full = _smap(
         full_body, mesh,
-        in_specs=(P(None, "x"), P("x", None)), out_specs=P(),
+        in_specs=(P(None, ax), P(ax, None)), out_specs=P(),
         check_vma=False,
     )
 

@@ -1,0 +1,154 @@
+"""The out-of-core K-streaming runner: MEM-gate, stream, validate, time.
+
+`ops/stream_k.py` owns the mechanics (plan, staging, jitted consumer);
+this module is the benchmark program around them, with the certification
+order the subsystem promises:
+
+1. **Gate before allocating.** `analysis/memory_model.check_stream_budget`
+   (MEM-003) must return clean for the plan BEFORE any host or device
+   allocation — the static certificate that the resident window fits
+   ``--mem-budget-gib``. The contrast half
+   (`nonstreaming_over_budget`) records which in-core modes the same
+   shape MEM-gates, so the record proves "this matmul ran HERE and could
+   not have run THERE".
+2. **Stream.** Host-resident operands, double-buffered K-panel windows,
+   row-sharded high-precision accumulator (ops/stream_k.py docstring).
+3. **Validate.** ``--validate`` corner-checks the sharded accumulator
+   against a float64 host reference of the same corner.
+
+Run: python -m tpu_matmul_bench parallel stream --sizes 4096 \
+         --stream-k 8 --mem-budget-gib 0.5
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from tpu_matmul_bench.analysis.memory_model import (
+    DEFAULT_BUDGET_GIB,
+    check_stream_budget,
+    nonstreaming_over_budget,
+    stream_window_bytes,
+)
+from tpu_matmul_bench.ops.stream_k import (
+    StreamPlan,
+    acc_dtype,
+    stream_matmul,
+)
+from tpu_matmul_bench.parallel.modes import (
+    VALIDATION_CORNER,
+    corner_validation,
+)
+from tpu_matmul_bench.utils.config import BenchConfig
+from tpu_matmul_bench.utils.metrics import calculate_tflops
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord, report
+
+#: default panel count when --stream-k is omitted: enough panels that the
+#: window is a small fraction of the operand, few enough to keep the
+#: per-window dispatch overhead invisible at benchmark sizes
+DEFAULT_PANELS = 8
+
+
+def host_operands(config: BenchConfig, size: int
+                  ) -> tuple[np.ndarray, np.ndarray]:
+    """Seeded HOST operands — numpy end to end, so generation never
+    touches the device allocator (the whole point is that these may not
+    fit there)."""
+    rng = np.random.default_rng(config.seed)
+    dt = np.dtype(config.dtype)
+    if np.issubdtype(dt, np.integer):
+        a = rng.integers(-4, 4, (size, size), dtype=np.int8).astype(dt)
+        b = rng.integers(-4, 4, (size, size), dtype=np.int8).astype(dt)
+        return a, b
+    a = rng.standard_normal((size, size), dtype=np.float32).astype(dt)
+    b = rng.standard_normal((size, size), dtype=np.float32).astype(dt)
+    return a, b
+
+
+def _expected_corner_host(a: np.ndarray, b: np.ndarray,
+                          corner: int = VALIDATION_CORNER) -> np.ndarray:
+    """float64 host reference for the C[:corner, :corner] block (full-K
+    dot of A's first rows with B's first columns)."""
+    c = min(corner, a.shape[0], b.shape[1])
+    if np.issubdtype(a.dtype, np.integer):
+        return a[:c].astype(np.int64) @ b[:, :c].astype(np.int64)
+    return a[:c].astype(np.float64) @ b[:, :c].astype(np.float64)
+
+
+def stream_gate(config: BenchConfig, size: int, world: int,
+                ) -> tuple[StreamPlan, dict]:
+    """Run the MEM-003 gate for one shape; returns the validated plan and
+    the certificate extras, or raises SystemExit(1) with the finding
+    printed — the runner's no-allocation-without-certificate contract."""
+    panels = config.stream_k or DEFAULT_PANELS
+    budget = (config.mem_budget_gib if config.mem_budget_gib is not None
+              else DEFAULT_BUDGET_GIB)
+    plan = StreamPlan(size=size, panels=panels, window=2, world=world)
+    findings = check_stream_budget(size, config.dtype, world, panels,
+                                   window=plan.window, budget_gib=budget)
+    if findings:
+        for f in findings:
+            report(f"\nMEM GATE [{f.severity}] {f.rule} {f.where}: "
+                   f"{f.message}")
+        raise SystemExit(1)
+    resident = stream_window_bytes(size, config.dtype, world, panels,
+                                   window=plan.window)
+    full_gib = (2 * size * size * np.dtype(config.dtype).itemsize
+                + size * size * np.dtype(acc_dtype(config.dtype)).itemsize
+                ) / 2**30
+    over = nonstreaming_over_budget(config, world, size, budget)
+    return plan, {
+        "panels": plan.panels,
+        "window": plan.window,
+        "resident_gib": round(resident / 2**30, 4),
+        "budget_gib": budget,
+        "full_problem_gib": round(full_gib, 4),
+        # the contrast certificate: in-core modes the SAME budget rejects
+        "nonstreaming_over_budget": over,
+        "out_of_core": bool(over),
+    }
+
+
+def stream_benchmark(config: BenchConfig, mesh, size: int
+                     ) -> BenchmarkRecord:
+    """Gate, stream, validate, and time one out-of-core matmul."""
+    world = mesh.size
+    plan, cert = stream_gate(config, size, world)
+
+    a, b = host_operands(config, size)
+    if config.validate:
+        c = stream_matmul(a, b, mesh, plan)
+        got = np.asarray(jax.device_get(
+            c[:VALIDATION_CORNER, :VALIDATION_CORNER]))
+        verdict = corner_validation(got, _expected_corner_host(a, b),
+                                    config.dtype)
+        del c
+    else:
+        verdict = {}
+
+    # one warmup pass compiles the consumer and touches every code path;
+    # further warmup would re-stream the full operands for nothing
+    jax.block_until_ready(stream_matmul(a, b, mesh, plan))
+    t0 = time.perf_counter()
+    for _ in range(config.iterations):
+        jax.block_until_ready(stream_matmul(a, b, mesh, plan))
+    total = time.perf_counter() - t0
+    avg = total / config.iterations
+
+    tflops_total = calculate_tflops(size, avg)
+    rec = BenchmarkRecord(
+        benchmark="stream", mode="stream_k", size=size,
+        dtype=config.dtype_name, world=world,
+        iterations=config.iterations, warmup=1,
+        avg_time_s=avg,
+        tflops_per_device=tflops_total / world,
+        tflops_total=tflops_total,
+        extras={"stream_k": cert},
+    )
+    if config.mesh:
+        rec.extras["mesh"] = config.mesh
+    rec.extras.update(verdict)
+    return rec

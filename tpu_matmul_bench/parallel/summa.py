@@ -41,7 +41,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_matmul_bench.ops.matmul import matmul_2d
-from tpu_matmul_bench.parallel.mesh import mesh_device_kind
+from tpu_matmul_bench.parallel.mesh import mesh_device_kind, mesh_spec_of
 from tpu_matmul_bench.parallel.mesh import sharded_normal, smap
 from tpu_matmul_bench.parallel.modes import (
     ModeSetup,
@@ -107,8 +107,16 @@ def summa_min_size(n_devices: int, floor: int = 1,
 def summa_programs(mesh: Mesh, impl: str = "xla",
                    blocks: tuple[int, int, int] | None = None,
                    comm_quant: str | None = None):
-    """(compute, full) shard_map programs for the SUMMA step on `mesh`."""
-    r, c = mesh.shape["i"], mesh.shape["j"]
+    """(compute, full) shard_map programs for the SUMMA step on `mesh`.
+
+    Grid roles come from POSITION: the outer mesh axis is the grid rows
+    ('i'), the inner the columns ('j'). On the flat ('i', 'j') mesh this
+    is the PR-6 program byte for byte; on a factorized ('dcn', 'ici')
+    mesh the B-panel broadcast (over rows) rides DCN while the A-panel
+    broadcast (over columns) stays on ICI — the two disjoint broadcasts
+    mapped onto the two link classes."""
+    i_ax, j_ax = mesh.axis_names
+    r, c = mesh.shape[i_ax], mesh.shape[j_ax]
     s = math.lcm(r, c)
     mm = matmul_2d(impl, blocks, mesh_device_kind(mesh))
     # fuse_f32: the broadcast panels feed the step matmul directly, so the
@@ -122,8 +130,8 @@ def summa_programs(mesh: Mesh, impl: str = "xla",
         # a_local [m/r, k/c], b_local [k/r, n/c]; k panels of width k/s
         kb_a = a_local.shape[1] // (s // c)   # panel width inside A block
         kb_b = b_local.shape[0] // (s // r)   # panel height inside B block
-        my_j = lax.axis_index("j")
-        my_i = lax.axis_index("i")
+        my_j = lax.axis_index(j_ax)
+        my_i = lax.axis_index(i_ax)
         out_dtype = matmul_out_dtype(a_local.dtype)
         acc0 = jnp.zeros((a_local.shape[0], b_local.shape[1]), out_dtype)
 
@@ -136,25 +144,27 @@ def summa_programs(mesh: Mesh, impl: str = "xla",
                 b_local, (t % (s // r)) * kb_b, kb_b, axis=0)
             if with_comm:
                 # mesh-axis broadcast: the owner contributes, others zeros
-                a_pan = psum(jnp.where(my_j == col_owner, a_pan, 0), "j")
-                b_pan = psum(jnp.where(my_i == row_owner, b_pan, 0), "i")
+                a_pan = psum(jnp.where(my_j == col_owner, a_pan, 0), j_ax)
+                b_pan = psum(jnp.where(my_i == row_owner, b_pan, 0), i_ax)
             return acc + mm(a_pan, b_pan).astype(out_dtype), None
 
         acc, _ = lax.scan(step, acc0, jnp.arange(s))
         return acc
 
     compute = smap(lambda a, b: body(a, b, False), mesh,
-                   in_specs=(P("i", "j"), P("i", "j")),
-                   out_specs=P("i", "j"), check_vma=False)
+                   in_specs=(P(i_ax, j_ax), P(i_ax, j_ax)),
+                   out_specs=P(i_ax, j_ax), check_vma=False)
     full = smap(lambda a, b: body(a, b, True), mesh,
-                in_specs=(P("i", "j"), P("i", "j")),
-                out_specs=P("i", "j"), check_vma=False)
+                in_specs=(P(i_ax, j_ax), P(i_ax, j_ax)),
+                out_specs=P(i_ax, j_ax), check_vma=False)
     return compute, full
 
 
 def summa_mode(config: BenchConfig, mesh: Mesh, size: int,
                benchmark: str = "summa") -> ModeSetup:
-    r, c = mesh.shape["i"], mesh.shape["j"]
+    i_ax, j_ax = mesh.axis_names
+    r, c = mesh.shape[i_ax], mesh.shape[j_ax]
+    mesh_spec = mesh_spec_of(mesh)
     world = r * c
     s = math.lcm(r, c)
     if size % (r * s) or size % (c * s):
@@ -165,9 +175,9 @@ def summa_mode(config: BenchConfig, mesh: Mesh, size: int,
             f"c·lcm(r,c)={c * s} for the ({r}x{c}) SUMMA grid")
 
     (a,) = sharded_normal(config.seed, (size, size), config.dtype, mesh,
-                          P("i", "j"), count=1)
+                          P(i_ax, j_ax), count=1)
     (b,) = sharded_normal(config.seed + 1, (size, size), config.dtype, mesh,
-                          P("i", "j"), count=1)
+                          P(i_ax, j_ax), count=1)
     compute, full = summa_programs(mesh, config.matmul_impl, config.blocks,
                                    comm_quant=config.comm_quant)
 
@@ -177,9 +187,12 @@ def summa_mode(config: BenchConfig, mesh: Mesh, size: int,
         total = calculate_tflops(size, total_s)
         extras = {"grid": f"{r}x{c}", "k_panels": s,
                   "algorithm": "SUMMA (2-D grid, masked-psum broadcasts)"}
+        if mesh_spec is not None:
+            extras["mesh"] = mesh_spec
         if uses_quantized_comm(config):
             extras["comm_quant"] = comm_quant_record_extra(
-                config, world, mode="summa", size=size, rows=r)
+                config, world, mode="summa", size=size, rows=r,
+                mesh_spec=mesh_spec)
         return BenchmarkRecord(
             benchmark=benchmark, mode="summa", size=size,
             dtype=config.dtype_name, world=world,

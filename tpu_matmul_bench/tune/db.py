@@ -95,7 +95,9 @@ def canonical_dtype(dtype: Any) -> str:
 
 
 def problem_fingerprint(m: int, k: int, n: int, dtype: Any,
-                        comm_quant: str | None = None) -> str:
+                        comm_quant: str | None = None,
+                        mesh: str | None = None,
+                        stream_k: int | None = None) -> str:
     """Stable digest of one routing question. Hashing convention shared
     with the DRIFT gate (analysis/fingerprint.digest).
 
@@ -105,13 +107,26 @@ def problem_fingerprint(m: int, k: int, n: int, dtype: Any,
     never alias the full-precision cell for the same shape. The key is
     only added when a format is active — every pre-PR-10 fingerprint
     (and the committed DB) is unchanged; quantized-wire routing starts
-    from empty cells rather than inheriting full-precision winners."""
+    from empty cells rather than inheriting full-precision winners.
+
+    A mesh factorization and a K-streaming plan fold in the same way
+    (PR 15): ``mesh`` (canonicalized — "dcn:2,ici:4") and ``stream_k``
+    (the panel count) join the digest only when set, so every flat-mesh
+    in-core fingerprint is byte-identical to what it always was, while
+    hierarchical/out-of-core problems hash to NEW fingerprints and never
+    inherit flat winners."""
     from tpu_matmul_bench.analysis.fingerprint import digest
 
     record = {"op": "matmul_2d", "m": int(m), "k": int(k),
               "n": int(n), "dtype": canonical_dtype(dtype)}
     if comm_quant and comm_quant != "none":
         record["comm_quant"] = str(comm_quant)
+    if mesh:
+        from tpu_matmul_bench.parallel.mesh import canonical_mesh_spec
+
+        record["mesh"] = canonical_mesh_spec(mesh)
+    if stream_k:
+        record["stream_k"] = int(stream_k)
     return digest(record)
 
 
@@ -162,6 +177,10 @@ class Cell:
     # collectives); folded into the fingerprint so quantized cells never
     # alias full-precision ones
     comm_quant: str | None = None
+    # mesh factorization ("dcn:R,ici:C"; None = flat) and K-streaming
+    # panel count (None = in-core) — same folding contract as comm_quant
+    mesh: str | None = None
+    stream_k: int | None = None
 
     def __post_init__(self) -> None:
         if self.provenance_kind not in PROVENANCE_KINDS:
@@ -175,7 +194,8 @@ class Cell:
     @property
     def fingerprint(self) -> str:
         return problem_fingerprint(self.m, self.k, self.n, self.dtype,
-                                   self.comm_quant)
+                                   self.comm_quant, mesh=self.mesh,
+                                   stream_k=self.stream_k)
 
     @property
     def key(self) -> tuple[str, str]:
@@ -195,6 +215,10 @@ class Cell:
                                    "dtype": self.dtype}
         if self.comm_quant and self.comm_quant != "none":
             problem["comm_quant"] = self.comm_quant
+        if self.mesh:
+            problem["mesh"] = self.mesh
+        if self.stream_k:
+            problem["stream_k"] = self.stream_k
         return {
             "record_type": "tune_cell",
             "schema": CELL_SCHEMA,
@@ -231,6 +255,8 @@ class Cell:
             program_digest=str(rec.get("program_digest", "")),
             created_at=str(rec.get("created_at", "")),
             comm_quant=prob.get("comm_quant"),
+            mesh=prob.get("mesh"),
+            stream_k=prob.get("stream_k"),
         )
 
 

@@ -84,6 +84,12 @@ class BenchConfig:
     # best of N repeats is the stable headline estimator (what bench.py's
     # best-of-3 protocol does at the harness level)
     repeats: int = 1
+    # hierarchical mesh factorization ("dcn:R,ici:C"); None = flat 'x'
+    mesh: str | None = None
+    # out-of-core K-streaming: panels per matmul (None = not streaming)
+    stream_k: int | None = None
+    # per-device memory budget the MEM-* gates certify against
+    mem_budget_gib: float | None = None
 
     @property
     def wres_override(self) -> bool | None:
@@ -111,13 +117,27 @@ class BenchConfig:
 
 def comm_quant_arg(value: str) -> str:
     """argparse type for --comm-quant: validate against the wire-format
-    grammar (none | int8 | int8-tensor | fp8 | int8-block:<B> |
-    fp8-block:<B>) at parse time, keeping the raw string as the config
-    value (parallel/collectives.py parses it again where it is used)."""
-    from tpu_matmul_bench.parallel.collectives import parse_wire_format
+    grammar — uniform (none | int8 | int8-tensor | fp8 | int8-block:<B> |
+    fp8-block:<B>) or per-link (dcn=<fmt>,ici=<fmt>) — at parse time,
+    keeping the raw string as the config value (parallel/collectives.py
+    parses it again where it is used)."""
+    from tpu_matmul_bench.parallel.collectives import validate_comm_quant
 
     try:
-        parse_wire_format(value)
+        validate_comm_quant(value)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+    return value
+
+
+def mesh_arg(value: str) -> str:
+    """argparse type for --mesh: validate the dcn:R,ici:C factorization
+    grammar at parse time, keeping the raw string (parallel/mesh.py
+    builds the mesh where it is used)."""
+    from tpu_matmul_bench.parallel.mesh import parse_mesh_spec
+
+    try:
+        parse_mesh_spec(value)
     except ValueError as e:
         raise argparse.ArgumentTypeError(str(e))
     return value
@@ -195,7 +215,33 @@ def build_parser(
              "'int8-block:<B>'/'fp8-block:<B>' quantize per B-column block "
              "with one fp32 scale each and fuse the dequant into the "
              "consuming matmul. Applies to every distributed mode's "
-             "psum/all_gather leg.",
+             "psum/all_gather leg. The per-link form "
+             "'dcn=<fmt>,ici=<fmt>' picks a format per link class on a "
+             "--mesh factorized mesh (unnamed links stay exact).",
+    )
+    p.add_argument(
+        "--mesh", type=mesh_arg, default=None, metavar="dcn:R[,ici:C]",
+        help="Hierarchical mesh factorization (parallel/mesh.py): axis "
+             "names ARE link classes — 'dcn' the slow inter-host network "
+             "(the process boundary under run_multihost_benchmark.sh), "
+             "'ici' the slice interconnect. The 2-D modes (hybrid, summa) "
+             "map their outer parallelism onto dcn and inner onto ici; a "
+             "per-link --comm-quant splits wire formats accordingly. "
+             "Default: the flat 1-D 'x' mesh.",
+    )
+    p.add_argument(
+        "--stream-k", type=int, default=None, metavar="PANELS",
+        help="Out-of-core K-streaming (ops/stream_k.py): split K into "
+             "PANELS host-resident panels consumed through a bounded "
+             "double-buffered device window. Only the `parallel stream` "
+             "program consumes this; the in-core modes reject it.",
+    )
+    p.add_argument(
+        "--mem-budget-gib", type=float, default=None, metavar="GIB",
+        help="Per-device memory budget the MEM-* gates certify against "
+             "(analysis/memory_model.py; default: 16 GiB, one v5e HBM). "
+             "The streaming runner refuses to allocate anything unless "
+             "MEM-003 proves its resident window fits.",
     )
     p.add_argument(
         "--precision", type=str, default="default",
@@ -299,6 +345,9 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         wres=getattr(args, "wres", "auto"),
         timing=getattr(args, "timing", "dispatch"),
         repeats=getattr(args, "repeats", 1),
+        mesh=getattr(args, "mesh", None),
+        stream_k=getattr(args, "stream_k", None),
+        mem_budget_gib=getattr(args, "mem_budget_gib", None),
     )
 
 

@@ -487,6 +487,68 @@ def _digest_fault_audit(recs: list[dict]) -> None:
           f"crash consistency {verdict}")
 
 
+def _tail_shares(walls_spans: list[tuple[float, dict[str, float]]],
+                 quantile: float = 0.95) -> dict | None:
+    """p95+ tail attribution over (wall_ms, component_ms) pairs.
+    Inlined rather than imported from serve.trace so the script stays
+    runnable standalone against a copied-off ledger dir."""
+    if not walls_spans:
+        return None
+    walls = sorted(w for w, _ in walls_spans)
+    pos = (len(walls) - 1) * quantile
+    lo, hi = int(pos), min(int(pos) + 1, len(walls) - 1)
+    threshold = walls[lo] + (walls[hi] - walls[lo]) * (pos - lo)
+    tail = [(w, s) for w, s in walls_spans if w >= threshold]
+    total = sum(w for w, _ in tail) or 1.0
+    shares = {c: 0.0 for c in
+              ("queue_wait", "batch_wait", "compile", "execute")}
+    for _, spans in tail:
+        for comp, ms in spans.items():
+            shares[comp] = shares.get(comp, 0.0) + ms
+    return {"threshold_ms": threshold, "tail_count": len(tail),
+            "shares": {c: 100.0 * v / total for c, v in shares.items()}}
+
+
+def _digest_serve_spans(recs: list[dict]) -> None:
+    """Flight-recorder span lines (serve_span): per-bucket p95+ tail
+    attribution — which component (queue-wait / batch-wait / compile /
+    execute) owns the tail's wall time. A quiet p99 can hide the tail's
+    cause migrating between components; this table surfaces it."""
+    comp_of = {"queue_wait": "queue_wait", "batch_wait": "batch_wait",
+               "cache": "compile", "execute": "execute"}
+    by_bucket: dict[str, list[tuple[float, dict[str, float]]]] = {}
+    terminal = {"complete": 0, "shed": 0, "other": 0}
+    for r in recs:
+        state = str(r.get("state"))
+        if state != "complete":
+            terminal["shed" if state.startswith("shed") else "other"] += 1
+            continue
+        terminal["complete"] += 1
+        comps: dict[str, float] = {}
+        for sp in r.get("spans") or []:
+            comp = comp_of.get(sp.get("name"))
+            if comp:
+                comps[comp] = comps.get(comp, 0.0) + (sp.get("ms") or 0.0)
+        pair = (float(r.get("wall_ms") or 0.0), comps)
+        by_bucket.setdefault(str(r.get("bucket")), []).append(pair)
+        by_bucket.setdefault("(all)", []).append(pair)
+    print(f"  [trace] {len(recs)} serve_span lines "
+          f"({terminal['complete']} complete, {terminal['shed']} shed, "
+          f"{terminal['other']} other) — tail attribution, p95+ share "
+          "of tail wall time:")
+    print(f"  {'bucket':<28} {'n':>5} {'p95 ms':>8} "
+          f"{'queue%':>7} {'batch%':>7} {'compile%':>8} {'exec%':>7}")
+    for bucket in sorted(by_bucket, key=lambda b: (b != "(all)", b)):
+        att = _tail_shares(by_bucket[bucket])
+        if att is None:
+            continue
+        s = att["shares"]
+        print(f"  {bucket:<28} {len(by_bucket[bucket]):>5} "
+              f"{att['threshold_ms']:>8.3f} "
+              f"{s['queue_wait']:>7.1f} {s['batch_wait']:>7.1f} "
+              f"{s['compile']:>8.1f} {s['execute']:>7.1f}")
+
+
 def _is_campaign_dir(p: Path) -> bool:
     return (p / _JOURNAL).exists() or (p / _JOBS_SUBDIR).is_dir()
 
@@ -712,6 +774,13 @@ def main(paths: list[str]) -> None:
             print(f"  [stream] {len(batches)} serve_batch lines "
                   f"({done} requests, {failed} failed) — liveness "
                   "channel, excluded from ranking")
+        # per-request flight-recorder terminal lines: distilled to the
+        # tail-attribution table, never ranked as measurements
+        spans = [r for r in recs if r.get("record_type") == "serve_span"]
+        if spans:
+            recs = [r for r in recs
+                    if r.get("record_type") != "serve_span"]
+            _digest_serve_spans(spans)
         if any(r.get("record_type") in ("lint_finding", "lint_summary")
                for r in recs):
             _digest_lint(recs, manifests)

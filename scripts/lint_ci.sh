@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Ten layers, cheapest first:
+# Eleven layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -67,6 +67,15 @@
 #      over-budget streaming window and certify a fitting one, and a
 #      small streamed matmul must validate numerically on a factorized
 #      mesh.
+#  11. python -m tpu_matmul_bench serve trace selftest — the per-request
+#      flight recorder: the TRACE-001/002/003 span-coverage audit must
+#      be clean (every shed/breaker raise emits a terminal record, the
+#      terminal-state vocabulary is covered, exemplar reservoirs are
+#      bounded), then a seeded in-process serve run must stream one
+#      terminal serve_span record per request whose span chain
+#      reconciles against measured wall latency within 5%, with the
+#      slowest trace retained as a histogram exemplar and `serve
+#      explain` rendering it.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -111,3 +120,6 @@ JAX_PLATFORMS=cpu python -m tpu_matmul_bench obs detect --fail-on error
 echo "== parallel hier selftest (DCN x ICI inventory + out-of-core gate) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m tpu_matmul_bench parallel hier selftest
+
+echo "== serve trace selftest (flight recorder / span reconciliation) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench serve trace selftest

@@ -12,7 +12,9 @@ the repo's actual history:
   comm-quant frontier campaign, the multi-tenant serve campaign, and
   the serialized-executable serve proof;
 - round 7: the hierarchical DCN×ICI campaign (factorized meshes,
-  per-link wire formats, and the out-of-core K-streaming rider).
+  per-link wire formats, and the out-of-core K-streaming rider);
+- round 8: the flight-recorder serve run (per-request serve_span
+  ledger, from which the serve_tail tail-attribution series derive).
 
 The output is byte-deterministic (no wall-clock anywhere in a point:
 timestamps come only from ledger manifests), so
@@ -45,6 +47,7 @@ POST_ROUND_DIRS = (
     ("measurements/comm_quant", "measurements/serve_tenants",
      "measurements/serve_artifacts"),
     ("measurements/hier",),
+    ("measurements/serve_trace",),
 )
 
 

@@ -240,6 +240,28 @@ class TestSupervisor:
         assert res.rc is None and not res.timed_out
         assert "spawn failed" in res.error
 
+    def test_heartbeat_override_routes_off_the_log(self, tmp_path):
+        from tpu_matmul_bench.faults.supervisor import supervised_run
+
+        hb = tmp_path / ".state" / "hb" / "t.log.hb"
+        res = supervised_run([sys.executable, "-c", "print('ok')"],
+                             log_path=tmp_path / "jobs" / "t.log",
+                             heartbeat=hb)
+        assert res.rc == 0
+        assert hb.exists()
+        # no .hb sibling lands next to the (committed) job log
+        assert not list((tmp_path / "jobs").glob("*.hb"))
+
+    def test_executor_launch_keeps_jobs_dir_hb_free(self, tmp_path):
+        from tpu_matmul_bench.campaign.executor import _default_launch
+
+        log = tmp_path / "camp" / "jobs" / "j.log"
+        res = _default_launch([sys.executable, "-c", "print('hi')"],
+                              log=log, timeout_s=30.0, env=None)
+        assert res.rc == 0
+        assert (tmp_path / "camp" / ".state" / "hb" / "j.log.hb").exists()
+        assert not list(log.parent.glob("*.hb"))
+
 
 # ---------------------------------------------------------------------------
 # retry policy + budget
@@ -586,6 +608,77 @@ class TestServeBatchRecord:
 
 
 # ---------------------------------------------------------------------------
+# serve_span stream contract (PR 16 flight recorder)
+
+
+def _span_record(i, wall=2.0, state="complete"):
+    q = round(wall * 0.5, 4)
+    b = round(wall * 0.1, 4)
+    c = 0.01
+    e = round(wall - q - b - c, 4)
+    return {"record_type": "serve_span", "trace": f"run-r{i:06d}",
+            "rid": i, "tenant": "default",
+            "bucket": "256x256x256/float32", "state": state,
+            "wall_ms": wall,
+            "spans": [{"name": "queue_wait", "ms": q},
+                      {"name": "batch_wait", "ms": b},
+                      {"name": "cache", "ms": c, "hit": True},
+                      {"name": "execute", "ms": e}]}
+
+
+class TestServeSpanRecord:
+    def test_valid_record_passes(self):
+        from tpu_matmul_bench.serve.trace import validate_serve_span_record
+
+        assert validate_serve_span_record(_span_record(1)) == []
+
+    @pytest.mark.parametrize("mutate", [
+        lambda d: d.update(record_type="serve_batch"),
+        lambda d: d.pop("trace"),
+        lambda d: d.update(rid="one"),
+        lambda d: d.update(state="vanished"),
+        lambda d: d.update(wall_ms=-1.0),
+        lambda d: d.update(spans=d["spans"][:2]),       # broken chain
+        lambda d: d["spans"][0].update(name="mystery"),
+        lambda d: d["spans"][3].update(ms=-0.5),
+        lambda d: d.update(wall_ms=d["wall_ms"] * 2),   # fails 5% gate
+    ])
+    def test_broken_records_fail(self, mutate):
+        from tpu_matmul_bench.serve.trace import validate_serve_span_record
+
+        d = _span_record(1)
+        mutate(d)
+        assert validate_serve_span_record(d)
+
+    def test_shed_record_needs_no_span_chain(self):
+        from tpu_matmul_bench.serve.trace import validate_serve_span_record
+
+        d = _span_record(2, state="shed_overflow")
+        d["spans"] = []
+        d["wall_ms"] = 0.0
+        assert validate_serve_span_record(d) == []
+
+    def test_explain_degrades_on_torn_tail(self, tmp_path, capsys):
+        from tpu_matmul_bench.serve.trace import run_explain
+
+        p = tmp_path / "serve.jsonl"
+        lines = [json.dumps({"record_type": "manifest",
+                             "schema_version": 2,
+                             "serve_config": {"scheduler": "continuous",
+                                              "mix": "256",
+                                              "load_mode": "open"}})]
+        lines += [json.dumps(_span_record(i, wall=2.0 + i))
+                  for i in range(3)]
+        data = ("\n".join(lines) + "\n").encode()
+        p.write_bytes(data[:-17])  # torn mid-last-record
+        rc = run_explain(str(p), slowest=5)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "warning" in out
+        assert out.count("reconciliation") == 2
+
+
+# ---------------------------------------------------------------------------
 # torn-line fuzz (satellite): every durable JSONL artifact, truncated AND
 # garbled at every byte offset of its last record, must stay readable by
 # the repo's own reader — all complete records recovered, nothing raised.
@@ -663,12 +756,35 @@ def _build_history(tmp_path):
     return path, count
 
 
+def _build_serve_spans(tmp_path):
+    from tpu_matmul_bench.utils.reporting import JsonWriter
+
+    path = tmp_path / "serve.jsonl"
+    w = JsonWriter(str(path),
+                   manifest={"record_type": "manifest",
+                             "schema_version": 2})
+    for i in range(3):
+        w.write_raw(_span_record(i, wall=2.0 + i))
+    w.close()
+
+    def count(p):
+        from tpu_matmul_bench.serve.trace import (
+            read_trace_records, validate_serve_span_record)
+
+        _, recs, _ = read_trace_records(p)
+        return sum(1 for r in recs
+                   if not validate_serve_span_record(r))
+
+    return path, count
+
+
 _ARTIFACTS = {
     "campaign_journal": _build_journal,
     "tune_db": _build_tune_db,
     "obs_snapshots": _build_obs,
     "faults_ledger": _build_ledger,
     "history_store": _build_history,
+    "serve_span_stream": _build_serve_spans,
 }
 
 

@@ -29,7 +29,7 @@ REPO = Path(__file__).resolve().parent.parent
 #: new value after a regen; a mismatch means the store and the tree
 #: drifted apart (commit the regenerated file AND update this pin)
 COMMITTED_STORE_SHA256 = (
-    "e07c0b390f58157560ce00d94e9af1b5f744bc23c6a76d8a0962b619b4407a02")
+    "58e4e53780432e2c28984301bdcbb4dd5642f5dce2b238060e1b831b030a4b46")
 
 
 def _mk(labels, value, *, seq, status="ok", noise_pct=None, digest=None,
@@ -598,3 +598,81 @@ class TestHierLabels:
         streams = [p for p in store.points()
                    if (p.get("labels") or {}).get("stream_k")]
         assert streams and streams[0]["labels"]["stream_k"] == 32
+
+
+class TestServeTailSeries:
+    """PR 16: the flight recorder's serve_span lines distill to
+    kind="serve_tail" tail-attribution series, and tail *composition*
+    drift is symmetric — a share migrating in either direction fires
+    HIST-001, never "improves"."""
+
+    @staticmethod
+    def _span_ledger(path, walls):
+        man = {"record_type": "manifest", "schema_version": 2,
+               "created_unix": 1.7e9, "device_kind": "cpu",
+               "serve_config": {"mix": "256", "qps": 50.0,
+                                "scheduler": "continuous",
+                                "load_mode": "open", "tenants": None,
+                                "dtype": "float32"}}
+        lines = [json.dumps(man)]
+        for i, wall in enumerate(walls):
+            q, e = round(wall * 0.6, 4), round(wall * 0.35, 4)
+            b = round(wall - q - e - 0.01, 4)
+            lines.append(json.dumps({
+                "record_type": "serve_span", "trace": f"t-r{i:06d}",
+                "rid": i, "tenant": "default", "bucket": "256x256x256",
+                "state": "complete", "wall_ms": wall,
+                "spans": [{"name": "queue_wait", "ms": q},
+                          {"name": "batch_wait", "ms": b},
+                          {"name": "cache", "ms": 0.01, "hit": True},
+                          {"name": "execute", "ms": e}]}))
+        path.write_text("\n".join(lines) + "\n")
+        return path
+
+    def test_points_from_span_ledger(self, tmp_path):
+        p = self._span_ledger(tmp_path / "run.jsonl",
+                              [1.0, 1.1, 1.2, 1.3, 5.0])
+        pts = [pt for pt in hist.points_from_source(p)
+               if pt["metric"] == "tail_share_pct"]
+        assert len(pts) == 4
+        labels = pts[0]["labels"]
+        assert labels["kind"] == "serve_tail"
+        assert labels["scheduler"] == "continuous"
+        by_comp = {pt["labels"]["component"]: pt["value"] for pt in pts}
+        assert set(by_comp) == {"queue_wait", "batch_wait", "compile",
+                                "execute"}
+        assert sum(by_comp.values()) == pytest.approx(100.0, abs=0.5)
+        # the seeded chain is 60% queue / 35% execute
+        assert by_comp["queue_wait"] == pytest.approx(60.0, abs=1.0)
+        assert pts[0]["unit"] == "pct"
+        assert pts[0]["detail"]["tail_count"] >= 1
+
+    def test_components_are_distinct_series(self, tmp_path):
+        p = self._span_ledger(tmp_path / "run.jsonl", [1.0, 2.0, 9.0])
+        pts = [pt for pt in hist.points_from_source(p)
+               if pt["metric"] == "tail_share_pct"]
+        assert len({pt["series"] for pt in pts}) == 4
+
+    def test_composition_shift_is_symmetric_hist_001(self, tmp_path):
+        labels = {"kind": "serve_tail", "metric": "tail_share_pct",
+                  "component": "queue_wait", "mix": "256"}
+        up = _seed_store(tmp_path / "up", [30.0, 31.0, 60.0],
+                         labels=labels, metric="tail_share_pct")
+        assert _rules(det.detect_findings(up)) == [("HIST-001", "error")]
+        down = _seed_store(tmp_path / "dn", [60.0, 61.0, 30.0],
+                           labels=labels, metric="tail_share_pct")
+        findings = det.detect_findings(down)
+        assert _rules(findings) == [("HIST-001", "error")]
+        assert "shifted" in findings[0].message
+        # composition has no "better" direction: never HIST-002
+        assert all(f.rule != "HIST-002" for f in
+                   det.detect_findings(up) + det.detect_findings(down))
+
+    def test_committed_store_has_serve_tail_series(self):
+        store = hist.HistoryStore.load()
+        tail = [p for p in store.points()
+                if (p.get("labels") or {}).get("kind") == "serve_tail"]
+        comps = {p["labels"].get("component") for p in tail}
+        assert comps == {"queue_wait", "batch_wait", "compile",
+                         "execute"}
+        assert all(p["unit"] == "pct" for p in tail)

@@ -616,3 +616,93 @@ def test_seeded_hier_spec_violations(tmp_path):
 def test_hier_rules_in_catalog():
     assert set(RULES) >= {"COLL-H-001", "COLL-H-002", "COLL-H-003",
                           "MEM-003", "SPEC-008"}
+
+
+# ------------------------------------------ flight-recorder seeds (PR 16)
+
+def _trace_findings(tree):
+    from tpu_matmul_bench.serve.trace import trace_findings
+
+    return trace_findings(root=tree)
+
+
+def test_trace_rules_in_catalog():
+    assert set(RULES) >= {"TRACE-001", "TRACE-002", "TRACE-003"}
+    for rule in ("TRACE-001", "TRACE-002", "TRACE-003"):
+        assert RULES[rule][0] == "error", rule
+
+
+def test_trace_audit_clean_on_shipped_tree():
+    from tpu_matmul_bench.serve.trace import trace_findings
+
+    assert trace_findings() == []
+
+
+def test_seeded_shed_without_emission_flags_trace001(tmp_path):
+    # string-concatenated so the audit never trips on this test file
+    bad = "def shed(self, req):\n    rai" + \
+        "se QueueOverflowError('full')\n"
+    (tmp_path / "sched.py").write_text(bad)
+    findings = _trace_findings(tmp_path)
+    assert [(f.rule, f.severity) for f in findings] == \
+        [("TRACE-001", "error")]
+    assert findings[0].where == "sched.py:2"
+
+    good = ("def shed(self, recorder, req):\n"
+            "    recorder.term" + "inal(req, 'shed_overflow')\n"
+            "    rai" + "se QueueOverflowError('full')\n")
+    (tmp_path / "sched.py").write_text(good)
+    assert _trace_findings(tmp_path) == []
+
+
+def test_seeded_trace002_unknown_state(tmp_path):
+    (tmp_path / "svc.py").write_text(
+        "recorder.term" + "inal(req, 'vanished')\n")
+    findings = _trace_findings(tmp_path)
+    assert [(f.rule, f.severity) for f in findings] == \
+        [("TRACE-002", "error")]
+    assert "vanished" in findings[0].message
+
+
+def test_seeded_trace002_duplicate_state_site(tmp_path):
+    (tmp_path / "svc.py").write_text(
+        "recorder.term" + "inal(req, 'complete')\n"
+        "recorder.term" + "inal(req2, 'complete')\n")
+    findings = _trace_findings(tmp_path)
+    assert [(f.rule, f.where) for f in findings] == \
+        [("TRACE-002", "svc.py:2")]
+    assert "more than one site" in findings[0].message
+
+
+def test_seeded_trace002_nonliteral_state(tmp_path):
+    (tmp_path / "svc.py").write_text(
+        "recorder.term" + "inal(req, state_var)\n")
+    findings = _trace_findings(tmp_path)
+    assert [f.rule for f in findings] == ["TRACE-002"]
+    assert "string literal" in findings[0].message
+
+
+def test_seeded_unbounded_exemplar_reservoir_flags_trace003(tmp_path):
+    (tmp_path / "reg.py").write_text(
+        "class H:\n"
+        "    def __init__(self):\n"
+        "        self._exemplars = []\n")
+    findings = _trace_findings(tmp_path)
+    assert [(f.rule, f.severity, f.where) for f in findings] == \
+        [("TRACE-003", "error", "reg.py")]
+
+    # bounded reservoir: clean
+    (tmp_path / "reg.py").write_text(
+        "EXEMPLAR_LIMIT = 8\n"
+        "class H:\n"
+        "    def __init__(self):\n"
+        "        self._exemplars = []\n"
+        "        del self._exemplars[EXEMPLAR_LIMIT:]\n")
+    assert _trace_findings(tmp_path) == []
+
+
+def test_seeded_oversized_exemplar_limit_flags_trace003(tmp_path):
+    (tmp_path / "reg.py").write_text("EXEMPLAR_LIMIT = 4096\n")
+    findings = _trace_findings(tmp_path)
+    assert [f.rule for f in findings] == ["TRACE-003"]
+    assert "outside" in findings[0].message

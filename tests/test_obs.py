@@ -240,6 +240,40 @@ def test_health_endpoints(tmp_path):
         exp.stop_http()
 
 
+def test_metrics_exemplars_behind_flag(tmp_path):
+    """Loopback probe for OpenMetrics exemplar annotation: tail quantile
+    lines carry `# {trace_id=...}` only when the exporter opts in."""
+    def _reg():
+        reg = MetricsRegistry()
+        h = reg.histogram("probe_ms")
+        for i, v in enumerate((1.0, 2.0, 50.0)):
+            h.observe(v, trace_id=f"run-r{i:06d}")
+        return reg
+
+    exp = obs_export.SnapshotExporter(tmp_path / "on", registry=_reg(),
+                                      interval_s=60.0, exemplars=True)
+    try:
+        port = exp.start_http(0)
+        code, body = _http_get(port, obs_export.METRICS_PATH)
+        assert code == 200
+        # the slowest observation's trace id rides the p99 line
+        assert '# {trace_id="run-r000002"} 50.0' in body
+        p99 = next(ln for ln in body.splitlines()
+                   if 'quantile="0.99"' in ln)
+        assert "trace_id" in p99
+    finally:
+        exp.stop_http()
+
+    off = obs_export.SnapshotExporter(tmp_path / "off", registry=_reg(),
+                                      interval_s=60.0)
+    try:
+        port = off.start_http(0)
+        code, body = _http_get(port, obs_export.METRICS_PATH)
+        assert code == 200 and "trace_id" not in body
+    finally:
+        off.stop_http()
+
+
 def test_readiness_bound_scales_with_interval(tmp_path):
     exp = obs_export.SnapshotExporter(tmp_path, registry=MetricsRegistry(),
                                       interval_s=0.05)

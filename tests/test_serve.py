@@ -415,3 +415,75 @@ def test_serve_bench_sheds_under_tiny_depth(tmp_path):
     assert s["shed"] > 0
     assert s["shed_rate_pct"] > 0
     assert s["queue"]["shed"] == s["shed"]
+
+
+def test_serve_explain_end_to_end_reconciles(tmp_path):
+    """PR 16 acceptance: a seeded CPU serve run streams one terminal
+    serve_span record per request, and `serve explain --slowest 3`
+    decomposes each trace into spans summing within 5% of measured wall
+    latency."""
+    from tpu_matmul_bench.serve.trace import (
+        read_trace_records, reconciles, validate_serve_span_record)
+
+    ledger = tmp_path / "serve.jsonl"
+    out = _run_serve(["bench", "--qps", "60", "--duration", "0.8",
+                      "--mix", "64,128:0.5", "--prewarm", "--seed", "3",
+                      "--json-out", str(ledger)])
+    assert out.returncode == 0, out.stderr[-2000:]
+
+    _, span_recs, problems = read_trace_records(ledger)
+    assert problems == []
+    assert span_recs, "no serve_span records in the ledger"
+    for rec in span_recs:
+        assert validate_serve_span_record(rec) == [], rec
+    completes = [r for r in span_recs if r["state"] == "complete"]
+    _, records = _ledger(ledger)
+    assert len(completes) == records[0]["extras"]["serve"]["requests"]
+    assert len({r["trace"] for r in span_recs}) == len(span_recs)
+    for rec in completes:
+        ok, delta_pct = reconciles(rec)
+        assert ok, (rec["trace"], delta_pct)
+
+    # the CLI view: jax-free explain renders the slowest traces
+    out = _run_serve(["explain", "--ledger", str(ledger),
+                      "--slowest", "3"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.count("reconciliation") == min(3, len(completes))
+    assert "FAIL" not in out.stdout
+    slowest = max(completes, key=lambda r: r["wall_ms"])
+    assert slowest["trace"] in out.stdout
+
+    # --trace targets one id; a bogus id is a loud nonzero exit
+    out = _run_serve(["explain", "--ledger", str(ledger),
+                      "--trace", slowest["trace"]])
+    assert out.returncode == 0
+    assert out.stdout.count("trace ") == 1
+    out = _run_serve(["explain", "--ledger", str(ledger),
+                      "--trace", "no-such-trace"])
+    assert out.returncode == 1
+
+
+def test_serve_shed_requests_leave_terminal_spans(tmp_path):
+    """Refused requests must not vanish from the trace record: every
+    shed carries a trace id and a terminal serve_span line."""
+    from tpu_matmul_bench.serve.trace import read_trace_records
+
+    ledger = tmp_path / "shed.jsonl"
+    out = _run_serve(["bench", "--qps", "300", "--duration", "1",
+                      "--mix", "256", "--max-depth", "1",
+                      "--json-out", str(ledger)])
+    assert out.returncode == 0, out.stderr[-2000:]
+    _, span_recs, _ = read_trace_records(ledger)
+    _, records = _ledger(ledger)
+    s = records[0]["extras"]["serve"]
+    shed = [r for r in span_recs if r["state"].startswith("shed")]
+    assert len(shed) == s["shed"] > 0
+    assert all(r["trace"] for r in shed)
+
+
+def test_serve_trace_selftest_cli():
+    """Layer-11 gate: span-coverage audit + seeded run + exemplar bound
+    + explain reconciliation, in one in-process command."""
+    out = _run_serve(["trace", "selftest"])
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "trace selftest ok" in out.stdout

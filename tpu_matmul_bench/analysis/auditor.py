@@ -890,6 +890,16 @@ def audit_faults() -> list[Finding]:
     return static_findings()
 
 
+def audit_trace() -> list[Finding]:
+    """TRACE-001/002/003: every scheduler shed/breaker site emits a
+    terminal span, terminal states are covered exactly once per
+    admission path, exemplar retention is bounded (serve/trace.py owns
+    the scan; this is the lint wiring)."""
+    from tpu_matmul_bench.serve.trace import trace_findings
+
+    return trace_findings()
+
+
 # ---------------------------------------------------------------------------
 # COLL-H-*: the hierarchical (DCN×ICI) mesh contract (PR 15)
 # ---------------------------------------------------------------------------
@@ -1057,6 +1067,7 @@ AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "memory": _audit_memory,
     "fingerprint": _audit_fingerprint,
     "faults": audit_faults,
+    "trace": audit_trace,
 }
 
 #: groups that compile optimized HLO (slower than trace-only audits);

@@ -199,6 +199,20 @@ RULES: dict[str, tuple[Severity, str]] = {
                           "not covering --num-devices, a malformed "
                           "per-link --comm-quant, or a non-positive "
                           "--stream-k / --mem-budget-gib"),
+    "TRACE-001": ("error", "scheduler shed/breaker raise site with no "
+                           "adjacent flight-recorder terminal emission — "
+                           "a refused request would vanish from the "
+                           "per-request trace record"),
+    "TRACE-002": ("error", "terminal-span coverage broken: an emission "
+                           "site uses an unknown terminal state, a state "
+                           "is emitted at more than one site in a file "
+                           "(a request could get two terminal spans), or "
+                           "a terminal state has no emission site at all"),
+    "TRACE-003": ("error", "unbounded exemplar retention: an exemplar "
+                           "reservoir is declared without an "
+                           "EXEMPLAR_LIMIT bound, or the limit is outside "
+                           "its sane range — trace-id retention behind "
+                           "tail quantiles must stay small"),
 }
 
 

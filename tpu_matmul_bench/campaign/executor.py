@@ -86,11 +86,16 @@ def _default_launch(cmd: list[str], *, log: Path, timeout_s: float,
                     heartbeat_timeout_s: float | None = None) -> LaunchResult:
     """Production launch: the supervisor owns the child — deadline AND
     heartbeat-stall escalation (SIGTERM, grace, SIGKILL to the process
-    group), with the ladder recorded in the job log (DESIGN §17)."""
+    group), with the ladder recorded in the job log (DESIGN §17).
+
+    The heartbeat file lives under ``<campaign>/.state/hb/`` (scratch
+    state, gitignored) rather than as a ``.log.hb`` sibling — campaign
+    job dirs are committed, and liveness signals are not artifacts."""
     return supervised_run(
         cmd, log_path=log, timeout_s=timeout_s or None,
         env=dict(env) if env is not None else None,
-        heartbeat_timeout_s=heartbeat_timeout_s)
+        heartbeat_timeout_s=heartbeat_timeout_s,
+        heartbeat=log.parent.parent / ".state" / "hb" / f"{log.name}.hb")
 
 
 def ledger_measurement_count(ledger: Path) -> int:

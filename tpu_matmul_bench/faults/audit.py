@@ -429,9 +429,18 @@ def _validate_serve_line(d: dict) -> list[str]:
         SERVE_BATCH_RECORD_TYPE,
         validate_serve_batch_record,
     )
+    from tpu_matmul_bench.serve.trace import (
+        SERVE_SPAN_RECORD_TYPE,
+        validate_serve_span_record,
+    )
 
     if d.get("record_type") == SERVE_BATCH_RECORD_TYPE:
         return validate_serve_batch_record(d)
+    if d.get("record_type") == SERVE_SPAN_RECORD_TYPE:
+        # per-request terminal span lines ride the same fsynced channel:
+        # every complete line a killed run left behind must be schema-
+        # valid AND reconcile against its own recorded wall latency
+        return validate_serve_span_record(d)
     return []
 
 

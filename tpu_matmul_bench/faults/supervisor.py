@@ -73,7 +73,11 @@ class LaunchResult:
 
 
 def heartbeat_path(log_path: str | os.PathLike[str]) -> Path:
-    """The heartbeat file paired with a job log (jobs/x.log -> x.log.hb)."""
+    """Default heartbeat file paired with a job log (jobs/x.log ->
+    x.log.hb). Callers that keep their logs under version control
+    should pass `supervised_run(..., heartbeat=...)` pointing at
+    scratch state instead — heartbeats are runtime liveness signals,
+    not artifacts."""
     p = Path(log_path)
     return p.with_name(p.name + ".hb")
 
@@ -95,6 +99,7 @@ def supervised_run(
     timeout_s: float | None = None,
     env: dict | None = None,
     heartbeat_timeout_s: float | None = None,
+    heartbeat: str | os.PathLike[str] | None = None,
     term_grace_s: float = DEFAULT_TERM_GRACE_S,
 ) -> LaunchResult:
     """Run `cmd` under supervision, appending its output to `log_path`.
@@ -109,7 +114,8 @@ def supervised_run(
     """
     log = Path(log_path)
     log.parent.mkdir(parents=True, exist_ok=True)
-    hb = heartbeat_path(log)
+    hb = Path(heartbeat) if heartbeat is not None else heartbeat_path(log)
+    hb.parent.mkdir(parents=True, exist_ok=True)
     run_env = dict(os.environ if env is None else env)
     run_env[fault_plan.HEARTBEAT_ENV] = str(hb)
     with open(log, "a") as fh:

@@ -57,6 +57,12 @@ SERIES_NOISE_CAP_PCT = 15.0
 #: serve cells) are tracked
 EXPLORATORY_KINDS = frozenset({"tune"})
 
+#: metrics with no "better" direction — a tail-composition share
+#: drifting either way beyond the band is a shift in WHERE the tail's
+#: latency goes (e.g. execute-dominated → queue-dominated), which is a
+#: regression signal in both directions, never an improvement
+SYMMETRIC_METRICS = frozenset({"tail_share_pct"})
+
 #: [history] table vocabulary in specs/history.toml
 HISTORY_SPEC_KEYS = ("store", "detect_window", "min_rounds",
                      "threshold_pct", "stale_rounds",
@@ -155,7 +161,7 @@ def _series_label(points: list[dict[str, Any]]) -> str:
     parts = [str(labels.get("kind", "?"))]
     for key in ("harness", "benchmark", "mode", "size", "dtype", "world",
                 "backend", "comm_quant", "blocks", "mix", "scheduler",
-                "qps", "cell", "n_devices"):
+                "qps", "cell", "n_devices", "component"):
         val = labels.get(key)
         if val in (None, "", "none", 1):
             continue
@@ -236,8 +242,14 @@ def _series_findings(sid: str, points: list[dict[str, Any]],
         snoise = series_noise_pct([by_round[r]["value"] for r in window])
         tol = tolerance_pct(cfg, point_noise=point_noise,
                             series_noise=snoise)
-        regressed = delta_pct > tol if lower else delta_pct < -tol
-        improved = delta_pct < -tol if lower else delta_pct > tol
+        if metric in SYMMETRIC_METRICS:
+            # composition shares: any beyond-band move is a shift in
+            # the tail's cause, flagged as a regression either way
+            regressed = abs(delta_pct) > tol
+            improved = False
+        else:
+            regressed = delta_pct > tol if lower else delta_pct < -tol
+            improved = delta_pct < -tol if lower else delta_pct > tol
         details = {"series": sid, "metric": metric,
                    "latest": latest["value"], "latest_round": window[-1],
                    "last_known_good": lkg["value"],
@@ -246,9 +258,11 @@ def _series_findings(sid: str, points: list[dict[str, Any]],
                    "delta_pct": round(delta_pct, 3),
                    "tolerance_pct": round(tol, 3)}
         if regressed:
+            verb = "shifted" if metric in SYMMETRIC_METRICS \
+                else "regressed"
             out.append(Finding(
                 "HIST-001", label,
-                f"{metric} regressed {abs(delta_pct):.2f}% beyond the "
+                f"{metric} {verb} {abs(delta_pct):.2f}% beyond the "
                 f"{tol:.2f}% noise band vs last-known-good "
                 f"{lkg['value']:.4g} (round {details['lkg_round']}, "
                 f"{lkg.get('source')})",

@@ -66,16 +66,23 @@ def snapshot_record(registry: MetricsRegistry | None = None, *,
     }
 
 
-def prometheus_text(snap: dict[str, Any]) -> str:
+def prometheus_text(snap: dict[str, Any], *, exemplars: bool = False) -> str:
     """Text exposition of one snapshot. Histograms render as Prometheus
     *summaries*: pre-computed quantiles as ``{quantile="0.5"}`` labels
     plus ``_count``/``_sum`` series (windowed quantiles can't be
-    re-aggregated server-side, which is exactly a summary's contract)."""
+    re-aggregated server-side, which is exactly a summary's contract).
+
+    With ``exemplars=True``, tail quantile lines (p95/p99) carry an
+    OpenMetrics exemplar suffix — ``# {trace_id="..."} <value>`` — naming
+    the flight-recorder trace closest to that quantile from above, so a
+    scraped tail is one hop from `serve explain --trace`. Off by
+    default: the exemplar syntax predates some parsers."""
     lines: list[str] = []
     typed: set[str] = set()
 
     def emit(series: str, kind: str, value: Any,
-             extra_label: str | None = None) -> None:
+             extra_label: str | None = None,
+             exemplar: tuple[str, float] | None = None) -> None:
         name = series.split("{", 1)[0]
         if name not in typed:
             lines.append(f"# TYPE {name} {kind}")
@@ -85,7 +92,22 @@ def prometheus_text(snap: dict[str, Any]) -> str:
                 series = series[:-1] + "," + extra_label + "}"
             else:
                 series = series + "{" + extra_label + "}"
-        lines.append(f"{series} {value}")
+        suffix = ""
+        if exemplar is not None:
+            suffix = f' # {{trace_id="{exemplar[0]}"}} {exemplar[1]}'
+        lines.append(f"{series} {value}{suffix}")
+
+    def _tail_exemplar(summary: dict[str, Any],
+                       quantile_value: Any) -> tuple[str, float] | None:
+        """The retained exemplar nearest the quantile from above (the
+        reservoir keeps the K largest, so anything >= a tail quantile
+        that survived the bound is an honest witness for it)."""
+        exs = summary.get("exemplars") or []
+        at_or_above = [e for e in exs if e["value"] >= quantile_value]
+        if not at_or_above:
+            return None
+        pick = min(at_or_above, key=lambda e: e["value"])
+        return str(pick["trace_id"]), float(pick["value"])
 
     for series, value in (snap.get("counters") or {}).items():
         emit(series, "counter", value)
@@ -98,8 +120,10 @@ def prometheus_text(snap: dict[str, Any]) -> str:
             labels = "{" + labels
         for qlabel, q in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
             if qlabel in summary:
+                ex = _tail_exemplar(summary, summary[qlabel]) \
+                    if exemplars and qlabel in ("p95", "p99") else None
                 emit(series, "summary", summary[qlabel],
-                     extra_label=f'quantile="{q}"')
+                     extra_label=f'quantile="{q}"', exemplar=ex)
         emit(name + "_count" + labels, "summary", summary.get("count", 0))
         emit(name + "_sum" + labels, "summary", summary.get("sum", 0.0))
     return "\n".join(lines) + "\n"
@@ -119,13 +143,16 @@ class SnapshotExporter:
                  registry: MetricsRegistry | None = None,
                  interval_s: float = DEFAULT_INTERVAL_S,
                  run_id: str | None = None,
-                 seq_start: int = 0) -> None:
+                 seq_start: int = 0,
+                 exemplars: bool = False) -> None:
         self.out_dir = Path(out_dir)
         self.snapshot_path = self.out_dir / SNAPSHOT_NAME
         self.prom_path = self.out_dir / PROM_NAME
         self._registry = registry
         self._interval_s = max(float(interval_s), 0.01)
         self._run_id = run_id
+        # OpenMetrics exemplar annotation on exported tail quantiles
+        self._exemplars = bool(exemplars)
         # seq_start lets a resumed process continue an existing snapshot
         # file with monotonic seq numbers (faults/workloads.py) instead
         # of restarting at 1
@@ -153,7 +180,7 @@ class SnapshotExporter:
             fh.flush()
             _fsync_best_effort(fh)
         tmp = self.prom_path.with_suffix(".prom.tmp")
-        tmp.write_text(prometheus_text(snap))
+        tmp.write_text(prometheus_text(snap, exemplars=self._exemplars))
         os.replace(tmp, self.prom_path)
         self._last_flush_unix = time.time()
         return snap
@@ -240,9 +267,12 @@ class SnapshotExporter:
                     try:
                         text = exporter.prom_path.read_text()
                     except OSError:
-                        text = prometheus_text(snapshot_record(
-                            exporter._registry, run_id=exporter._run_id,
-                            seq=exporter._seq))
+                        text = prometheus_text(
+                            snapshot_record(
+                                exporter._registry,
+                                run_id=exporter._run_id,
+                                seq=exporter._seq),
+                            exemplars=exporter._exemplars)
                     self._reply(200, text,
                                 ctype="text/plain; version=0.0.4")
                 else:

@@ -34,6 +34,12 @@ DEFAULT_HISTOGRAM_WINDOW = 2048
 
 QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
 
+# Exemplar reservoir bound per histogram: the K largest observations
+# retain their trace ids, so a tail quantile in any snapshot can name
+# the requests that produced it (flight-recorder forensics; lint
+# TRACE-003 certifies this bound exists and stays small).
+EXEMPLAR_LIMIT = 8
+
 # global write sequence: lets snapshot() resolve "last set wins" across
 # gauge instruments that share a series without comparing wall clocks
 _SEQ = itertools.count(1)
@@ -116,18 +122,28 @@ class Histogram(_Instrument):
         self._count = 0
         self._sum = 0.0
         self._max = 0.0
+        # tail exemplars: (value, trace_id), largest values first,
+        # bounded at EXEMPLAR_LIMIT — the bridge from a p99 summary
+        # back to the individual requests that live in the tail
+        self._exemplars: list[tuple[float, str]] = []
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: str | None = None) -> None:
         with self._lock:
             self._window.append(float(value))
             self._count += 1
             self._sum += float(value)
             if value > self._max:
                 self._max = float(value)
+            if trace_id:
+                self._exemplars.append((float(value), str(trace_id)))
+                self._exemplars.sort(key=lambda e: -e[0])
+                del self._exemplars[EXEMPLAR_LIMIT:]
 
-    def _state(self) -> tuple[list[float], int, float, float]:
+    def _state(self) -> tuple[
+            list[float], int, float, float, list[tuple[float, str]]]:
         with self._lock:
-            return list(self._window), self._count, self._sum, self._max
+            return (list(self._window), self._count, self._sum, self._max,
+                    list(self._exemplars))
 
 
 def _quantile(sorted_vals: list[float], q: float) -> float:
@@ -143,14 +159,20 @@ def _quantile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
 
 
-def _histogram_summary(windows: list[float], count: int, total: float,
-                       peak: float) -> dict[str, Any]:
+def _histogram_summary(
+    windows: list[float], count: int, total: float, peak: float,
+    tail_exemplars: list[tuple[float, str]] | None = None,
+) -> dict[str, Any]:
     out: dict[str, Any] = {"count": count, "sum": round(total, 6)}
     if windows:
         ordered = sorted(windows)
         for label, q in QUANTILES:
             out[label] = round(_quantile(ordered, q), 6)
         out["max"] = round(peak, 6)
+    if tail_exemplars:
+        out["exemplars"] = [
+            {"value": round(v, 6), "trace_id": t}
+            for v, t in tail_exemplars]
     return out
 
 
@@ -186,7 +208,7 @@ class MetricsRegistry:
             instruments = list(self._instruments)
         counters: dict[str, float] = {}
         gauges: dict[str, tuple[int, float]] = {}
-        hists: dict[str, list[tuple[list[float], int, float, float]]] = {}
+        hists: dict[str, list[tuple]] = {}
         for inst in instruments:
             if isinstance(inst, Counter):
                 counters[inst.key] = counters.get(inst.key, 0) + inst.value
@@ -201,12 +223,16 @@ class MetricsRegistry:
         for key, states in hists.items():
             window: list[float] = []
             count, total, peak = 0, 0.0, 0.0
-            for w, c, s, mx in states:
+            merged_ex: list[tuple[float, str]] = []
+            for w, c, s, mx, exs in states:
                 window.extend(w)
                 count += c
                 total += s
                 peak = max(peak, mx)
-            merged_hists[key] = _histogram_summary(window, count, total, peak)
+                merged_ex.extend(exs)
+            merged_ex.sort(key=lambda e: -e[0])
+            merged_hists[key] = _histogram_summary(
+                window, count, total, peak, merged_ex[:EXEMPLAR_LIMIT])
         return {
             "counters": {k: counters[k] for k in sorted(counters)},
             "gauges": {k: gauges[k][1] for k in sorted(gauges)},

@@ -175,6 +175,17 @@ def render(store: HistoryStore,
              "last", "best", "trend"], rows))
         lines.append("")
 
+    if "serve_tail" in by_kind:
+        lines.append("## Serve tail composition (p95+ share by "
+                     "component, pct of tail wall time)")
+        lines.append("")
+        rows = _group_rows(by_kind["serve_tail"], rounds,
+                           ("component", "mix", "qps", "scheduler"))
+        lines.extend(_table(
+            ["component", "mix", "qps", "scheduler", "series", "rounds",
+             "last", "best", "trend"], rows))
+        lines.append("")
+
     if "fault_audit" in by_kind:
         lines.append("## Fault-audit cells (pass=1)")
         lines.append("")

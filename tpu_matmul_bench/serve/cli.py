@@ -18,10 +18,22 @@ nonzero unless the ledger contract holds (percentile monotonicity,
 counter consistency, the extras["serve"] key set, per-tenant SLO
 attainment rows).
 
-Both are campaign-able: the executor appends `--json-out <ledger>` after
-the subcommand's flags, so a `[[job]] program = "serve"` with
-`flags = ["bench", "--qps", "50", ...]` produces a gated serve ledger
-like any other program (specs/serve.toml is the reference spec).
+`explain` is the flight recorder's forensics view: given a serve ledger
+with per-request `serve_span` terminal records, render the causal
+critical-path decomposition (queue-wait → batch-wait → cache → execute)
+of one trace (`--trace ID`) or the slowest N (`--slowest N`), with each
+trace's components reconciled against its measured wall latency. Pure
+ledger reading — works on machines without jax.
+
+`trace selftest` certifies the recorder end to end (lint_ci.sh layer
+11): static span-coverage audit (TRACE-001/002/003), a seeded
+in-process run whose span records reconcile, and the exemplar bound.
+
+Both bench and ab are campaign-able: the executor appends
+`--json-out <ledger>` after the subcommand's flags, so a `[[job]]
+program = "serve"` with `flags = ["bench", "--qps", "50", ...]`
+produces a gated serve ledger like any other program (specs/serve.toml
+is the reference spec).
 """
 
 from __future__ import annotations
@@ -36,12 +48,6 @@ from tpu_matmul_bench.serve.queue import (
     DEFAULT_MAX_DEPTH,
 )
 from tpu_matmul_bench.serve.scheduler import DEFAULT_STARVATION_MS
-from tpu_matmul_bench.serve.service import (
-    ServeConfig,
-    run_ab,
-    run_bench,
-    run_selftest,
-)
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -100,6 +106,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="export live metrics snapshots (obs_snapshot.jsonl "
                         "+ metrics.prom) into this directory; tail them "
                         "with `python -m tpu_matmul_bench obs status`")
+    p.add_argument("--obs-exemplars", action="store_true",
+                   help="annotate exported histogram lines with "
+                        "OpenMetrics exemplars (`# {trace_id=...}`) so "
+                        "tail quantiles in /metrics name the requests "
+                        "behind them")
     p.add_argument("--artifacts", default=None, nargs="?",
                    const="", metavar="DIR",
                    help="serialized-executable store root: warm_start "
@@ -156,6 +167,27 @@ def build_parser() -> argparse.ArgumentParser:
     selftest = sub.add_parser(
         "selftest", help="no-load ledger-contract check (CI hook)")
     _add_common(selftest)
+
+    explain = sub.add_parser(
+        "explain", help="critical-path decomposition of a traced request "
+                        "from a serve ledger's span records (no jax)")
+    explain.add_argument("--ledger", required=True,
+                         help="schema-v2 serve ledger with serve_span "
+                              "lines (a --json-out from a bench run)")
+    pick = explain.add_mutually_exclusive_group()
+    pick.add_argument("--trace", default=None,
+                      help="explain this trace id (default: slowest N)")
+    pick.add_argument("--slowest", type=int, default=3,
+                      help="explain the N slowest traces "
+                           "(default %(default)s)")
+
+    trace = sub.add_parser(
+        "trace", help="flight-recorder tooling")
+    tsub = trace.add_subparsers(dest="trace_command", required=True)
+    tselftest = tsub.add_parser(
+        "selftest", help="span-coverage audit + seeded-run reconciliation "
+                         "+ exemplar bound (CI hook, lint_ci layer 11)")
+    _add_common(tselftest)
     return p
 
 
@@ -172,7 +204,9 @@ def _parse_grid(spec: str | None) -> tuple[int, ...] | None:
     return points
 
 
-def _config_from(args: argparse.Namespace) -> ServeConfig:
+def _config_from(args: argparse.Namespace):
+    from tpu_matmul_bench.serve.service import ServeConfig
+
     kwargs = dict(
         mix=args.mix,
         dtype_name=args.dtype_name,
@@ -191,6 +225,7 @@ def _config_from(args: argparse.Namespace) -> ServeConfig:
         append_ledger=args.append,
         trace_out=args.trace_out,
         obs_dir=args.obs_dir,
+        obs_exemplars=args.obs_exemplars,
         artifacts=args.artifacts,
     )
     if args.cache_capacity is not None:
@@ -207,12 +242,30 @@ def _config_from(args: argparse.Namespace) -> ServeConfig:
 
 def main(argv: Sequence[str] | None = None):
     args = build_parser().parse_args(argv)
+    if args.command == "explain":
+        # pure ledger forensics: never imports the serving stack (jax)
+        from tpu_matmul_bench.serve.trace import run_explain
+
+        rc = run_explain(args.ledger, trace_id=args.trace,
+                         slowest=args.slowest)
+        if rc:
+            raise SystemExit(rc)
+        return None
+    from tpu_matmul_bench.serve.service import (
+        run_ab,
+        run_bench,
+        run_selftest,
+        run_trace_selftest,
+    )
+
     try:
         config = _config_from(args)
         config.mix_entries  # validate the mix spec before touching devices
         config.tenant_specs  # ... and the tenant definitions
     except ValueError as e:
         raise SystemExit(f"serve: {e}")
+    if args.command == "trace":
+        return run_trace_selftest(config)
     if args.command == "selftest":
         return run_selftest(config)
     if args.command == "ab":

@@ -62,6 +62,7 @@ class Request:
     bucket: tuple[int, int, int] | None = None  # stamped on admission
     tenant: str = "default"  # traffic class (serve/tenants.py)
     dispatched_at: float = 0.0  # wall clock when its batch was taken
+    trace: str = ""  # flight-recorder id, parented under the run context
 
 
 class ShapeGrid:
@@ -99,6 +100,7 @@ class AdmissionQueue:
         max_depth: int = DEFAULT_MAX_DEPTH,
         window_s: float = DEFAULT_WINDOW_S,
         max_batch: int = DEFAULT_MAX_BATCH,
+        recorder: Any = None,
     ) -> None:
         if max_depth < 1 or max_batch < 1 or window_s < 0:
             raise ValueError(
@@ -108,6 +110,10 @@ class AdmissionQueue:
         self.max_depth = max_depth
         self.window_s = window_s
         self.max_batch = max_batch
+        # flight recorder (serve/trace.py): shed requests get a terminal
+        # trace event, so a p99 forensics pass can see WHO was refused,
+        # not just how many (a None recorder no-ops)
+        self.recorder = recorder
         self._items: list[tuple[float, Request]] = []  # (enqueue_wall, req)
         self._cond = threading.Condition()
         self._closed = False
@@ -154,6 +160,9 @@ class AdmissionQueue:
                 self._m_shed.inc()
                 self._shed_by_tenant[req.tenant] = \
                     self._shed_by_tenant.get(req.tenant, 0) + 1
+                if self.recorder:
+                    self.recorder.terminal(req, "shed_overflow",
+                                           depth=len(self._items))
                 raise QueueOverflowError(len(self._items), self.max_depth)
             req.submitted_at = time.perf_counter()
             self._items.append((req.submitted_at, req))

@@ -132,6 +132,7 @@ class ContinuousScheduler:
         breaker_threshold: int = DEFAULT_BREAKER_THRESHOLD,
         breaker_cooldown_s: float = DEFAULT_BREAKER_COOLDOWN_S,
         clock=time.monotonic,
+        recorder: Any = None,
     ) -> None:
         if max_depth < 1 or max_batch < 1 or starvation_ms <= 0:
             raise ValueError(
@@ -146,6 +147,10 @@ class ContinuousScheduler:
         self.grid = grid or ShapeGrid()
         self.max_depth = max_depth
         self.max_batch = max_batch
+        # flight recorder (serve/trace.py): every shed/breaker/eviction
+        # decision emits a terminal trace event so refused requests stay
+        # attributable per-trace, not just countable (None no-ops)
+        self.recorder = recorder
         self.starvation_s = starvation_ms / 1e3
         self._tenants: dict[str, _TenantState] = {
             t.tenant_id: _TenantState(t) for t in tenants}
@@ -274,6 +279,10 @@ class ContinuousScheduler:
                 else:
                     self._shed_locked(state, self._m_breaker_shed)
                     self._rejected += 1
+                    if self.recorder:
+                        self.recorder.terminal(
+                            req, "shed_breaker",
+                            bucket=_bucket_label(req.bucket, req.dtype))
                     raise BreakerOpenError(
                         self._depth, self.max_depth,
                         bucket=_bucket_label(req.bucket, req.dtype))
@@ -285,18 +294,26 @@ class ContinuousScheduler:
                     and self._slo_wait_estimate_s(state) * 1e3 > slo:
                 self._shed_locked(state, self._m_slo_shed)
                 self._rejected += 1
+                if self.recorder:
+                    self.recorder.terminal(req, "shed_slo", slo_ms=slo)
                 raise QueueOverflowError(len(state.queue), self.max_depth)
             if self._depth >= self.max_depth:
                 victim = self._overflow_victim_locked(state)
                 if victim is state:
                     self._shed_locked(state)
                     self._rejected += 1
+                    if self.recorder:
+                        self.recorder.terminal(req, "shed_overflow",
+                                               depth=self._depth)
                     raise QueueOverflowError(self._depth, self.max_depth)
                 # selective shedding: evict the violator's NEWEST request
                 # (its oldest is closest to dispatch — evicting it would
                 # maximize wasted wait) and admit the in-share submitter
-                victim.queue.pop()
+                evicted = victim.queue.pop()
                 self._shed_locked(victim, self._m_evicted)
+                if self.recorder:
+                    self.recorder.terminal(evicted, "evicted",
+                                           displaced_by=req.tenant)
                 self._m_tenant_depth[victim.spec.tenant_id].set(
                     len(victim.queue))
                 self._depth -= 1

@@ -57,6 +57,11 @@ from tpu_matmul_bench.serve.tenants import (
     TenantSpec,
     parse_tenants_arg,
 )
+from tpu_matmul_bench.serve.trace import (
+    FlightRecorder,
+    mint_trace_id,
+    request_spans,
+)
 from tpu_matmul_bench.utils import telemetry
 from tpu_matmul_bench.utils.errors import QueueOverflowError, classify
 from tpu_matmul_bench.utils.reporting import (
@@ -107,6 +112,10 @@ class ServeConfig:
     trace_out: str | None = None
     prewarm: bool = False
     obs_dir: str | None = None  # snapshot exporter output (obs/export.py)
+    # annotate exported /metrics histogram lines with OpenMetrics
+    # exemplars (`# {trace_id="..."} v`) — off by default: not every
+    # scraper tolerates the exemplar syntax
+    obs_exemplars: bool = False
     # online explorer (tune/online.py): fraction of requests eligible
     # for shadow-routing through the runner-up impl (0 = off), and the
     # tune DB measured winners are promoted into (None = no promotion)
@@ -256,6 +265,10 @@ def _worker_drain(
     # fixed queue predates breakers; only schedulers that grow
     # note_result get failure feedback (and hence circuit breaking)
     note_result = getattr(q, "note_result", None)
+    # flight recorder (serve/trace.py): both admission paths carry one;
+    # the worker is the only thread that flushes its terminal records
+    # onto the ledger stream (between batches + once after the drain)
+    recorder = getattr(q, "recorder", None)
     batch_seq = 0
     while (batch := q.take_batch()) is not None:
         batch_seq += 1
@@ -289,6 +302,11 @@ def _worker_drain(
                 t0 = time.perf_counter()
                 try:
                     entry = cache.get(use_key)
+                    # cache-acquisition boundary: t0→t_entry is the
+                    # request's cache span (a cold request's compile or
+                    # artifact deserialize lives here), t_entry→done its
+                    # pure execute span
+                    t_entry = time.perf_counter()
                     out = entry.compiled(a, b)
                     sync(out)
                 except Exception as e:  # noqa: BLE001 — fault boundary
@@ -303,6 +321,22 @@ def _worker_drain(
                     report(f"serve: request {req.rid} ({use_key.label}) "
                            f"failed [{classify(e)}]: {e}",
                            file=sys.stderr)
+                    if recorder is not None:
+                        t_fail = time.perf_counter()
+                        recorder.terminal(
+                            req, "failed",
+                            spans=[
+                                {"name": "queue_wait", "ms": round(max(
+                                    req.dispatched_at - req.submitted_at,
+                                    0.0) * 1e3, 4)},
+                                {"name": "batch_wait", "ms": round(max(
+                                    t0 - req.dispatched_at, 0.0) * 1e3, 4)},
+                                {"name": "execute", "ms": round(max(
+                                    t_fail - t0, 0.0) * 1e3, 4)},
+                            ],
+                            wall_ms=round(max(
+                                t_fail - req.submitted_at, 0.0) * 1e3, 4),
+                            error=classify(e))
                     if on_complete is not None:
                         on_complete(req)
                     continue
@@ -321,12 +355,35 @@ def _worker_drain(
                 m_requests.inc()
                 if note_result is not None:
                     note_result(req.bucket, req.dtype, ok=True)
-                hist.observe((done - req.submitted_at) * 1e3)
+                if recorder is not None:
+                    recorder.terminal(
+                        req, "complete",
+                        spans=request_spans(
+                            req, t0, t_entry, done,
+                            cache_hit=was_cached,
+                            cache_source=None if was_cached
+                            else entry.source,
+                            cold_compile_ms=entry.cold_compile_s * 1e3
+                            if not was_cached
+                            and entry.source == "compile" else None,
+                            deserialize_ms=entry.deserialize_s * 1e3
+                            if not was_cached
+                            and entry.source == "artifact" else None),
+                        wall_ms=round((done - req.submitted_at) * 1e3, 4))
+                    # the same request on the Perfetto timeline: one
+                    # admission→completion event carrying its trace id,
+                    # so the campaign merge can line sheds and batches
+                    # up against individual requests
+                    telemetry.emit_span(
+                        "serve:request", req.submitted_at, done, depth=1,
+                        trace=req.trace, rid=req.rid, bucket=use_key.label)
+                hist.observe((done - req.submitted_at) * 1e3,
+                             trace_id=req.trace or None)
                 whist = wait_hists.get(req.tenant)
                 if whist is None:
                     whist = wait_hists[req.tenant] = reg.histogram(
                         "serve_wait_ms", tenant=req.tenant)
-                whist.observe(wait_s * 1e3)
+                whist.observe(wait_s * 1e3, trace_id=req.trace or None)
                 if on_complete is not None:
                     on_complete(req)
         if stream is not None:
@@ -339,8 +396,21 @@ def _worker_drain(
                 "batch_ms": round(
                     (time.perf_counter() - batch_t0) * 1e3, 3),
             })
+            if recorder is not None:
+                # terminal span records ride the same fsynced channel,
+                # flushed in batch neighborhoods so submit-side sheds
+                # land near the batches they raced with
+                for span_rec in recorder.drain():
+                    stream.write_raw(span_rec)
         if note_service is not None:
             note_service(time.perf_counter() - batch_t0, len(batch))
+    if recorder is not None:
+        # sheds that landed after the last batch was taken (or runs that
+        # shed everything) still reach the ledger — and with no stream,
+        # the buffer is emptied so it can't grow unbounded
+        for span_rec in recorder.drain():
+            if stream is not None:
+                stream.write_raw(span_rec)
 
 
 def _open_loop_producer(q: AdmissionQueue, schedule: Sequence[Request],
@@ -349,6 +419,9 @@ def _open_loop_producer(q: AdmissionQueue, schedule: Sequence[Request],
         delay = t0 + req.arrival_s - time.perf_counter()
         if delay > 0:
             time.sleep(delay)
+        # trace id minted BEFORE submit: a request shed at the door
+        # still has an identity its terminal span can carry
+        req.trace = mint_trace_id(req.rid)
         try:
             q.submit(req)
         except QueueOverflowError:
@@ -365,6 +438,7 @@ def _closed_loop_producer(q: AdmissionQueue, requests: Iterator[Request],
         if time.perf_counter() >= t_end:
             sem.release()
             break
+        req.trace = mint_trace_id(req.rid)
         try:
             q.submit(req)
         except QueueOverflowError:
@@ -672,7 +746,7 @@ def _exporter(config: ServeConfig):
         return contextlib.nullcontext()
     from tpu_matmul_bench.obs.export import SnapshotExporter
 
-    return SnapshotExporter(config.obs_dir)
+    return SnapshotExporter(config.obs_dir, exemplars=config.obs_exemplars)
 
 
 def _attach_cost_analysis(rec: BenchmarkRecord,
@@ -692,15 +766,20 @@ def _make_admission(config: ServeConfig, grid: ShapeGrid,
     `AdmissionQueue` or the continuous-batching `ContinuousScheduler`
     (both share the submit/take_batch/stats contract)."""
     which = scheduler or config.scheduler
+    # every admission path carries a flight recorder: shed/eviction
+    # terminal spans originate here, completion spans from the worker
+    recorder = FlightRecorder()
     if which == "fixed":
         return AdmissionQueue(grid, max_depth=config.max_depth,
                               window_s=config.window_ms / 1e3,
-                              max_batch=config.max_batch)
+                              max_batch=config.max_batch,
+                              recorder=recorder)
     if which == "continuous":
         return ContinuousScheduler(grid, tenants=tenants,
                                    max_depth=config.max_depth,
                                    max_batch=config.max_batch,
-                                   starvation_ms=config.starvation_ms)
+                                   starvation_ms=config.starvation_ms,
+                                   recorder=recorder)
     raise ValueError(f"unknown scheduler {which!r} "
                      "(want 'fixed' or 'continuous')")
 
@@ -1100,7 +1179,8 @@ def run_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
         for rid in range(SELFTEST_REQUESTS):
             q.submit(Request(rid=rid, m=e.m, k=e.k, n=e.n,
                              dtype=config.dtype_name,
-                             tenant=tenants[rid % len(tenants)].tenant_id))
+                             tenant=tenants[rid % len(tenants)].tenant_id,
+                             trace=mint_trace_id(rid)))
         q.close()
         _worker_drain(q, cache, pool, samples, impl=config.matmul_impl,
                       mesh_shape=(world,), stream=writer)
@@ -1226,6 +1306,115 @@ def validate_serve_record(rec: BenchmarkRecord) -> list[str]:
             f"goodput_qps {s['goodput_qps']} exceeds achieved_qps "
             f"{s['achieved_qps']}")
     return problems
+
+
+def run_trace_selftest(config: ServeConfig) -> list[BenchmarkRecord]:
+    """`serve trace selftest`: the flight recorder's end-to-end CI hook
+    (lint_ci.sh layer 11). Three certifications in one pass:
+
+    1. **span coverage** — the TRACE-001/002/003 static audit over the
+       real tree is clean (every shed site emits, terminal states are
+       exactly-once, the exemplar reservoir is bounded);
+    2. **reconciliation** — a seeded in-process serve run's ledger
+       yields one terminal span record per offered request, every
+       complete record's span chain sums to its measured wall latency,
+       and `serve explain --slowest 3` renders and reconciles;
+    3. **exemplar bound** — the run's tail histograms retain at most
+       EXEMPLAR_LIMIT exemplars, and the slowest request's trace id is
+       among them (the p99→trace bridge actually bridges).
+
+    Exits nonzero on any violation."""
+    import tempfile
+    from pathlib import Path
+
+    from tpu_matmul_bench.obs.registry import EXEMPLAR_LIMIT, reset_registry
+    from tpu_matmul_bench.serve import trace as flight
+
+    problems: list[str] = []
+    findings = flight.trace_findings()
+    problems.extend(
+        f"static audit: {f.rule} at {f.where}: {f.message}"
+        for f in findings)
+    reg = reset_registry()
+    with tempfile.TemporaryDirectory(prefix="serve-trace-") as td:
+        ledger = str(Path(td) / "serve.jsonl")
+        run_cfg = dataclasses.replace(
+            config, mix="256", qps=80.0, duration_s=0.6, concurrency=None,
+            tenants=None, json_out=ledger, append_ledger=False,
+            trace_out=None, obs_dir=None, prewarm=True, explore=0.0,
+            explore_db=None)
+        report(header("Serve trace selftest (seeded run)", {
+            "Request mix": run_cfg.mix,
+            "Offered load": f"{run_cfg.qps} QPS x {run_cfg.duration_s} s",
+            "Scheduler": run_cfg.scheduler,
+        }))
+        records = run_bench(run_cfg)
+        manifest, span_recs, read_problems = \
+            flight.read_trace_records(ledger)
+        problems.extend(f"ledger read: {p}" for p in read_problems)
+        if manifest is None:
+            problems.append("ledger has no manifest line")
+        for d in span_recs:
+            problems.extend(
+                f"trace {d.get('trace')}: {p}"
+                for p in flight.validate_serve_span_record(d))
+        serve = records[0].extras["serve"]
+        by_state: dict[str, int] = {}
+        for d in span_recs:
+            by_state[d.get("state", "?")] = \
+                by_state.get(d.get("state", "?"), 0) + 1
+        if by_state.get("complete", 0) != serve["requests"]:
+            problems.append(
+                f"{by_state.get('complete', 0)} complete span records vs "
+                f"{serve['requests']} completed requests — a request "
+                "finished without (or with more than one) terminal span")
+        shed_spans = sum(v for s, v in by_state.items()
+                         if s.startswith("shed_") or s == "evicted")
+        if shed_spans != serve["shed"]:
+            problems.append(
+                f"{shed_spans} shed/evicted span records vs "
+                f"{serve['shed']} sheds counted — refusals are escaping "
+                "the recorder")
+        traces = [d["trace"] for d in span_recs if "trace" in d]
+        if len(traces) != len(set(traces)):
+            problems.append("duplicate trace ids across terminal records")
+        lines, rc = flight.render_explain(span_recs, slowest=3)
+        report(*lines)
+        if rc != 0:
+            problems.append(
+                "explain --slowest 3 failed reconciliation (span "
+                "components vs measured wall latency)")
+        completes = [d for d in span_recs if d.get("state") == "complete"]
+        slowest = max(completes, key=lambda d: d["wall_ms"], default=None)
+        snap = reg.snapshot()
+        lat_hists = {k: v for k, v in snap["histograms"].items()
+                     if k.startswith("serve_latency_ms")}
+        if not lat_hists:
+            problems.append("no serve_latency_ms histogram in the "
+                            "snapshot — exemplar path untestable")
+        exemplar_traces: set[str] = set()
+        for k, summary in lat_hists.items():
+            exs = summary.get("exemplars", [])
+            if len(exs) > EXEMPLAR_LIMIT:
+                problems.append(
+                    f"{k} retains {len(exs)} exemplars "
+                    f"(> EXEMPLAR_LIMIT={EXEMPLAR_LIMIT})")
+            exemplar_traces.update(e["trace_id"] for e in exs)
+        if slowest is not None and slowest["trace"] not in exemplar_traces:
+            problems.append(
+                f"slowest trace {slowest['trace']} "
+                f"({slowest['wall_ms']} ms) missing from the tail "
+                "exemplars — the p99→trace bridge is broken")
+    if problems:
+        report(*[f"trace selftest FAILED: {p}" for p in problems],
+               file=sys.stderr)
+        raise SystemExit(1)
+    report(f"trace selftest ok: span coverage audit clean, "
+           f"{len(span_recs)} terminal span record(s) "
+           f"({by_state.get('complete', 0)} complete) reconcile against "
+           f"measured wall latency, exemplars bounded at "
+           f"{EXEMPLAR_LIMIT} with the slowest trace retained")
+    return records
 
 
 def validate_serve_batch_record(d: dict[str, Any]) -> list[str]:

@@ -162,6 +162,26 @@ class SpanTracker:
             if self._sink is not None:
                 self._sink.write(_chrome_event(event))
 
+    def emit(self, name: str, start_pc: float, end_pc: float, *,
+             depth: int = 0, **args: Any) -> None:
+        """Record a span retrospectively from absolute `time.perf_counter`
+        timestamps (the serve flight recorder's request phases are
+        measured first and attributed later — they cannot be wrapped in
+        a live `span()` context). Lands in the same timeline: clamped to
+        this tracker's epoch, flushed through the sink like any other
+        closed span."""
+        start_s = max(start_pc - self.epoch, 0.0)
+        event = SpanEvent(
+            name=name,
+            start_s=start_s,
+            dur_s=max(end_pc - start_pc, 0.0),
+            depth=depth,
+            args={k: v for k, v in args.items() if v is not None},
+        )
+        self.events.append(event)
+        if self._sink is not None:
+            self._sink.write(_chrome_event(event))
+
     def to_chrome_trace(self) -> dict[str, Any]:
         """Chrome trace event format: complete ("X") events on one
         pid/tid; viewers nest them by interval containment."""
@@ -225,6 +245,16 @@ def span(name: str, **args: Any):
     if tracker is None:
         return _null_span(dict(args))
     return tracker.span(name, **args)
+
+
+def emit_span(name: str, start_pc: float, end_pc: float, *,
+              depth: int = 0, **args: Any) -> None:
+    """Module-level retrospective span (see SpanTracker.emit): a no-op
+    when no tracker session is installed, so per-request attribution
+    costs nothing outside `--trace-out` runs."""
+    tracker = _TRACKER
+    if tracker is not None:
+        tracker.emit(name, start_pc, end_pc, depth=depth, **args)
 
 
 def note_artifact(kind: str, path: str) -> None:

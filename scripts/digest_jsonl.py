@@ -154,6 +154,41 @@ def _serve_sublines(r) -> list[str]:
     return lines
 
 
+def _train_row(r, t) -> str:
+    """Train-step records headline the per-phase wall-time split (the
+    cumulative-prefix telescoping makes the phases sum to the step wall
+    time as an identity) plus the ZeRO/wire labels and, when a quantized
+    gradient wire ran, the final update-error drift vs the exact shadow."""
+    ex = r.get("extras") or {}
+    shape = ex.get("shape") or f"{r.get('size')}²"
+    wall = r.get("avg_time_s") or t.get("wall_s") or 0.0
+    phases = t.get("phases") or {}
+    split = " ".join(
+        f"{name.removesuffix('_s')}={1e3 * (phases.get(name) or 0):.2f}"
+        for name in ("fwd_s", "bwd_s", "grad_comm_s", "update_s",
+                     "allgather_s") if name in phases)
+    bits = (f"step={1e3 * wall:.2f}ms [{split}]ms "
+            f"zero={t.get('zero')} gq={t.get('grad_quant')} "
+            f"dpxtp={t.get('dp')}x{t.get('tp')}")
+    if ex.get("mesh"):
+        bits += f" mesh={ex['mesh']}"
+    if "update_rel_err" in t:
+        bits += (f" drift={t['update_rel_err']:.3g}"
+                 f"@{t.get('steps')}steps")
+    if "validation" in ex:
+        bits += f" validation={ex['validation']}"
+        if "validation_max_rel_err" in ex:
+            bits += f" relerr={ex['validation_max_rel_err']:g}"
+    wire = t.get("wire") or {}
+    if isinstance(wire.get("per_link"), dict):
+        bits += (f" wire={wire.get('wire_bytes')}B"
+                 f"/{wire.get('baseline_bytes')}B "
+                 f"bottleneck={wire.get('bottleneck_link')}")
+    return (f"  {r.get('tflops_per_device') or 0:8.2f} {'TFLOPS':6} "
+            f"{shape:>18} {'train/' + str(r.get('mode', '')):24} "
+            f"{'':>18} it={r.get('iterations')} {bits}")
+
+
 def _comm_quant_bits(r) -> str:
     """Quantized-wire annotation (PR 10): the format label plus, when the
     wire is live, the static byte prices from comms_model."""
@@ -177,6 +212,8 @@ def _row(r) -> str:
     ex = r.get("extras") or {}
     if r.get("benchmark") == "serve" and isinstance(ex.get("serve"), dict):
         return _serve_row(r, ex["serve"])
+    if r.get("benchmark") == "train" and isinstance(ex.get("train"), dict):
+        return _train_row(r, ex["train"])
     shape = ex.get("shape") or f"{r.get('size')}²"
     blocks = ""
     if "block_m" in ex:  # tuner records carry the blocking

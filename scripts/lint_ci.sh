@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Eleven layers, cheapest first:
+# Twelve layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -76,6 +76,14 @@
 #      reconciles against measured wall latency within 5%, with the
 #      slowest trace retained as a histogram exemplar and `serve
 #      explain` rendering it.
+#  12. python -m tpu_matmul_bench train selftest — the training-step
+#      layer: the TRAIN-00x audit must be clean (full-step collective
+#      inventories vs the gradient-collective model at two transposed
+#      factorizations, ZeRO shard-ownership disjointness, downcast
+#      budget, step purity), a fp32 ZeRO step must equal the replicated
+#      step and the dense reference to 1e-5 on both mesh families, and
+#      the quantized-wire update-error drift must not shrink when the
+#      scale block coarsens.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -123,3 +131,7 @@ JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_cou
 
 echo "== serve trace selftest (flight recorder / span reconciliation) =="
 JAX_PLATFORMS=cpu python -m tpu_matmul_bench serve trace selftest
+
+echo "== train selftest (train-step audit / ZeRO numerics / drift) =="
+JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m tpu_matmul_bench train selftest

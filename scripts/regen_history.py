@@ -14,7 +14,9 @@ the repo's actual history:
 - round 7: the hierarchical DCN×ICI campaign (factorized meshes,
   per-link wire formats, and the out-of-core K-streaming rider);
 - round 8: the flight-recorder serve run (per-request serve_span
-  ledger, from which the serve_tail tail-attribution series derive).
+  ledger, from which the serve_tail tail-attribution series derive);
+- round 9: the training-step campaign (kind="train" step-time and
+  update-error drift series, specs/train.toml).
 
 The output is byte-deterministic (no wall-clock anywhere in a point:
 timestamps come only from ledger manifests), so
@@ -48,6 +50,7 @@ POST_ROUND_DIRS = (
      "measurements/serve_artifacts"),
     ("measurements/hier",),
     ("measurements/serve_trace",),
+    ("measurements/train",),
 )
 
 

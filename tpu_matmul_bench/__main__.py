@@ -52,6 +52,12 @@ _PROGRAMS = {
     # two-level inventory-vs-model certification (`parallel hier
     # selftest`) — mesh/collective machinery lives in parallel/
     "parallel": "tpu_matmul_bench.parallel.cli",
+    # the training-step workload: one optimizer step (sharded fwd/bwd,
+    # quantized gradient sync via --grad-quant, ZeRO-style sharded update
+    # via --zero) with per-phase timing and the update-error drift series
+    # (`train bench`), plus CI layer 12's certification (`train selftest`)
+    # — programs live in train/ (DESIGN §22)
+    "train": "tpu_matmul_bench.train.cli",
 }
 
 

@@ -1052,6 +1052,169 @@ def audit_hier(factorizations: Iterable[str] = _HIER_FACTORIZATIONS,
     return findings
 
 
+# ---------------------------------------------------------------------------
+# the train-step audit: TRAIN-001..005 (see train/step.py, DESIGN §22)
+# ---------------------------------------------------------------------------
+
+#: the train audit grid: (mode, mesh spec|None). Flat dp plus BOTH
+#: transposed factorizations — a model that swaps the data/tensor roles
+#: cannot match both, same trap as the COLL-H grid.
+_TRAIN_CELLS = (("dp", None), ("hybrid", _HIER_FACTORIZATIONS[0]),
+                ("hybrid", _HIER_FACTORIZATIONS[1]))
+
+
+def _train_quant_for(spec: str | None) -> str:
+    """The quantized wire the audit traces per cell: per-link asymmetric on
+    factorized meshes (wrong-axis routing visible), uniform on flat."""
+    return _HIER_QUANT if spec else "fp8-block:32"
+
+
+def _train_inventory_findings(jaxpr: Any, mode: str, spec: str | None,
+                              world: int, grad_quant: str | None,
+                              zero: bool, where: str) -> list[Finding]:
+    """TRAIN-001/TRAIN-002: the traced FULL step's per-axis collective
+    inventory vs the closed-form gradient-collective model."""
+    from tpu_matmul_bench.analysis.comms_model import (
+        train_expected_collectives,
+    )
+
+    observed = sorted(_observed_axis_inventory(jaxpr))
+    expected = sorted(train_expected_collectives(
+        mode, spec, world, AUDIT_SIZE, jnp.bfloat16, grad_quant,
+        batch=AUDIT_BATCH, zero=zero))
+    obs_ka = sorted((k, a) for k, a, _ in observed)
+    exp_ka = sorted((k, a) for k, a, _ in expected)
+    if obs_ka != exp_ka:
+        return [Finding(
+            "TRAIN-001", where,
+            f"full-step collective inventory {obs_ka or '[]'} does not "
+            f"match the gradient-collective model {exp_ka or '[]'} for "
+            f"{mode} (zero={int(zero)}) on {spec or 'flat'}",
+            details={"observed": observed, "expected": expected})]
+    if observed != expected:
+        return [Finding(
+            "TRAIN-002", where,
+            f"per-collective payload bytes differ from the gradient-"
+            f"collective model for {mode} (zero={int(zero)}) on "
+            f"{spec or 'flat'}",
+            details={"observed": observed, "expected": expected})]
+    return []
+
+
+def _train_zero_findings(mode: str, mesh: Any, where: str) -> list[Finding]:
+    """TRAIN-003: the ZeRO ownership contract — the shard-row map must
+    tile the parameter disjointly, and one executed fp32 ZeRO step must
+    equal the replicated-update step (overlapping or gapped ownership
+    breaks the equality; this is the semantic teeth behind the map)."""
+    from tpu_matmul_bench.train.step import (
+        make_train_setup, train_axes, zero_shard_rows)
+
+    findings: list[Finding] = []
+    dp_ax, _ = train_axes(mesh, mode)
+    r = int(mesh.shape[dp_ax])
+    rows = zero_shard_rows(AUDIT_SIZE, r)
+    covered: set[int] = set()
+    overlap = False
+    for start, stop in rows:
+        span = set(range(start, stop))
+        overlap = overlap or bool(covered & span)
+        covered |= span
+    if overlap or covered != set(range(AUDIT_SIZE)):
+        findings.append(Finding(
+            "TRAIN-003", where,
+            f"zero_shard_rows({AUDIT_SIZE}, {r}) does not tile the weight "
+            f"rows disjointly: {rows}",
+            details={"rows": rows, "overlap": overlap,
+                     "missing": len(set(range(AUDIT_SIZE)) - covered)}))
+        return findings
+
+    sz = make_train_setup(mesh, mode, AUDIT_SIZE, jnp.float32, zero=True)
+    sr = make_train_setup(mesh, mode, AUDIT_SIZE, jnp.float32, zero=False)
+    x, w0 = sz.operands
+    import numpy as np
+
+    wz = np.asarray(sz.step(x, w0), dtype=np.float32)
+    wr = np.asarray(sr.step(x, w0), dtype=np.float32)
+    rel = float(np.linalg.norm(wz - wr) / max(np.linalg.norm(wr), 1e-30))
+    if rel > 1e-5:
+        findings.append(Finding(
+            "TRAIN-003", where,
+            f"executed ZeRO step differs from the replicated-update step "
+            f"at fp32 (rel err {rel:.2e} > 1e-5) — shard ownership, the "
+            "owned-slice update, or the allgather reassembly is wrong",
+            details={"rel_err": rel, "dp": r}))
+    return findings
+
+
+def audit_train() -> list[Finding]:
+    """Certify the train-step contract statically (plus one executed
+    ownership check): for flat dp and BOTH transposed dcn×ici
+    factorizations of the 8-device world, each × zero ∈ {0, 1} ×
+    {exact wire, quantized gradient wire}, trace the FULL step and check
+
+    - TRAIN-001/TRAIN-002: the per-axis collective inventory and payload
+      bytes match `comms_model.train_expected_collectives` — fwd/bwd are
+      collective-free, gradients ride the wire format, the ZeRO parameter
+      allgather travels exact;
+    - TRAIN-003: ZeRO shard ownership tiles disjointly and the executed
+      sharded-update step equals the replicated one;
+    - TRAIN-004: the quantized step performs no more non-wire downcasts
+      than the exact step (dequant rides fp32 into the single downcast);
+    - TRAIN-005: no host callbacks inside the timed step.
+    """
+    from tpu_matmul_bench.parallel.mesh import make_factorized_mesh, make_mesh
+    from tpu_matmul_bench.train.step import make_train_setup
+
+    findings: list[Finding] = []
+    devices = jax.devices()
+    if len(devices) < 8:
+        return [Finding(
+            "TRAIN-001", "train:mesh",
+            f"cannot audit train meshes: only {len(devices)} devices "
+            "(run under XLA_FLAGS=--xla_force_host_platform_device_count)",
+            severity="warn", details={"available": len(devices)})]
+    for mode, spec in _TRAIN_CELLS:
+        mesh = (make_factorized_mesh(devices[:8], spec) if spec
+                else make_mesh(devices[:8]))
+        world = int(mesh.size)
+        for zero in (False, True):
+            jaxprs: dict[str | None, Any] = {}
+            for gq in (None, _train_quant_for(spec)):
+                where = (f"train:{mode}@{spec or 'flat'}"
+                         f"/zero={int(zero)}+{gq or 'exact'}")
+                setup = make_train_setup(
+                    mesh, mode, AUDIT_SIZE, jnp.bfloat16,
+                    batch=AUDIT_BATCH, zero=zero, grad_quant=gq)
+                jaxpr = jax.make_jaxpr(setup.step)(*setup.operands)
+                jaxprs[gq] = jaxpr
+                findings.extend(_train_inventory_findings(
+                    jaxpr, mode, spec, world, gq, zero, where))
+                for prim in sorted(set(jt.callback_prims(jaxpr))):
+                    findings.append(Finding(
+                        "TRAIN-005", where,
+                        f"host callback primitive {prim!r} inside the "
+                        "timed optimizer step",
+                        details={"primitive": prim}))
+            # TRAIN-004: the wire format must not add accumulation
+            # downcasts — budget is the exact step's own count
+            gq = _train_quant_for(spec)
+            q_downs = _nonwire_downs(jaxprs[gq])
+            x_downs = _nonwire_downs(jaxprs[None])
+            if len(q_downs) > len(x_downs):
+                findings.append(Finding(
+                    "TRAIN-004",
+                    f"train:{mode}@{spec or 'flat'}/zero={int(zero)}",
+                    f"quantized step has {len(q_downs)} non-wire float "
+                    f"downcasts vs the exact step's {len(x_downs)} — "
+                    "dequantized gradients left the fp32 accumulator "
+                    "before the update's single downcast",
+                    details={"quantized": q_downs, "exact": x_downs,
+                             "grad_quant": gq}))
+        findings.extend(_train_zero_findings(
+            mode, mesh, f"train:{mode}@{spec or 'flat'}/zero-ownership"))
+    return findings
+
+
 AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "modes": audit_modes,
     "impls": audit_impls,
@@ -1063,6 +1226,7 @@ AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "obs": audit_obs,
     "comm_quant": audit_comm_quant,
     "hier": audit_hier,
+    "train": audit_train,
     "sched": _audit_sched,
     "memory": _audit_memory,
     "fingerprint": _audit_fingerprint,

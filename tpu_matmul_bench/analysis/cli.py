@@ -59,7 +59,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--skip", nargs="*", default=(),
                         choices=("modes", "impls", "donation", "pallas",
                                  "registry", "tune", "obs", "comm_quant",
-                                 "hier", "specs", "sched", "memory",
+                                 "hier", "train", "specs", "sched", "memory",
                                  "fingerprint", "faults"),
                         help="audit groups to skip")
     parser.add_argument("--no-hlo", action="store_true",

@@ -159,8 +159,10 @@ def _one_wire_entries(kind: str, axis: int, shape: tuple[int, ...], fmt,
     payload_bytes, role)`` entries with role ∈ {payload, scale}. Mirrors
     `wire_psum`/`wire_all_gather` exactly: an all_reduce becomes the
     (d−1)-hop ppermute ring + final all_gather, each hop carrying a
-    payload chunk and its scale chunk; an all_gather carries the whole
-    shard + scales; size-1 axes short-circuit to no traffic at all."""
+    payload chunk and its scale chunk; a reduce_scatter is the same ring
+    without the trailing all_gather (`wire_reduce_scatter`); an all_gather
+    carries the whole shard + scales; size-1 axes short-circuit to no
+    traffic at all."""
     if axis == 1:
         return []  # the d==1 short-circuit emits no collective at all
     n_rows = int(np.prod(shape[:-1]))
@@ -182,6 +184,17 @@ def _one_wire_entries(kind: str, axis: int, shape: tuple[int, ...], fmt,
                     chunk * cols * _WIRE_ITEMSIZE, "payload"))
         out.append(("all_gather", axis,
                     chunk * nb * _SCALE_ITEMSIZE, "scale"))
+    elif kind == "reduce_scatter":
+        if n_rows % axis:
+            raise ValueError(
+                f"{where}: flattened rows {n_rows} must divide the "
+                f"{axis}-device axis for the quantized ring")
+        chunk = n_rows // axis
+        for _ in range(axis - 1):  # the psum ring minus its all_gather
+            out.append(("ppermute", axis,
+                        chunk * cols * _WIRE_ITEMSIZE, "payload"))
+            out.append(("ppermute", axis,
+                        chunk * nb * _SCALE_ITEMSIZE, "scale"))
     elif kind == "all_gather":
         out.append(("all_gather", axis,
                     n_rows * cols * _WIRE_ITEMSIZE, "payload"))
@@ -438,6 +451,173 @@ def hier_wire_bytes_summary(mode: str, mesh_spec: str, size: int, dtype,
     return {
         "wire_format": comm_quant,
         "mesh": canonical_mesh_spec(mesh_spec),
+        "per_link": per_link,
+        "baseline_bytes": sum(b["baseline_bytes"] for b in per_link.values()),
+        "wire_bytes": sum(b["wire_bytes"] for b in per_link.values()),
+        "bottleneck_link": bottleneck,
+        "comm_seconds_rel": round(bottleneck_secs, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Train-step gradient-collective model (PR 17): the closed-form inventory of
+# one optimizer step's collectives, per mode × mesh × --zero.
+#
+# The train step's forward/backward legs are collective-free by construction
+# (train/step.py differentiates the LOCAL forward; the batch reduction is the
+# explicit gradient collective), so the FULL step program's inventory is
+# exactly the gradient sync plus — under ZeRO — the updated-shard allgather:
+#
+# - zero=0 (replicated update): one all_reduce of dW [n, n/C] over the data
+#   axis; every replica applies the identical update.
+# - zero=1 (ZeRO-style):       one reduce_scatter of dW [n, n/C] over the
+#   data axis (device r keeps its fully-reduced row chunk), the local update
+#   on the owned [n/R, n/C] shard, then one all_gather of the updated shard.
+#
+# `--grad-quant` rewrites ONLY the gradient collectives (role="grad") on the
+# wire; the weight all_gather (role="weight") carries updated parameters and
+# stays exact — quantizing it would bake wire error directly into the
+# parameters every step instead of into one gradient application (DESIGN
+# §22's wire-format placement rule).
+# ---------------------------------------------------------------------------
+
+TRAIN_MODES = ("dp", "hybrid")
+
+
+def train_axis_collectives(
+        mode: str, mesh_spec: str | None, world: int, size: int,
+        batch: int = 8, zero: bool = False,
+) -> list[tuple[str, str, int, tuple[int, ...], str]]:
+    """The float collectives of one train step's FULL program as
+    ``(kind, axis_name, axis_size, per_device_operand_shape, role)`` with
+    role ∈ {"grad", "weight"} — the train analogue of
+    `mode_axis_collectives`. ``mesh_spec=None`` means the flat 'x' mesh
+    over `world` devices."""
+    from tpu_matmul_bench.parallel.mesh import parse_mesh_spec
+
+    n = size
+    if mesh_spec is None:
+        axes: tuple[tuple[str, int], ...] = (("x", world),)
+    else:
+        axes = parse_mesh_spec(mesh_spec)
+    if mode == "dp":
+        if len(axes) != 1:
+            raise ValueError(
+                f"train mode 'dp' takes a one-axis mesh, got {mesh_spec!r}")
+        (dp_ax, r), wcols = axes[0], n
+    elif mode == "hybrid":
+        if len(axes) != 2:
+            raise ValueError(
+                f"train mode 'hybrid' needs a two-axis mesh (--mesh "
+                f"dcn:R,ici:C), got {mesh_spec!r}")
+        (dp_ax, r), (_, c) = axes
+        if n % c:
+            raise ValueError(f"size {n} must divide the {c}-wide tensor axis")
+        wcols = n // c
+    else:
+        raise ValueError(
+            f"no train comms model for mode {mode!r} (expected one of "
+            f"{TRAIN_MODES})")
+    if n % r:
+        raise ValueError(f"size {n} must divide the {r}-wide data axis "
+                         "(ZeRO shards weight rows over it)")
+    if not zero:
+        return [("all_reduce", dp_ax, r, (n, wcols), "grad")]
+    return [("reduce_scatter", dp_ax, r, (n, wcols), "grad"),
+            ("all_gather", dp_ax, r, (n // r, wcols), "weight")]
+
+
+def train_expected_collectives(
+        mode: str, mesh_spec: str | None, world: int, size: int, dtype,
+        grad_quant=None, batch: int = 8, zero: bool = False,
+) -> list[tuple[str, str, int]]:
+    """Expected per-axis collective inventory of the FULL train-step
+    program as ``(kind, axis_name, payload_bytes)`` — what the TRAIN rules
+    diff the traced step against. Only role="grad" entries are rewritten
+    on the wire under `grad_quant` (resolved per link class through
+    `link_format_spec`, the same door the step routes through)."""
+    from tpu_matmul_bench.parallel.collectives import (
+        link_format_spec, parse_wire_format)
+
+    item = _itemsize(dtype)
+    integer = np.issubdtype(np.dtype(dtype), np.integer)
+    out: list[tuple[str, str, int]] = []
+    for kind, name, axis, shape, role in train_axis_collectives(
+            mode, mesh_spec, world, size, batch=batch, zero=zero):
+        fmt = None
+        if role == "grad" and not integer:
+            fmt = parse_wire_format(link_format_spec(grad_quant, name))
+        if fmt is None:
+            # exact collectives trace even over size-1 axes; only the wire
+            # tier short-circuits at d==1
+            out.append((kind, name, int(np.prod(shape)) * item))
+        else:
+            for k, _, payload, _ in _one_wire_entries(
+                    kind, axis, shape, fmt, where=f"train/{mode}/{name}"):
+                out.append((k, name, payload))
+    return out
+
+
+def train_wire_bytes_summary(
+        mode: str, mesh_spec: str | None, world: int, size: int, dtype,
+        grad_quant, batch: int = 8, zero: bool = False) -> dict:
+    """Static per-link-class wire-byte prices for one train-step cell —
+    `hier_wire_bytes_summary` over the gradient-collective model, with the
+    exact weight all_gather priced at its full payload on its link."""
+    from tpu_matmul_bench.parallel.collectives import (
+        link_format_spec, parse_wire_format)
+    from tpu_matmul_bench.parallel.mesh import (
+        axis_link_class, canonical_mesh_spec)
+
+    item = _itemsize(dtype)
+    integer = np.issubdtype(np.dtype(dtype), np.integer)
+    per_link: dict[str, dict] = {}
+
+    def link_bucket(link: str, fmt_spec) -> dict:
+        return per_link.setdefault(link, {
+            "wire_format": fmt_spec, "baseline_bytes": 0.0,
+            "wire_payload_bytes": 0.0, "wire_scale_bytes": 0.0,
+        })
+
+    for kind, name, axis, shape, role in train_axis_collectives(
+            mode, mesh_spec, world, size, batch=batch, zero=zero):
+        link = axis_link_class(name)
+        sub = link_format_spec(grad_quant, name) if role == "grad" else None
+        fmt = None if integer else parse_wire_format(sub)
+        bucket = link_bucket(link, sub if not integer else None)
+        base = int(np.prod(shape)) * item * RING_WIRE_FACTOR[kind](axis)
+        bucket["baseline_bytes"] += base
+        if fmt is None:
+            bucket["wire_payload_bytes"] += base
+        else:
+            for k, _, payload, rl in _one_wire_entries(
+                    kind, axis, shape, fmt, where=f"train/{mode}/{name}"):
+                key = ("wire_payload_bytes" if rl == "payload"
+                       else "wire_scale_bytes")
+                bucket[key] += payload * RING_WIRE_FACTOR[k](axis)
+
+    bottleneck, bottleneck_secs = None, -1.0
+    for link, bucket in per_link.items():
+        payload_b = bucket["wire_payload_bytes"]
+        scale_b = bucket["wire_scale_bytes"]
+        baseline = bucket["baseline_bytes"]
+        for key in ("baseline_bytes", "wire_payload_bytes",
+                    "wire_scale_bytes"):
+            bucket[key] = int(round(bucket[key]))
+        bucket["wire_bytes"] = int(round(payload_b + scale_b))
+        if payload_b:
+            bucket["payload_reduction_x"] = round(baseline / payload_b, 4)
+            bucket["wire_reduction_x"] = round(
+                baseline / (payload_b + scale_b), 4)
+        secs = (payload_b + scale_b) * LINK_WIRE_SECONDS[link]
+        bucket["wire_seconds_rel"] = round(secs, 1)
+        if secs > bottleneck_secs:
+            bottleneck, bottleneck_secs = link, secs
+
+    return {
+        "wire_format": grad_quant,
+        "mesh": canonical_mesh_spec(mesh_spec) if mesh_spec else None,
+        "zero": int(zero),
         "per_link": per_link,
         "baseline_bytes": sum(b["baseline_bytes"] for b in per_link.values()),
         "wire_bytes": sum(b["wire_bytes"] for b in per_link.values()),

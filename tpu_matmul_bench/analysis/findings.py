@@ -213,6 +213,44 @@ RULES: dict[str, tuple[Severity, str]] = {
                            "EXEMPLAR_LIMIT bound, or the limit is outside "
                            "its sane range — trace-id retention behind "
                            "tail quantiles must stay small"),
+    "TRAIN-001": ("error", "train-step collective inventory mismatch: a "
+                           "traced full-step program's (kind, axis) "
+                           "multiset differs from the closed-form "
+                           "gradient-collective model "
+                           "(comms_model.train_expected_collectives) — a "
+                           "collective appeared in fwd/bwd, vanished from "
+                           "the sync, or moved to the wrong axis"),
+    "TRAIN-002": ("error", "train-step collective payload mismatch: right "
+                           "(kind, axis), wrong bytes vs the gradient-"
+                           "collective model — the wire format rewrote "
+                           "the wrong collective (the ZeRO parameter "
+                           "allgather must travel exact) or sized a "
+                           "chunk wrong"),
+    "TRAIN-003": ("error", "ZeRO shard-ownership violation: the per-"
+                           "replica updated weight-row shards do not "
+                           "tile the parameter disjointly (reduce_scatter "
+                           "chunk, owned update slice, and allgather "
+                           "reassembly disagree about who owns which "
+                           "rows)"),
+    "TRAIN-004": ("error", "train-step downcast budget exceeded: the "
+                           "quantized-wire step performs more non-wire "
+                           "float downcasts than the exact step — "
+                           "dequantized gradients must ride the fp32 "
+                           "accumulator into the update's single final "
+                           "downcast"),
+    "TRAIN-005": ("error", "impure train step: a host callback / side-"
+                           "effecting primitive inside the timed "
+                           "optimizer step — the step must be a pure "
+                           "function of (x, w) or the timing split and "
+                           "drift series measure the host"),
+    "SPEC-009": ("error", "invalid train flag in a spec's job flags: "
+                          "--grad-quant not in the wire-format grammar "
+                          "(or the legacy control tier, which has no "
+                          "reduce_scatter half), a per-link value with "
+                          "no factorized --mesh, --zero outside {0,1}, "
+                          "--steps < 2 when a drift series is measured, "
+                          "or a (mode, mesh) pair the collective model "
+                          "rejects"),
 }
 
 

@@ -594,6 +594,126 @@ def _hier_findings(job: Any, label: str) -> list[Finding]:
     return findings
 
 
+def _lint_train_job(job: Any, label: str) -> list[Finding]:
+    """SPEC-009 for one train job: subcommand, the --grad-quant grammar
+    (minus the legacy control tier, which has no reduce_scatter half),
+    per-link values only with a factorized --mesh, --zero ∈ {0, 1},
+    --steps ≥ 2 whenever a quantized wire makes the drift series
+    measurable, and a dry run of the gradient-collective model over the
+    job's (mode, mesh, size, zero) grid — shape/divisibility rejections
+    surface at lint time, not mid-campaign."""
+    import numpy as np
+
+    from tpu_matmul_bench.analysis.comms_model import (
+        train_expected_collectives,
+    )
+    from tpu_matmul_bench.parallel.collectives import (
+        is_per_link_spec,
+        parse_wire_format,
+        validate_comm_quant,
+    )
+
+    argv = list(job.argv)
+    findings: list[Finding] = []
+    if not ({"bench", "selftest"} & set(argv)):
+        findings.append(Finding(
+            "SPEC-009", label,
+            "train job names no subcommand: expected 'bench' or "
+            "'selftest' in the flags",
+            details={"argv": argv}))
+        return findings
+    if "selftest" in argv:
+        return findings  # selftest takes only --quiet; nothing to grid
+
+    if _flag_values(argv, "--comm-quant") or "--comm-quant" in argv:
+        findings.append(Finding(
+            "SPEC-009", label,
+            "train takes --grad-quant (gradient collectives), not "
+            "--comm-quant", details={}))
+
+    meshes = _raw_flag_values(argv, "--mesh")
+    quants = _raw_flag_values(argv, "--grad-quant")
+    for q in quants:
+        try:
+            validate_comm_quant(q)
+            if not is_per_link_spec(q):
+                fmt = parse_wire_format(q)
+                if fmt is not None and fmt.legacy:
+                    raise ValueError(
+                        f"{q!r} is the legacy control tier, which has no "
+                        "reduce_scatter half")
+        except ValueError as e:
+            findings.append(Finding(
+                "SPEC-009", label, f"bad --grad-quant value: {e}",
+                details={"grad_quant": q}))
+            continue
+        if is_per_link_spec(q) and not meshes:
+            findings.append(Finding(
+                "SPEC-009", label,
+                f"per-link --grad-quant {q} without a --mesh "
+                "factorization — there is only one (flat) link class to "
+                "route over",
+                details={"grad_quant": q}))
+
+    zeros: list[int] = []
+    for tok in _flag_values(argv, "--zero"):
+        if tok not in ("0", "1"):
+            findings.append(Finding(
+                "SPEC-009", label,
+                f"--zero must be 0 or 1, got {tok!r}",
+                details={"zero": tok}))
+        else:
+            zeros.append(int(tok))
+
+    # a quantized gradient wire makes the drift series measurable; a
+    # one-step series is a point, not a drift
+    wired = [q for q in quants if q != "none"]
+    for tok in _flag_values(argv, "--steps"):
+        try:
+            steps = int(tok)
+        except ValueError:
+            steps = 0
+        if steps < 1:
+            findings.append(Finding(
+                "SPEC-009", label,
+                f"--steps must be a positive count, got {tok!r}",
+                details={"steps": tok}))
+        elif steps < 2 and wired:
+            findings.append(Finding(
+                "SPEC-009", label,
+                f"--steps {steps} with a quantized --grad-quant: the "
+                "update-error drift series needs at least 2 steps to "
+                "show drift",
+                details={"steps": steps, "grad_quant": wired}))
+
+    # dry-run the gradient-collective model over the job's grid
+    devs = [int(x) for x in _flag_values(argv, "--num-devices")
+            if x.isdigit()]
+    sizes = [int(x) for x in _flag_values(argv, "--sizes") if x.isdigit()]
+    modes = _flag_values(argv, "--mode") or ["dp"]
+    for mode in modes:
+        for mesh in (meshes or [None]):
+            for world in (devs or [1]):
+                for s in sizes:
+                    for q in (quants or [None]):
+                        for z in (zeros or [0]):
+                            try:
+                                train_expected_collectives(
+                                    mode, mesh, world, s, np.float32,
+                                    None if q == "none" else q,
+                                    zero=bool(z))
+                            except ValueError as e:
+                                findings.append(Finding(
+                                    "SPEC-009", label,
+                                    f"train --mode {mode} "
+                                    f"--mesh {mesh or '(flat)'} --sizes "
+                                    f"{s} --zero {z} cannot run: {e}",
+                                    details={"mode": mode, "mesh": mesh,
+                                             "size": s, "zero": z,
+                                             "grad_quant": q}))
+    return findings
+
+
 def _unknown_key_findings(data: dict[str, Any], where: str) -> list[Finding]:
     findings = []
 
@@ -692,6 +812,8 @@ def lint_spec_file(path: str | Path) -> list[Finding]:
                                             spec_dir=p.parent))
         elif job.program == "obs":
             findings.extend(_lint_obs_job(job, f"{where}:{job.job_id}"))
+        elif job.program == "train":
+            findings.extend(_lint_train_job(job, f"{where}:{job.job_id}"))
 
     # SPEC-007: --comm-quant wire-format validity, statically — the value
     # must parse against the wire-format grammar, and for block formats

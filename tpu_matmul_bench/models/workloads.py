@@ -96,3 +96,43 @@ class BatchedMatmulWorkload:
             self.seed + seed_offset, (self.batch, self.size, self.size), self.dtype
         )
         return a, b
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainStepWorkload:
+    """One optimizer step of the linear train-step benchmark (train/step.py):
+    forward Y[b] = X[b]·W over a global batch, quadratic loss, backward
+    dW = Σ_b X[b]ᵀ·(Y[b]/denom), SGD update — two `size`-square matmul
+    applications per batch element per step (the forward product and the
+    VJP's gradient contraction; the cotangent itself is elementwise)."""
+
+    size: int
+    dtype: Any
+    batch: int = 8
+    steps: int = 4
+    lr: float = 0.01
+    seed: int = 0
+
+    #: matmul applications per batch element per step (fwd + bwd legs)
+    MATMULS_PER_SAMPLE = 2
+
+    @property
+    def flops(self) -> float:
+        """FLOPs of ONE step (the timed unit; multiply by `steps` for the
+        whole drift series)."""
+        return matmul_flops(self.size) * self.batch * self.MATMULS_PER_SAMPLE
+
+    @property
+    def memory_gib(self) -> float:
+        # X batch + W + Y batch + dW (all in the operand dtype; the fp32
+        # update temporaries are transient)
+        return matrix_memory_gib(self.size, self.dtype,
+                                 count=2 * self.batch + 2)
+
+    def operands(self, seed_offset: int = 0) -> tuple[jax.Array, jax.Array]:
+        (x,) = random_operands(self.seed + seed_offset,
+                               (self.batch, self.size, self.size),
+                               self.dtype, count=1)
+        (w,) = random_operands(self.seed + seed_offset + 1,
+                               (self.size, self.size), self.dtype, count=1)
+        return x, w

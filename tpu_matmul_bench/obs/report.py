@@ -186,6 +186,18 @@ def render(store: HistoryStore,
              "last", "best", "trend"], rows))
         lines.append("")
 
+    if "train" in by_kind:
+        lines.append("## Train step (step-time ms / update-error drift, "
+                     "lower is better)")
+        lines.append("")
+        rows = _group_rows(by_kind["train"], rounds,
+                           ("metric", "mode", "mesh", "zero", "grad_quant",
+                            "size"))
+        lines.extend(_table(
+            ["metric", "mode", "mesh", "zero", "wire", "size", "series",
+             "rounds", "last", "best", "trend"], rows))
+        lines.append("")
+
     if "fault_audit" in by_kind:
         lines.append("## Fault-audit cells (pass=1)")
         lines.append("")

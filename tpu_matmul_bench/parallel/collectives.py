@@ -56,9 +56,11 @@ from tpu_matmul_bench.utils.compat import axis_size, pcast_varying
 
 __all__ = [
     "WireFormat", "parse_wire_format", "wire_psum", "wire_all_gather",
+    "wire_reduce_scatter",
     "is_per_link_spec", "parse_link_formats", "link_format_spec",
     "validate_comm_quant",
-    "psum_impl", "allgather_impl", "comm_quant_extra", "uses_quantized_comm",
+    "psum_impl", "allgather_impl", "reduce_scatter_impl",
+    "comm_quant_extra", "uses_quantized_comm",
     "comm_quant_record_extra", "WIRE_DTYPES",
     "psum_over", "pmean_over", "all_gather_over", "verify_collectives",
 ]
@@ -273,6 +275,51 @@ def wire_psum(x: jax.Array, axis_name: str, fmt: WireFormat,
     return out.astype(res_dtype)
 
 
+def wire_reduce_scatter(x: jax.Array, axis_name: str, fmt: WireFormat,
+                        out_dtype=None) -> jax.Array:
+    """reduce_scatter(SUM) with block-quantized wire traffic; use inside
+    shard_map. Device i ends with the fully-reduced i-th row chunk —
+    the same ownership as ``lax.psum_scatter(..., tiled=True)``.
+
+    This is `wire_psum`'s reduce-scatter ring with the trailing all_gather
+    dropped: (d−1) ppermute hops of a quantized chunk + its fp32 scale
+    side-channel, so it moves 1/d of the ring-psum's wire bytes — the
+    gradient-sync half a ZeRO-style sharded update actually needs.
+    `out_dtype=None` downcasts once to x.dtype; pass jnp.float32 to keep
+    the fp32 accumulator alive for the consuming update (fuse_f32).
+    Integer inputs take the exact path; d==1 is inert.
+    """
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+    d = axis_size(axis_name)
+    if d == 1:
+        return x  # fully inert: identical to the exact program (DTYPE-Q-002)
+    res_dtype = jnp.dtype(out_dtype) if out_dtype is not None else x.dtype
+    orig_shape = x.shape
+    if orig_shape[0] % d:
+        raise ValueError(
+            f"leading dim {orig_shape[0]} of shape {orig_shape} must divide "
+            f"the {d}-device axis to scatter row chunks")
+    x2 = x.reshape(-1, orig_shape[-1])
+    chunk = x2.shape[0] // d
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % d) for i in range(d)]
+
+    def my_chunk(c):
+        return lax.dynamic_slice_in_dim(x2, c * chunk, chunk).astype(jnp.float32)
+
+    # same ring schedule as wire_psum's reduce-scatter phase: chunk `my`
+    # is home after d−1 hops, fully summed
+    acc = my_chunk(lax.rem(my + 2 * d - 1, d))
+    for t in range(1, d):
+        q, s = _wire_quantize(acc, fmt)
+        q = lax.ppermute(q, axis_name, perm)
+        s = lax.ppermute(s, axis_name, perm)
+        acc = _wire_dequantize(q, s) + my_chunk(lax.rem(my + 2 * d - 1 - t, d))
+    out = acc.reshape((orig_shape[0] // d,) + orig_shape[1:])
+    return out.astype(res_dtype)
+
+
 def wire_all_gather(x: jax.Array, axis_name: str, fmt: WireFormat,
                     axis: int = 0, out_dtype=None) -> jax.Array:
     """all_gather with block-quantized wire traffic; use inside shard_map.
@@ -403,6 +450,43 @@ def allgather_impl(comm_quant: str | None, fuse_f32: bool = False):
     def wire(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
         return wire_all_gather(x, axis_name, fmt, axis=axis,
                                out_dtype=out_dtype)
+
+    return wire
+
+
+def reduce_scatter_impl(comm_quant: str | None, fuse_f32: bool = False):
+    """The reduce_scatter implementation a program should use for a wire
+    format spec (the RS analogue of `psum_impl`; same format routing,
+    per-link resolution, and `fuse_f32` contract).
+
+    The output is device-varying by nature (each device keeps its own
+    chunk), so there is no `varying_out` knob; callers shard the output.
+    The legacy ``int8``/``int8-tensor`` control tier predates the ring
+    split and has no RS half — it is rejected rather than silently run
+    exact, so a ledger can never claim a quantized wire it didn't use.
+    """
+    if is_per_link_spec(comm_quant):
+        parse_link_formats(comm_quant)  # fail fast on bad grammar
+
+        def per_link(x: jax.Array, axis_name: str) -> jax.Array:
+            sub = link_format_spec(comm_quant, axis_name)
+            return reduce_scatter_impl(sub, fuse_f32)(x, axis_name)
+
+        return per_link
+    fmt = parse_wire_format(comm_quant)
+    if fmt is None:
+        return lambda x, axis_name: lax.psum_scatter(
+            x, axis_name, scatter_dimension=0, tiled=True)
+    if fmt.legacy:
+        raise ValueError(
+            f"--grad-quant {fmt.spec!r}: the legacy control tier has no "
+            "reduce_scatter half; use none, fp8, int8-block:<B> or "
+            "fp8-block:<B>")
+    _count_program(fmt, "reduce_scatter")
+    out_dtype = jnp.float32 if fuse_f32 else None
+
+    def wire(x: jax.Array, axis_name: str) -> jax.Array:
+        return wire_reduce_scatter(x, axis_name, fmt, out_dtype=out_dtype)
 
     return wire
 

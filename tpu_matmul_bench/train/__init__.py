@@ -1,0 +1,8 @@
+"""Training-step workload subsystem (DESIGN §22).
+
+One optimizer step — sharded forward/backward matmul, quantized gradient
+sync, ZeRO-style cross-replica weight update — expressed over the same
+meshes, wire formats, and certification machinery as the serving-side
+benchmarks. `step.py` builds the programs, `harness.py` times and
+validates them, `cli.py` wires ``python -m tpu_matmul_bench train``.
+"""

@@ -1,0 +1,263 @@
+"""`python -m tpu_matmul_bench train {bench, selftest}`.
+
+The training-step front end (DESIGN §22):
+
+- `bench` — one optimizer step per mode × mesh × size: per-phase
+  (fwd/bwd/grad-comm/update/allgather) timing split, `--grad-quant` wire
+  formats on the gradient collectives, `--zero {0,1}` ZeRO-vs-replicated
+  A/B, multi-step update-error drift vs an exact-wire shadow, and the
+  dense fp32 reference check under `--validate`.
+- `selftest` — CI layer 12's in-process certification: the TRAIN audit
+  tree must be clean, a ZeRO step must equal the replicated step at fp32
+  (≤1e-5), and the update-error drift must grow with the wire block size.
+  Exit 0 = the train-step contract holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Sequence
+
+_USAGE = ("usage: python -m tpu_matmul_bench train {bench,selftest} ...\n"
+          "  bench     one-optimizer-step benchmark (--grad-quant, --zero, "
+          "--steps)\n"
+          "  selftest  TRAIN audit + ZeRO-vs-replicated numerics + drift "
+          "monotonicity")
+
+
+def grad_quant_arg(value: str) -> str:
+    """argparse type for --grad-quant: the --comm-quant grammar minus the
+    legacy control tier (which has no reduce_scatter half)."""
+    from tpu_matmul_bench.parallel.collectives import (
+        is_per_link_spec, parse_wire_format, validate_comm_quant)
+
+    try:
+        validate_comm_quant(value)
+        if not is_per_link_spec(value):
+            fmt = parse_wire_format(value)
+            if fmt is not None and fmt.legacy:
+                raise ValueError(
+                    f"--grad-quant {value!r}: the legacy control tier has "
+                    "no reduce_scatter half; use none, fp8, int8-block:<B> "
+                    "or fp8-block:<B> (or the per-link form)")
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e)) from e
+    return value
+
+
+def _maybe_force_host_devices(needed: int | None) -> None:
+    """Make the acceptance command runnable standalone: when the mesh needs
+    N>1 devices, ask the CPU host platform for N virtual ones BEFORE the
+    backend initializes. The flag only affects the host (CPU) platform, so
+    on a real accelerator run it is inert."""
+    if not needed or needed <= 1:
+        return
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{xla_flags} --xla_force_host_platform_device_count={needed}"
+        ).strip()
+
+
+def _bench_main(argv: Sequence[str]) -> list:
+    from tpu_matmul_bench.train.step import (
+        DEFAULT_BATCH, DEFAULT_LR, DEFAULT_STEPS, TRAIN_MODES)
+    from tpu_matmul_bench.utils.config import build_parser
+
+    parser = build_parser(
+        "Training-step benchmark: sharded fwd/bwd matmul, quantized "
+        "gradient sync, ZeRO-style sharded update (train/step.py).",
+        modes=TRAIN_MODES, default_mode="dp")
+    # one step of a 256² linear model is the certifiable CPU-mesh default;
+    # the in-core matmul sweep's 4k-16k defaults would dwarf it
+    parser.set_defaults(sizes=[256], iterations=5, warmup=2)
+    parser.add_argument(
+        "--grad-quant", type=grad_quant_arg, default=None,
+        metavar="{none,fp8,int8-block:<B>,fp8-block:<B>,dcn=<f>,ici=<f>}",
+        help="Wire format for the GRADIENT collectives only (the ZeRO "
+             "allgather of updated parameters always travels exact). Same "
+             "grammar as --comm-quant minus the legacy control tier; the "
+             "per-link form picks a format per link class on a --mesh "
+             "factorized mesh, e.g. dcn=fp8-block:32,ici=none.")
+    parser.add_argument(
+        "--zero", type=int, choices=(0, 1), default=0,
+        help="1 = ZeRO-style sharded update: reduce_scatter the gradient "
+             "over the data axis, update only the owned weight-row shard, "
+             "allgather the updated shards. 0 (default) = all_reduce + "
+             "replicated update — the A/B control.")
+    parser.add_argument(
+        "--steps", type=int, default=DEFAULT_STEPS,
+        help="Optimizer steps for the update-error drift series "
+             f"(quantized-wire vs exact-wire shadow; default {DEFAULT_STEPS})")
+    parser.add_argument(
+        "--batch", type=int, default=DEFAULT_BATCH,
+        help=f"Global batch per step (default {DEFAULT_BATCH}; grown to "
+             "cover the data axis when it doesn't divide)")
+    parser.add_argument(
+        "--lr", type=float, default=DEFAULT_LR,
+        help=f"SGD learning rate of the weight update (default {DEFAULT_LR})")
+    args = parser.parse_args(list(argv))
+    if args.steps < 1:
+        parser.error("--steps must be >= 1")
+    if getattr(args, "comm_quant", None):
+        parser.error("the train step takes --grad-quant (gradient "
+                     "collectives), not --comm-quant")
+
+    # before any backend query: the mesh's device need, or --num-devices
+    if args.mesh:
+        from tpu_matmul_bench.parallel.mesh import parse_mesh_spec
+
+        needed = 1
+        for _, d in parse_mesh_spec(args.mesh):
+            needed *= d
+    else:
+        needed = args.num_devices
+    _maybe_force_host_devices(needed)
+
+    from tpu_matmul_bench.benchmarks.runner import run_sizes
+    from tpu_matmul_bench.parallel.mesh import make_factorized_mesh, make_mesh
+    from tpu_matmul_bench.train.harness import TrainArgs, bench_one
+    from tpu_matmul_bench.utils import telemetry
+    from tpu_matmul_bench.utils.config import config_from_args
+    from tpu_matmul_bench.utils.device import (
+        collect_device_info,
+        device_banner,
+        resolve_devices,
+    )
+    from tpu_matmul_bench.utils.reporting import header, report
+
+    config = config_from_args(args)
+    targs = TrainArgs(mode=config.mode or "dp", zero=bool(args.zero),
+                      grad_quant=args.grad_quant, steps=args.steps,
+                      batch=args.batch, lr=args.lr)
+
+    devices = resolve_devices(config.device, config.num_devices)
+    info = collect_device_info(devices)
+    mesh = (make_factorized_mesh(devices, config.mesh) if config.mesh
+            else make_mesh(devices))
+    report(device_banner(info))
+    report(header(
+        "Training-step Benchmark",
+        {
+            "Mode": targs.mode,
+            "Mesh": " x ".join(f"{mesh.shape[ax]} ({ax})"
+                               for ax in mesh.axis_names),
+            "ZeRO": "sharded update" if targs.zero else "replicated update",
+            "Gradient wire": targs.grad_quant or "exact",
+            "Steps (drift series)": targs.steps,
+            "Global batch": targs.batch,
+            "Data type": config.dtype_name,
+            "Iterations per test": config.iterations,
+        },
+    ))
+
+    with telemetry.session(config.trace_out):
+        records = run_sizes(
+            config, lambda s: bench_one(config, mesh, targs, s))
+    report("\n" + "=" * 70, "Benchmark completed!", "=" * 70)
+    return records
+
+
+def _selftest(argv: Sequence[str]) -> list:
+    parser = argparse.ArgumentParser(
+        prog="train selftest",
+        description="TRAIN audit + ZeRO numerics + drift-monotonicity "
+                    "certification")
+    parser.add_argument("--quiet", action="store_true",
+                        help="suppress per-finding lines")
+    args = parser.parse_args(list(argv))
+
+    # the audits need the 8-virtual-device CPU mesh, exactly like lint
+    from tpu_matmul_bench.analysis.cli import _force_cpu_backend
+
+    _force_cpu_backend()
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_matmul_bench.analysis.auditor import audit_train
+    from tpu_matmul_bench.parallel.mesh import make_factorized_mesh, make_mesh
+    from tpu_matmul_bench.train.harness import _rel_err, drift_series
+    from tpu_matmul_bench.train.step import make_train_setup
+
+    failures: list[str] = []
+
+    # 1) TRAIN-00x: full-step inventories vs the closed-form gradient-
+    #    collective model, downcast budget, ZeRO disjointness, purity
+    findings = audit_train()
+    for f in findings:
+        if not args.quiet:
+            print(f"[{f.severity:5s}] {f.rule} {f.where}: {f.message}")
+        if f.severity == "error":
+            failures.append(f"{f.rule} {f.where}")
+    print(f"train audit: {len(findings)} finding(s)")
+
+    # 2) the ZeRO ownership contract in numbers: a sharded-update step
+    #    must equal the replicated-update step (and the dense reference)
+    #    at fp32 to 1e-5, on both mesh families
+    cells = [("dp", make_mesh(jax.devices()[:8])),
+             ("hybrid", make_factorized_mesh(jax.devices()[:8],
+                                             "dcn:2,ici:4"))]
+    for mode, mesh in cells:
+        sz = make_train_setup(mesh, mode, 256, jnp.float32, zero=True)
+        sr = make_train_setup(mesh, mode, 256, jnp.float32, zero=False)
+        x, w0 = sz.operands
+        wz = sz.step(x, w0)
+        wr = sr.step(x, w0)
+        err_ab = float(_rel_err(wz, wr))
+        err_ref = float(_rel_err(wz, sz.reference(x, w0)))
+        if err_ab > 1e-5:
+            failures.append(
+                f"{mode}: ZeRO step != replicated step (rel {err_ab:.2e})")
+        if err_ref > 1e-5:
+            failures.append(
+                f"{mode}: ZeRO step != dense reference (rel {err_ref:.2e})")
+        print(f"zero numerics [{mode}]: vs replicated {err_ab:.2e}, "
+              f"vs reference {err_ref:.2e}")
+
+    # 3) drift monotonicity in block size: coarser scale blocks must not
+    #    DECREASE the update error (one fp32 scale per 16 columns bounds
+    #    outlier damage more tightly than one per 128)
+    mesh = make_mesh(jax.devices()[:8])
+    drifts = {}
+    for block in (16, 128):
+        s_q = make_train_setup(mesh, "dp", 256, jnp.float32, zero=True,
+                               grad_quant=f"fp8-block:{block}")
+        s_x = make_train_setup(mesh, "dp", 256, jnp.float32, zero=True,
+                               grad_quant=None)
+        drifts[block] = drift_series(s_q, s_x, 4)
+    print(f"drift series: block16 {drifts[16]}, block128 {drifts[128]}")
+    if drifts[128][-1] < drifts[16][-1]:
+        failures.append(
+            f"drift not monotone in block size: fp8-block:128 final "
+            f"{drifts[128][-1]:.3e} < fp8-block:16 final "
+            f"{drifts[16][-1]:.3e}")
+
+    if failures:
+        print(f"train selftest: FAILED ({len(failures)} problem(s))")
+        for msg in failures:
+            print(f"  - {msg}")
+        raise SystemExit(1)
+    print("train selftest: OK")
+    return [f.to_record() for f in findings]
+
+
+def main(argv: Sequence[str] | None = None) -> list:
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "bench" in argv and (not argv or argv[0] != "selftest"):
+        # accept the subcommand anywhere: campaign specs prepend their
+        # defaults flags before the job's own tokens
+        argv.remove("bench")
+        return _bench_main(argv)
+    if argv and argv[0] == "selftest":
+        return _selftest(argv[1:])
+    is_help = bool(argv) and argv[0] in ("-h", "--help")
+    print(_USAGE, file=sys.stdout if is_help else sys.stderr)
+    raise SystemExit(0 if is_help else 2)
+
+
+if __name__ == "__main__":
+    main()

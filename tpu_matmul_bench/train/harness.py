@@ -1,0 +1,180 @@
+"""Timing, validation, and ledger assembly for the train-step workload.
+
+`bench_one` times the five cumulative-prefix programs (`step.PHASES`),
+derives the per-phase split by telescoping (so the split reconciles with
+the full-step wall time as an identity), measures the multi-step
+update-error drift series against an exact-wire shadow, validates one
+step against the dense fp32 reference, and assembles a schema-v2
+`BenchmarkRecord` with the analytic per-link wire attribution from
+`comms_model.train_wire_bytes_summary`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from tpu_matmul_bench.parallel.collectives import link_format_spec
+from tpu_matmul_bench.parallel.mesh import mesh_device_kind
+from tpu_matmul_bench.train.step import (
+    DEFAULT_BATCH,
+    DEFAULT_LR,
+    DEFAULT_STEPS,
+    PHASES,
+    TrainStepSetup,
+    make_train_setup,
+    train_tolerance,
+)
+from tpu_matmul_bench.utils.config import BenchConfig
+from tpu_matmul_bench.utils.metrics import calculate_tflops
+from tpu_matmul_bench.utils.reporting import BenchmarkRecord
+from tpu_matmul_bench.utils.timing import Timing, time_jitted
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainArgs:
+    """The train-specific knobs on top of the shared BenchConfig."""
+
+    mode: str = "dp"
+    zero: bool = False
+    grad_quant: str | None = None
+    steps: int = DEFAULT_STEPS
+    batch: int = DEFAULT_BATCH
+    lr: float = DEFAULT_LR
+
+
+@jax.jit
+def _rel_err(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Frobenius relative error ‖a − b‖ / ‖b‖ in fp32."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    return jnp.linalg.norm(af - bf) / jnp.maximum(
+        jnp.linalg.norm(bf), jnp.float32(1e-30))
+
+
+def wire_active(setup: TrainStepSetup) -> bool:
+    """Whether the gradient sync actually quantizes: a wire format resolves
+    on the data axis AND the axis is wide enough to emit traffic."""
+    fmt = link_format_spec(setup.grad_quant, setup.dp_axis)
+    return fmt not in (None, "none") and setup.dp > 1
+
+
+def drift_series(setup: TrainStepSetup, exact: TrainStepSetup,
+                 steps: int) -> list[float]:
+    """Per-step update relative error: iterate the quantized-wire step and
+    the exact-wire shadow from the same w0 (both update in fp32 — the
+    difference isolated here is purely what the wire format did to the
+    gradients) and record ‖w_q − w_x‖/‖w_x‖ after each step."""
+    x, w0 = setup.operands
+    wq, wx = w0, w0
+    out: list[float] = []
+    for _ in range(steps):
+        wq = setup.step(x, wq)
+        wx = exact.step(x, wx)
+        out.append(round(float(_rel_err(wq, wx)), 10))
+    return out
+
+
+def validate_step(setup: TrainStepSetup, dtype) -> dict:
+    """One full step vs the dense fp32 reference, in the house
+    corner_validation verdict shape."""
+    x, w0 = setup.operands
+    got = setup.step(x, w0)
+    ref = setup.reference(x, w0)
+    err = float(_rel_err(got, ref))
+    tol = train_tolerance(dtype, setup.grad_quant, setup.dp_axis, setup.dp)
+    return {
+        "validation": "ok" if err <= tol else "FAILED",
+        "validation_max_rel_err": round(err, 8),
+        "validation_tolerance": tol,
+    }
+
+
+def bench_one(config: BenchConfig, mesh, targs: TrainArgs,
+              size: int) -> BenchmarkRecord:
+    """Measure one (mode, mesh, size) train cell → BenchmarkRecord."""
+    impl = config.matmul_impl or "xla"
+    if impl == "auto":
+        impl = "xla"  # auto may route to pallas, which has no VJP rule
+    if impl != "xla":
+        raise ValueError(
+            f"--matmul-impl {impl}: the train step differentiates the "
+            "forward with jax.vjp; only the xla matmul is differentiable")
+    setup = make_train_setup(
+        mesh, targs.mode, size, config.dtype, batch=targs.batch,
+        zero=targs.zero, grad_quant=targs.grad_quant, lr=targs.lr,
+        impl=impl, seed=config.seed)
+
+    # cumulative-prefix timing: prefix k runs phases 1..k, so the split
+    # below telescopes to the full wall time exactly
+    cum: dict[str, Timing] = {}
+    for phase in PHASES:
+        cum[phase] = time_jitted(
+            setup.prefixes[phase], setup.operands,
+            iterations=config.iterations, warmup=config.warmup)
+    wall = cum["allgather"].avg_s
+    phases_s: dict[str, float] = {}
+    prev = 0.0
+    for phase in PHASES:
+        t = cum[phase].avg_s
+        phases_s[f"{phase}_s"] = round(t - prev, 9)
+        prev = t
+
+    drift: list[float] = []
+    if wire_active(setup) and targs.steps > 0:
+        exact = make_train_setup(
+            mesh, targs.mode, size, config.dtype, batch=targs.batch,
+            zero=targs.zero, grad_quant=None, lr=targs.lr, impl=impl,
+            seed=config.seed)
+        drift = drift_series(setup, exact, targs.steps)
+
+    extras: dict = {"train": {
+        "zero": int(setup.zero),
+        "grad_quant": setup.grad_quant or "none",
+        "steps": targs.steps,
+        "lr": setup.lr,
+        "dp": setup.dp, "tp": setup.tp,
+        "global_batch": setup.global_batch,
+        "local_batch": setup.local_batch,
+        "phases": phases_s,
+        "phase_sum_s": round(sum(phases_s.values()), 9),
+        "wall_s": round(wall, 9),
+        "update_drift": drift,
+    }}
+    if drift:
+        extras["train"]["update_rel_err"] = drift[-1]
+    if setup.mesh_spec is not None:
+        extras["mesh"] = setup.mesh_spec
+    try:
+        from tpu_matmul_bench.analysis.comms_model import (
+            train_wire_bytes_summary)
+
+        extras["train"]["wire"] = train_wire_bytes_summary(
+            targs.mode, setup.mesh_spec, setup.world, size, config.dtype,
+            setup.grad_quant, batch=setup.global_batch, zero=setup.zero)
+    except ValueError:
+        pass  # cells the analytic model doesn't cover stay label-only
+
+    # fwd+bwd prefix is the pure compute leg; the comm split charges the
+    # gradient sync and the ZeRO allgather (update stays compute)
+    compute_s = cum["bwd"].avg_s + (cum["update"].avg_s
+                                    - cum["grad_comm"].avg_s)
+    comm_s = max(wall - compute_s, 0.0)
+    total = calculate_tflops(size, wall, num_ops=2 * setup.global_batch)
+    rec = BenchmarkRecord(
+        benchmark="train", mode=targs.mode, size=size,
+        dtype=config.dtype_name, world=setup.world,
+        iterations=cum["allgather"].iterations, warmup=config.warmup,
+        avg_time_s=wall,
+        tflops_per_device=total / setup.world,
+        tflops_total=total,
+        device_kind=mesh_device_kind(mesh),
+        compute_time_s=compute_s,
+        comm_time_s=comm_s,
+        extras=extras,
+    )
+    if config.validate:
+        rec.extras.update(validate_step(setup, config.dtype))
+    return rec

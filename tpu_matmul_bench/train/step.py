@@ -1,0 +1,281 @@
+"""One optimizer step as shard_map programs over the benchmark meshes.
+
+The workload is deliberately minimal — linear model Y[b] = X[b]·W,
+quadratic loss L = ‖Y‖²/(2·denom) — so the backward pass is one honest
+`jax.vjp` through the mode's matmul and the analytic gradient
+dW = Σ_b X[b]ᵀ·(Y[b]/denom) is checkable in closed form. The step's
+dataflow (DESIGN §22):
+
+    forward (local)  →  backward via jax.vjp (local)  →  gradient sync
+    over the data axis  →  weight update (fp32)  →  [ZeRO] allgather of
+    the updated shards
+
+Two train modes over the existing meshes:
+
+- ``dp``     — one-axis mesh (flat 'x' or a single-axis factorization):
+  X sharded over the batch, W replicated.
+- ``hybrid`` — two-axis mesh (``--mesh dcn:R,ici:C``): X sharded over the
+  outer (data) axis, W column-sharded over the inner (tensor) axis —
+  axis roles come from POSITION, the `parallel/hybrid.py` convention, so
+  the gradient sync rides DCN and stays inside a slice otherwise.
+
+The forward/backward legs are collective-free by construction — the step
+differentiates the LOCAL forward and performs the cross-replica batch
+reduction as an explicit gradient collective — so the FULL step's traced
+inventory is exactly the gradient sync (+ the ZeRO weight allgather),
+which is what `analysis/comms_model.train_axis_collectives` prices and
+the TRAIN audit rules certify.
+
+`--grad-quant` routes ONLY the gradient collectives through the wire
+formats (`psum_impl`/`reduce_scatter_impl`, per-link via
+`link_format_spec`); the ZeRO allgather of updated parameters is always
+exact. The update itself runs in fp32 and downcasts exactly once to the
+weight dtype (the DTYPE-Q-001 accumulate-high discipline, audited over
+the whole step by TRAIN-004).
+
+Per-phase timing uses CUMULATIVE PREFIX programs: phase k's program runs
+phases 1..k and returns the value crossing the k-th boundary, so
+phase_time(k) = t(k) − t(k−1) and the per-phase split telescopes to the
+full-step wall time by construction — the reconciliation the ledger
+reports is an identity, not a model fit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_matmul_bench.ops.matmul import matmul_2d
+from tpu_matmul_bench.parallel.collectives import (
+    link_format_spec,
+    psum_impl,
+    reduce_scatter_impl,
+)
+from tpu_matmul_bench.parallel.mesh import (
+    mesh_device_kind,
+    mesh_spec_of,
+    sharded_normal,
+    smap,
+)
+from tpu_matmul_bench.utils.metrics import matrix_memory_gib
+
+TRAIN_MODES = ("dp", "hybrid")
+
+#: the step's phase boundaries, in dataflow order — prefix program k runs
+#: phases 1..k (see module docstring)
+PHASES = ("fwd", "bwd", "grad_comm", "update", "allgather")
+
+DEFAULT_BATCH = 8
+DEFAULT_STEPS = 4
+DEFAULT_LR = 0.01
+
+
+def train_axes(mesh: Mesh, mode: str) -> tuple[str, str | None]:
+    """(data_axis, tensor_axis|None) for a train mode on a mesh — roles by
+    POSITION (outer = data), the `parallel/hybrid.py` convention."""
+    names = mesh.axis_names
+    if mode == "dp":
+        if len(names) != 1:
+            raise ValueError(
+                f"train mode 'dp' takes a one-axis mesh, got axes {names}")
+        return names[0], None
+    if mode == "hybrid":
+        if len(names) != 2:
+            raise ValueError(
+                "train mode 'hybrid' needs a two-axis mesh "
+                f"(--mesh dcn:R,ici:C), got axes {names}")
+        return names[0], names[1]
+    raise ValueError(
+        f"unknown train mode {mode!r} (expected one of {TRAIN_MODES})")
+
+
+def zero_shard_rows(size: int, r: int) -> list[tuple[int, int]]:
+    """The ZeRO ownership map: device i of the r-wide data axis updates
+    weight rows [start, stop). The invariant the TRAIN-003 audit pins:
+    the r intervals are pairwise disjoint and tile [0, size) exactly."""
+    if size % r:
+        raise ValueError(f"size {size} must divide the {r}-wide data axis")
+    chunk = size // r
+    return [(i * chunk, (i + 1) * chunk) for i in range(r)]
+
+
+def train_tolerance(dtype: Any, grad_quant: str | None, dp_axis: str,
+                    world: int) -> float:
+    """Validation tolerance for one train step: the dtype floor, loosened
+    to the quantized-ring bound when the data axis's gradient sync runs a
+    wire format (conservative — wire error enters the weights scaled by
+    the learning rate, so the ring bound is an upper rail)."""
+    from tpu_matmul_bench.parallel.modes import (
+        quantized_tolerance, validation_tolerance)
+
+    base = validation_tolerance(dtype)
+    qt = quantized_tolerance(link_format_spec(grad_quant, dp_axis), world)
+    return max(base, qt) if qt is not None else base
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainStepSetup:
+    """Everything the harness needs for one (mode, mesh, size) train cell."""
+
+    mode: str
+    size: int
+    zero: bool
+    grad_quant: str | None
+    lr: float
+    world: int
+    dp: int                     # data-axis width R (ZeRO shard count)
+    tp: int                     # tensor-axis width C (1 for mode dp)
+    dp_axis: str
+    tp_axis: str | None
+    mesh_spec: str | None       # canonical --mesh spec, None on flat meshes
+    global_batch: int
+    local_batch: int
+    operands: tuple[jax.Array, jax.Array]        # (x, w0)
+    prefixes: "dict[str, Callable]"              # phase → jitted prefix
+    step: Callable                               # full step: (x, w) → w_new
+    reference: Callable                          # dense fp32 one-step ref
+    memory_gib_per_device: float
+
+
+def train_step_programs(mesh: Mesh, mode: str, size: int, *,
+                        batch: int = DEFAULT_BATCH, zero: bool = False,
+                        grad_quant: str | None = None, lr: float = DEFAULT_LR,
+                        impl: str = "xla",
+                        blocks: tuple[int, int, int] | None = None,
+                        ) -> dict[str, Callable]:
+    """The five cumulative-prefix shard_map programs of one train step,
+    keyed by `PHASES`. ``prefixes["allgather"]`` is the full step; its
+    output sharding matches the weight input's, so it iterates:
+    ``w = prefixes["allgather"](x, w)``."""
+    dp_ax, tp_ax = train_axes(mesh, mode)
+    r = mesh.shape[dp_ax]
+    c = mesh.shape[tp_ax] if tp_ax else 1
+    n = size
+    zero_shard_rows(n, r)  # raises unless the data axis tiles the rows
+    if tp_ax and n % c:
+        raise ValueError(f"size {n} must divide the {c}-wide tensor axis")
+    lb = max(batch // r, 1)
+    denom = float(lb * r * n * n)
+    mm = matmul_2d(impl, blocks, mesh_device_kind(mesh))
+    # gradient collectives ride the wire format; fuse_f32 keeps the
+    # dequantized gradient in fp32 through the update so the whole step
+    # performs exactly one downcast (the astype in `updated` below)
+    rs = reduce_scatter_impl(grad_quant, fuse_f32=True)
+    ar = psum_impl(grad_quant, varying_out=True, fuse_f32=True)
+
+    def fwd_local(x, w):  # x: [lb, n, n] batch shard, w: [n, n/c] col shard
+        return jnp.stack([mm(x[i], w) for i in range(x.shape[0])])
+
+    def grads_local(x, w):
+        # backward through the LOCAL forward: the quadratic loss's
+        # cotangent is analytic (dL/dY = Y/denom), so the vjp never
+        # differentiates through a collective and the batch reduction
+        # stays an explicit gradient collective below
+        y, pullback = jax.vjp(lambda wv: fwd_local(x, wv), w)
+        dy = lax.optimization_barrier(y) / denom
+        (dw,) = pullback(dy.astype(y.dtype))
+        return dw  # [n, n/c]: this shard's local-batch contribution
+
+    def grad_sync(dw):
+        g = rs(dw, dp_ax) if zero else ar(dw, dp_ax)
+        return g.astype(jnp.float32)  # no-op (untraced) on the fused wire
+
+    def updated(w, g32):
+        if zero:
+            # the ZeRO ownership invariant: device i updates exactly the
+            # row chunk its reduce_scatter delivered (zero_shard_rows)
+            my = lax.axis_index(dp_ax)
+            own = lax.dynamic_slice_in_dim(w, my * (n // r), n // r, axis=0)
+            new = own.astype(jnp.float32) - lr * g32
+        else:
+            new = w.astype(jnp.float32) - lr * g32
+        return new.astype(w.dtype)  # the step's single downcast
+
+    def p_fwd(x, w):
+        return fwd_local(x, w)
+
+    def p_bwd(x, w):
+        return grads_local(x, w)
+
+    def p_grad(x, w):
+        return grad_sync(grads_local(x, w))
+
+    def p_update(x, w):
+        return updated(w, grad_sync(grads_local(x, w)))
+
+    def p_step(x, w):
+        new = updated(w, grad_sync(grads_local(x, w)))
+        if zero:
+            # reassemble the full weight from the owned shards — updated
+            # PARAMETERS travel exact, only gradients ride the wire format
+            new = lax.all_gather(new, dp_ax, axis=0, tiled=True)
+        return new
+
+    x_spec = P(dp_ax)
+    w_spec = P(None, tp_ax)
+    out_specs = {
+        "fwd": P(dp_ax, None, tp_ax),
+        "bwd": P(dp_ax, tp_ax),
+        "grad_comm": P(dp_ax, tp_ax) if zero else P(None, tp_ax),
+        "update": P(dp_ax, tp_ax) if zero else P(None, tp_ax),
+        "allgather": w_spec,
+    }
+    bodies = {"fwd": p_fwd, "bwd": p_bwd, "grad_comm": p_grad,
+              "update": p_update, "allgather": p_step}
+    return {
+        phase: smap(bodies[phase], mesh, in_specs=(x_spec, w_spec),
+                    out_specs=out_specs[phase], check_vma=False)
+        for phase in PHASES
+    }
+
+
+def make_train_setup(mesh: Mesh, mode: str, size: int, dtype: Any, *,
+                     batch: int = DEFAULT_BATCH, zero: bool = False,
+                     grad_quant: str | None = None, lr: float = DEFAULT_LR,
+                     impl: str = "xla",
+                     blocks: tuple[int, int, int] | None = None,
+                     seed: int = 0) -> TrainStepSetup:
+    """Operands + programs + dense reference for one train cell."""
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        raise ValueError("the train step is a float workload (gradients); "
+                         f"got dtype {jnp.dtype(dtype).name}")
+    dp_ax, tp_ax = train_axes(mesh, mode)
+    r = mesh.shape[dp_ax]
+    c = mesh.shape[tp_ax] if tp_ax else 1
+    lb = max(batch // r, 1)
+    g = lb * r
+    denom = float(g * size * size)
+
+    (x,) = sharded_normal(seed, (g, size, size), dtype, mesh, P(dp_ax),
+                          count=1)
+    (w,) = sharded_normal(seed + 1, (size, size), dtype, mesh,
+                          P(None, tp_ax), count=1)
+    prefixes = train_step_programs(
+        mesh, mode, size, batch=g, zero=zero, grad_quant=grad_quant, lr=lr,
+        impl=impl, blocks=blocks)
+
+    @jax.jit
+    def reference(xx, ww):
+        # the dense fp32 step on the global arrays — no mesh, no wire
+        xf = xx.astype(jnp.float32)
+        wf = ww.astype(jnp.float32)
+        y = jnp.einsum("bik,kj->bij", xf, wf)
+        dw = jnp.einsum("bik,bij->kj", xf, y) / denom
+        return (wf - lr * dw).astype(ww.dtype)
+
+    # per-device: x shard (lb) + w shard (1/c) + forward batch (lb) + dw
+    # (1/c) + the fp32 update temporaries (2/c·r for ZeRO, 2/c otherwise)
+    mem = matrix_memory_gib(size, dtype, count=2 * lb) + \
+        matrix_memory_gib(size, dtype, count=2.0 / c) + \
+        matrix_memory_gib(size, jnp.float32, count=2.0 / c)
+    return TrainStepSetup(
+        mode=mode, size=size, zero=zero, grad_quant=grad_quant, lr=lr,
+        world=r * c, dp=r, tp=c, dp_axis=dp_ax, tp_axis=tp_ax,
+        mesh_spec=mesh_spec_of(mesh), global_batch=g, local_batch=lb,
+        operands=(x, w), prefixes=prefixes, step=prefixes["allgather"],
+        reference=reference, memory_gib_per_device=mem)

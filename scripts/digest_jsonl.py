@@ -78,6 +78,24 @@ def _serve_sublines(r) -> list[str]:
     if not isinstance(s, dict):
         return []
     lines: list[str] = []
+    # pod runs: one row per replica group — per-group goodput/attainment
+    # is the gate surface (a sick group hides in the pod aggregate)
+    pod = s.get("pod")
+    if isinstance(pod, dict):
+        for g in pod.get("groups") or []:
+            if not isinstance(g, dict):
+                continue
+            lines.append(
+                f"      group {g.get('group', '?'):<4} "
+                f"[{g.get('mesh', '?'):<12}] "
+                f"{g.get('requests', 0):>6} done {g.get('shed', 0):>5} shed"
+                f"  goodput={g.get('goodput_qps')}qps "
+                f"p99={g.get('p99_ms')}ms "
+                f"slo={g.get('slo_attainment_pct')}%att")
+        lines.append(
+            f"      pod headline: min-group goodput "
+            f"{pod.get('min_group_goodput_qps')}qps, worst-tenant "
+            f"{pod.get('worst_tenant_attainment_pct')}% attained")
     tenants = s.get("tenants") or {}
     if len(tenants) > 1:
         for tid, row in sorted(tenants.items()):

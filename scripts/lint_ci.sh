@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Twelve layers, cheapest first:
+# Thirteen layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -84,6 +84,15 @@
 #      step and the dense reference to 1e-5 on both mesh families, and
 #      the quantized-wire update-error drift must not shrink when the
 #      scale block coarsens.
+#  13. python -m tpu_matmul_bench serve pod selftest — the pod-scale
+#      serving layer: the POD-00x audit must be clean (replica-group
+#      partitions cover the mesh disjointly, per-group collective
+#      inventories match the comms model at two transposed
+#      factorizations, no cross-group collective), then a seeded pod
+#      run on the virtual CPU mesh must conserve every request across
+#      groups with zero cold compiles, stamp every terminal span with
+#      its replica group, and render group-attributed tail blame via
+#      `serve explain`.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -135,3 +144,7 @@ JAX_PLATFORMS=cpu python -m tpu_matmul_bench serve trace selftest
 echo "== train selftest (train-step audit / ZeRO numerics / drift) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m tpu_matmul_bench train selftest
+
+echo "== serve pod selftest (replica groups / sharded warm start / pod SLO) =="
+JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m tpu_matmul_bench serve pod selftest

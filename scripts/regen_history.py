@@ -51,6 +51,7 @@ POST_ROUND_DIRS = (
     ("measurements/hier",),
     ("measurements/serve_trace",),
     ("measurements/train",),
+    ("measurements/serve_pod",),
 )
 
 

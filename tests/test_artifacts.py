@@ -347,3 +347,36 @@ class TestTwoProcessE2E:
         assert pre2["compile_ms"] == 0.0
         for label, row in s2["buckets"].items():
             assert row["impl_source"] == "artifact", label
+
+
+# ----------------------------------------------- pod placement keying
+
+def test_mesh_spec_distinguishes_artifact_keys():
+    """Two replica groups of identical shape must never share a blob:
+    the placement label joins the key. And the empty label recomputes
+    the pre-pod key byte-identically, so every artifact committed before
+    pod serving stays a hit."""
+    from tpu_matmul_bench.tune.artifacts import artifact_key
+
+    base = ("fp" * 6, "0.4.0", "pd" * 6, "cpu", (4,))
+    g0 = artifact_key(*base, mesh_spec="dcn:2,ici:4/g0=ici:4")
+    g1 = artifact_key(*base, mesh_spec="dcn:2,ici:4/g1=ici:4")
+    plain = artifact_key(*base)
+    assert len({g0, g1, plain}) == 3
+    assert artifact_key(*base, mesh_spec="") == plain
+
+
+def test_meta_carries_mesh_spec_into_key_and_record(store):
+    meta = ArtifactMeta.build(16, 16, 16, "float32", impl="xla",
+                              device_kind="cpu", mesh_shape=(2, 2),
+                              mesh_spec="dcn:2,ici:2/g0=dcn:1,ici:2")
+    other = ArtifactMeta.build(16, 16, 16, "float32", impl="xla",
+                               device_kind="cpu", mesh_shape=(2, 2),
+                               mesh_spec="dcn:2,ici:2/g1=dcn:1,ici:2")
+    assert len({meta.key, other.key, _meta().key}) == 3
+    from tpu_matmul_bench.tune.artifacts import pack_executable
+
+    rec = store.put(meta, pack_executable(_compiled_matmul()))
+    assert rec["mesh_spec"] == meta.mesh_spec
+    assert store.lookup(meta) is not None
+    assert store.lookup(other) is None

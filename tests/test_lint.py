@@ -706,3 +706,58 @@ def test_seeded_oversized_exemplar_limit_flags_trace003(tmp_path):
     findings = _trace_findings(tmp_path)
     assert [f.rule for f in findings] == ["TRACE-003"]
     assert "outside" in findings[0].message
+
+
+# ----------------------------------------------- pod serving (PR 18)
+
+def test_pod_rules_in_catalog():
+    for rule in ("POD-001", "POD-002", "POD-003", "SPEC-010"):
+        assert rule in RULES, rule
+        assert RULES[rule][0] == "error", rule
+
+
+def test_seeded_pod_spec_flags_spec010(tmp_path):
+    """Each way a pod serve job can be statically wrong lands on
+    SPEC-010: groups that don't divide the outer axis, pod flags with
+    no mesh, the fixed scheduler, a capped --num-devices, and a wire
+    format whose block cannot tile a mix bucket's gather payload."""
+    spec = tmp_path / "pod.toml"
+    spec.write_text(
+        '[campaign]\nname = "seeded-pod"\n\n'
+        '[[job]]\nid = "indivisible"\nprogram = "serve"\n'
+        'flags = ["bench", "--mesh", "dcn:3,ici:2",'
+        ' "--replica-groups", "2"]\n\n'
+        '[[job]]\nid = "orphan-groups"\nprogram = "serve"\n'
+        'flags = ["bench", "--replica-groups", "2"]\n\n'
+        '[[job]]\nid = "fixed-sched"\nprogram = "serve"\n'
+        'flags = ["bench", "--mesh", "dcn:2,ici:4",'
+        ' "--replica-groups", "2", "--scheduler", "fixed"]\n\n'
+        '[[job]]\nid = "short-devices"\nprogram = "serve"\n'
+        'flags = ["bench", "--mesh", "dcn:2,ici:4",'
+        ' "--replica-groups", "2", "--num-devices", "4"]\n\n'
+        '[[job]]\nid = "bad-wire"\nprogram = "serve"\n'
+        'flags = ["bench", "--mesh", "dcn:2,ici:4",'
+        ' "--replica-groups", "2", "--mix", "256",'
+        ' "--comm-quant", "dcn=none,ici=fp8-block:96"]\n\n'
+        '[[job]]\nid = "ok-pod"\nprogram = "serve"\n'
+        'flags = ["bench", "--mesh", "dcn:2,ici:4",'
+        ' "--replica-groups", "2", "--mix", "256,512:0.5",'
+        ' "--comm-quant", "dcn=none,ici=fp8-block:32", "--prewarm"]\n')
+    findings = spec_lint.lint_spec_file(spec)
+    by_job = {}
+    for f in findings:
+        by_job.setdefault(f.where.rsplit(":", 1)[-1], []).append(f.rule)
+    assert by_job.pop("indivisible") == ["SPEC-010"]
+    assert by_job.pop("orphan-groups") == ["SPEC-010"]
+    assert by_job.pop("fixed-sched") == ["SPEC-010"]
+    # the capped world trips both the generic mesh/devices rule
+    # (SPEC-008) and the pod-specific one
+    assert sorted(by_job.pop("short-devices")) == ["SPEC-008", "SPEC-010"]
+    assert by_job.pop("bad-wire") == ["SPEC-010"]
+    assert by_job == {}, "clean pod job must not trip anything"
+
+
+def test_pod_audit_clean_on_shipped_tree(devices):
+    from tpu_matmul_bench.analysis.auditor import audit_pod
+
+    assert [f for f in audit_pod() if f.severity == "error"] == []

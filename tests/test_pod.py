@@ -1,0 +1,308 @@
+"""Pod-scale serving: replica-group placement and the pod front-end.
+
+The placement math is pure (no backend), so partition coverage and the
+POD-001 fixtures run anywhere; the PodQueue tests drive real
+ContinuousSchedulers with seeded adversarial mixes to prove the pod
+front conserves every request across groups, spreads by backlog, and
+confines a poisoned group's open breaker to that group.
+"""
+
+import random
+
+import pytest
+
+from tpu_matmul_bench.obs.registry import reset_registry
+from tpu_matmul_bench.serve.placement import (
+    ReplicaGroup,
+    mesh_world,
+    partition_problems,
+    partition_spec,
+)
+from tpu_matmul_bench.serve.pod import PodQueue
+from tpu_matmul_bench.serve.queue import Request, ShapeGrid
+from tpu_matmul_bench.serve.scheduler import ContinuousScheduler
+from tpu_matmul_bench.serve.tenants import TenantSpec
+from tpu_matmul_bench.utils.errors import QueueOverflowError
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    # scheduler counters live on the process-global obs registry; each
+    # test gets a clean bus so counts don't bleed across instances
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def _req(rid, tenant="default", m=128, k=128, n=128, dtype="float32"):
+    return Request(rid=rid, m=m, k=k, n=n, dtype=dtype, tenant=tenant)
+
+
+def _pod(groups=2, tenants=None, **kw):
+    parts = partition_spec("dcn:2,ici:4", groups)
+    if tenants is not None:
+        kw["tenants"] = tenants
+    scheds = [ContinuousScheduler(ShapeGrid(), **kw) for _ in parts]
+    return PodQueue(ShapeGrid(), parts, scheds)
+
+
+def _drain_all(q):
+    q.close()
+    batches = []
+    for gi, sched in enumerate(q.scheds):
+        while True:
+            b = sched.take_batch()
+            if b is None:
+                break
+            batches.append((gi, b))
+    return batches
+
+
+# ------------------------------------------------------------- placement
+
+
+def test_partition_covers_transposed_factorizations():
+    """The POD-001 shape at both committed factorizations: groups split
+    the OUTER axis, keep the inner axis whole, and tile the flat device
+    order contiguously."""
+    wide = partition_spec("dcn:2,ici:4", 2)
+    assert [g.mesh_spec for g in wide] == ["ici:4", "ici:4"]
+    assert [g.device_indices for g in wide] == [(0, 1, 2, 3), (4, 5, 6, 7)]
+
+    tall = partition_spec("dcn:4,ici:2", 2)
+    assert [g.mesh_spec for g in tall] == ["dcn:2,ici:2", "dcn:2,ici:2"]
+    assert [g.device_indices for g in tall] == [(0, 1, 2, 3), (4, 5, 6, 7)]
+
+    # placement labels are parent-unique: they key caches and artifacts
+    labels = {g.placement for g in wide} | {g.placement for g in tall}
+    assert len(labels) == 4
+    assert wide[0].placement == "dcn:2,ici:4/g0=ici:4"
+
+    for parts in (wide, tall):
+        assert partition_problems(parts, 8) == []
+
+
+def test_partition_flat_and_degenerate_specs():
+    flat = partition_spec("ici:8", 4)
+    assert [g.mesh_spec for g in flat] == ["ici:2"] * 4
+    assert partition_problems(flat, 8) == []
+    one = partition_spec("dcn:2,ici:4", 1)
+    assert one[0].mesh_spec == "dcn:2,ici:4"
+    assert one[0].world == mesh_world("dcn:2,ici:4") == 8
+
+
+def test_partition_spec_refuses_bad_inputs():
+    with pytest.raises(ValueError, match="must divide"):
+        partition_spec("dcn:2,ici:4", 3)
+    with pytest.raises(ValueError, match="positive"):
+        partition_spec("dcn:2,ici:4", 0)
+    with pytest.raises(ValueError, match="dcn before ici"):
+        partition_spec("ici:4,dcn:2", 2)
+    with pytest.raises(ValueError, match="duplicate"):
+        partition_spec("dcn:2,dcn:2", 2)
+    with pytest.raises(ValueError, match="link class"):
+        partition_spec("pcie:8", 2)
+
+
+def test_partition_problems_fixture_partitions_trip_pod001():
+    """Seeded bad partitions must be *detected*, not merely avoided:
+    overlap, gap, out-of-world claim, and empty group each produce a
+    distinct problem string (what the POD-001 audit reports)."""
+    def grp(i, devs):
+        return ReplicaGroup(index=i, parent_spec="dcn:2,ici:4",
+                            mesh_spec="ici:4", device_indices=devs)
+
+    overlap = [grp(0, (0, 1, 2, 3)), grp(1, (3, 4, 5, 6, 7))]
+    assert any("not disjoint" in p for p in partition_problems(overlap, 8))
+    gap = [grp(0, (0, 1, 2)), grp(1, (4, 5, 6, 7))]
+    assert any("no replica group" in p for p in partition_problems(gap, 8))
+    outside = [grp(0, (0, 1, 2, 3)), grp(1, (4, 5, 6, 8))]
+    assert any("outside" in p for p in partition_problems(outside, 8))
+    empty = [grp(0, tuple(range(8))), grp(1, ())]
+    assert any("owns no devices" in p for p in partition_problems(empty, 8))
+
+
+def test_pod_collective_scope_fixture_trips_pod003(devices):
+    """A group program that gathers over an axis its own mesh does not
+    define is cross-group traffic by construction; the scope check must
+    flag it on the traced jaxpr."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tpu_matmul_bench.analysis import jaxpr_tools as jt
+    from tpu_matmul_bench.serve.pod import pod_collective_scope_problems
+
+    mesh = Mesh(np.array(devices[:4]).reshape(4), ("ici",))
+
+    def leaky(x):
+        from tpu_matmul_bench.parallel.mesh import shard_map_compat
+
+        def body(a):
+            return jax.lax.all_gather(a, "ici", axis=0, tiled=True)
+
+        return shard_map_compat(body, mesh=mesh, in_specs=P("ici"),
+                                out_specs=P(), check_vma=False)(x)
+
+    jaxpr = jax.make_jaxpr(leaky)(jnp.ones((8,), jnp.float32))
+    inv = jt.collective_inventory(jaxpr)
+    assert inv, "fixture must actually trace a collective"
+    assert pod_collective_scope_problems(jaxpr, allowed_axes=set()) != []
+    assert pod_collective_scope_problems(jaxpr, allowed_axes={"ici"}) == []
+
+
+# -------------------------------------------------------------- pod queue
+
+
+def test_pod_queue_conserves_adversarial_seeded_mix():
+    """Every submission attempt ends exactly one way across the WHOLE
+    pod: dispatched by some group or shed by some group — per tenant,
+    with the PodQueue.stats() aggregation matching the per-group sum."""
+    tenants = (TenantSpec("a", weight=4.0, priority=0),
+               TenantSpec("b", weight=2.0, priority=1, slo_ms=50.0),
+               TenantSpec("c", weight=1.0, priority=1))
+    q = _pod(groups=2, tenants=tenants, max_depth=16, max_batch=4)
+    for s in q.scheds:
+        s.note_service(0.01, 1)  # live estimate for SLO shedding
+    rng = random.Random(7)
+    shapes = [(128, 128, 128), (128, 128, 256), (256, 128, 128),
+              (256, 256, 256)]
+    attempts = {"a": 0, "b": 0, "c": 0}
+    batches = []
+    for rid in range(400):
+        tid = rng.choice("abc")
+        m, k, n = rng.choice(shapes)
+        attempts[tid] += 1
+        try:
+            q.submit(_req(rid, tid, m=m, k=k, n=n))
+        except QueueOverflowError:
+            pass
+        if rng.random() < 0.3:
+            gi = rng.randrange(2)
+            b = q.scheds[gi].take_batch()
+            if b:
+                batches.append((gi, b))
+    batches.extend(_drain_all(q))
+    assert q.depth == 0
+    stats = q.stats()
+    dispatched = {"a": 0, "b": 0, "c": 0}
+    for gi, batch in batches:
+        assert 1 <= len(batch) <= 4
+        assert len({(r.bucket, r.dtype) for r in batch}) == 1
+        for r in batch:
+            # the group stamp set at placement matches the scheduler
+            # that actually dispatched the request
+            assert r.group == gi
+            dispatched[r.tenant] += 1
+    for tid in attempts:
+        assert dispatched[tid] + stats["tenants"][tid]["shed"] \
+            == attempts[tid], tid
+    assert sum(dispatched.values()) + stats["shed"] == 400
+    assert q.offered == 400
+    assert stats["scheduler"] == "pod"
+    assert stats["replica_groups"] == 2
+    # per-group rows sum to the pod aggregate
+    per = stats["groups"]
+    assert sum(per[g]["submitted"] for g in per) == stats["submitted"]
+    assert sum(per[g]["shed"] for g in per) == stats["shed"]
+    # both groups actually took traffic (least-backlog placement)
+    assert all(per[g]["submitted"] > 0 for g in per)
+
+
+def test_pod_queue_spreads_by_backlog():
+    """With no draining, equal requests alternate across equal groups —
+    depth ties break to the lowest index, then the deeper group loses."""
+    q = _pod(groups=2, max_depth=64)
+    placements = [q.submit(_req(rid)).group for rid in range(8)]
+    assert placements == [0, 1, 0, 1, 0, 1, 0, 1]
+    assert q.scheds[0].depth == q.scheds[1].depth == 4
+
+
+def test_pod_breaker_isolation_diverts_never_sheds():
+    """One poisoned group's open breaker must divert the other groups'
+    traffic, not shed it: submits route to the healthy group, and the
+    pod-level breaker view opens only when EVERY group is open."""
+    q = _pod(groups=2, max_depth=64, breaker_threshold=3)
+    bucket = ShapeGrid().bucket(128, 128, 128)
+    for _ in range(3):  # trip g0's breaker for this bucket
+        q.scheds[0].note_result(bucket, "float32", ok=False)
+    assert q.scheds[0].breaker_open(bucket, "float32")
+    assert not q.breaker_open(bucket, "float32")  # g1 still serves
+    before_shed = q.shed
+    for rid in range(6):
+        assert q.submit(_req(rid)).group == 1
+    assert q.shed == before_shed  # diverted, not shed
+    assert q.scheds[1].depth == 6 and q.scheds[0].depth == 0
+    # a different bucket still lands on g0 once depths say so: the
+    # breaker is per-(bucket, dtype), not per-group quarantine
+    assert q.submit(_req(100, m=512, k=512, n=512)).group == 0
+
+    # when EVERY group is open the pod view opens and the delegated
+    # scheduler sheds with its normal single terminal (no retry loop)
+    for _ in range(3):
+        q.scheds[1].note_result(bucket, "float32", ok=False)
+    assert q.breaker_open(bucket, "float32")
+    with pytest.raises(QueueOverflowError):
+        q.submit(_req(101))
+    assert q.shed == before_shed + 1  # exactly one shed, one terminal
+
+
+def test_pod_queue_refuses_mismatched_groups():
+    parts = partition_spec("dcn:2,ici:4", 2)
+    with pytest.raises(ValueError):
+        PodQueue(ShapeGrid(), parts, [ContinuousScheduler(ShapeGrid())])
+    with pytest.raises(ValueError):
+        PodQueue(ShapeGrid(), (), [])
+
+
+# ------------------------------------------------------------ history pod
+
+
+def test_history_pod_points_from_pod_record(tmp_path):
+    """A pod serve ledger yields the gate series ISSUE 18 promises: one
+    higher-better goodput point per replica group plus the worst-tenant
+    attainment headline, none of them classified lower-better."""
+    import json
+
+    from tpu_matmul_bench.obs.history import (
+        LOWER_BETTER_METRICS,
+        _ledger_points,
+    )
+
+    rec = {
+        "benchmark": "serve", "dtype": "float32", "world": 8,
+        "device_kind": "cpu",
+        "extras": {"serve": {
+            "scheduler": "pod", "load_mode": "open", "p99_ms": 12.0,
+            "requests": 40, "goodput_qps": 50.0,
+            "pod": {
+                "groups": [
+                    {"group": "g0", "placement": "dcn:2,ici:4/g0=ici:4",
+                     "requests": 22, "shed": 0, "goodput_qps": 26.0,
+                     "slo_attainment_pct": 100.0, "p99_ms": 11.0},
+                    {"group": "g1", "placement": "dcn:2,ici:4/g1=ici:4",
+                     "requests": 18, "shed": 1, "goodput_qps": 24.0,
+                     "slo_attainment_pct": 95.0, "p99_ms": 13.0},
+                ],
+                "min_group_goodput_qps": 24.0,
+                "worst_tenant_attainment_pct": 95.0,
+            },
+        }},
+    }
+    path = tmp_path / "pod.jsonl"
+    path.write_text(json.dumps(rec) + "\n")
+    points = _ledger_points(path, "test", "c" * 12, None)
+    by_metric = {}
+    for p in points:
+        by_metric.setdefault(p["metric"], []).append(p)
+    goodputs = sorted(p["labels"]["group"]
+                      for p in by_metric["group_goodput_qps"])
+    assert goodputs == ["g0", "g1"]
+    assert {p["value"] for p in by_metric["group_goodput_qps"]} \
+        == {26.0, 24.0}
+    (attain,) = by_metric["min_attainment_pct"]
+    assert attain["value"] == 95.0
+    assert attain["detail"]["min_group_goodput_qps"] == 24.0
+    assert "group_goodput_qps" not in LOWER_BETTER_METRICS
+    assert "min_attainment_pct" not in LOWER_BETTER_METRICS

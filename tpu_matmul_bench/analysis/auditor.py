@@ -900,6 +900,17 @@ def audit_trace() -> list[Finding]:
     return trace_findings()
 
 
+def audit_pod() -> list[Finding]:
+    """POD-001/002/003: replica-group partitions cover the pod mesh
+    disjointly, each group program's traced collective inventory matches
+    the comms model at transposed factorizations, and no group program
+    names an axis outside its own mesh (serve/pod.py owns the scan; this
+    is the lint wiring)."""
+    from tpu_matmul_bench.serve.pod import pod_findings
+
+    return pod_findings()
+
+
 # ---------------------------------------------------------------------------
 # COLL-H-*: the hierarchical (DCN×ICI) mesh contract (PR 15)
 # ---------------------------------------------------------------------------
@@ -1232,6 +1243,7 @@ AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "fingerprint": _audit_fingerprint,
     "faults": audit_faults,
     "trace": audit_trace,
+    "pod": audit_pod,
 }
 
 #: groups that compile optimized HLO (slower than trace-only audits);

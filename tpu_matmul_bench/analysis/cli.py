@@ -58,9 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "under the repo root)")
     parser.add_argument("--skip", nargs="*", default=(),
                         choices=("modes", "impls", "donation", "pallas",
-                                 "registry", "tune", "obs", "comm_quant",
-                                 "hier", "train", "specs", "sched", "memory",
-                                 "fingerprint", "faults"),
+                                 "registry", "tune", "artifacts", "obs",
+                                 "comm_quant", "hier", "train", "specs",
+                                 "sched", "memory", "fingerprint", "faults",
+                                 "trace", "pod"),
                         help="audit groups to skip")
     parser.add_argument("--no-hlo", action="store_true",
                         help="skip the HLO pass family (sched + memory + "

@@ -384,6 +384,65 @@ def hier_expected_collectives(
     return out
 
 
+def pod_axis_collectives(
+        mesh_spec: str, m: int, k: int, n: int,
+) -> list[tuple[str, str, int, tuple[int, ...]]]:
+    """The float collectives of one replica group's serving executable
+    (serve/pod.py) as ``(kind, axis_name, axis_size,
+    per_device_operand_shape)``: the group computes an exact
+    C[m,n] = A·B with A row-sharded over the outer axis and B
+    column-sharded over the inner axis, then reassembles the replicated
+    output with one tiled all_gather per mesh axis, inner first —
+    columns within an ICI group, rows across the group's remaining DCN
+    extent. Shapes are the gather *inputs* (per-device shards), the
+    convention `jaxpr_tools.collective_inventory` measures."""
+    from tpu_matmul_bench.parallel.mesh import parse_mesh_spec
+
+    axes = parse_mesh_spec(mesh_spec)
+    if len(axes) == 2:
+        (o_name, o), (i_name, i) = axes
+        if m % o or n % i:
+            raise ValueError(
+                f"pod group over {mesh_spec!r} needs {o} | m={m} and "
+                f"{i} | n={n}")
+        return [
+            ("all_gather", i_name, i, (m // o, n // i)),
+            ("all_gather", o_name, o, (m // o, n)),
+        ]
+    (name, d), = axes
+    if n % d:
+        raise ValueError(
+            f"pod group over {mesh_spec!r} needs {d} | n={n}")
+    return [("all_gather", name, d, (m, n // d))]
+
+
+def pod_expected_collectives(
+        mesh_spec: str, m: int, k: int, n: int, dtype,
+        comm_quant=None) -> list[tuple[str, str, int]]:
+    """Expected per-axis collective inventory of one replica group's
+    bucket executable as ``(kind, axis_name, payload_bytes)`` — what the
+    POD-002 rule diffs traced group programs against, and what SPEC-010
+    dry-runs over a pod job's mix. Same wire-format resolution door as
+    `hier_expected_collectives`: each axis's gathers are rewritten under
+    the format its link class resolves to."""
+    from tpu_matmul_bench.parallel.collectives import (
+        link_format_spec, parse_wire_format)
+
+    item = matmul_out_itemsize(dtype)
+    integer = np.issubdtype(np.dtype(dtype), np.integer)
+    out: list[tuple[str, str, int]] = []
+    for kind, name, axis, shape in pod_axis_collectives(mesh_spec, m, k, n):
+        fmt = None if integer else parse_wire_format(
+            link_format_spec(comm_quant, name))
+        if fmt is None:
+            out.append((kind, name, int(np.prod(shape)) * item))
+        else:
+            for kk, _, payload, _ in _one_wire_entries(
+                    kind, axis, shape, fmt, where=f"pod/{name}"):
+                out.append((kk, name, payload))
+    return out
+
+
 def hier_wire_bytes_summary(mode: str, mesh_spec: str, size: int, dtype,
                             comm_quant, batch: int = 4) -> dict:
     """Static per-link-class wire-byte prices for one (mode, mesh, size,

@@ -251,6 +251,30 @@ RULES: dict[str, tuple[Severity, str]] = {
                           "--steps < 2 when a drift series is measured, "
                           "or a (mode, mesh) pair the collective model "
                           "rejects"),
+    "POD-001": ("error", "replica-group partition does not cover the mesh "
+                         "disjointly: a device belongs to zero or to more "
+                         "than one group, or a group claims a device "
+                         "outside the world — pod placement would route "
+                         "traffic onto devices nobody (or everybody) "
+                         "owns"),
+    "POD-002": ("error", "per-group collective inventory mismatch: a "
+                         "traced group executable's (kind, axis, payload) "
+                         "multiset differs from the pod comms model "
+                         "(comms_model.pod_expected_collectives) at a "
+                         "tested factorization — the sharded serving "
+                         "program gathers the wrong way or sizes a shard "
+                         "wrong"),
+    "POD-003": ("error", "cross-group collective: a dispatched group "
+                         "program carries a collective over an axis "
+                         "outside its own group mesh — one replica "
+                         "group's request would synchronize with another "
+                         "group's devices, destroying replica isolation"),
+    "SPEC-010": ("error", "invalid pod flag in a serve spec's job flags: "
+                          "--replica-groups not a positive integer "
+                          "dividing the outer axis of --mesh, pod flags "
+                          "with no factorized --mesh, --mesh not covering "
+                          "--num-devices, or a per-link --comm-quant the "
+                          "pod collective model rejects"),
 }
 
 

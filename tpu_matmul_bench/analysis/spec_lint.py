@@ -41,6 +41,8 @@ _SERVE_COMMON_FLAGS = {
     "--max-batch", "--cache-capacity", "--matmul-impl", "--seed",
     "--device", "--num-devices", "--json-out", "--append", "--trace-out",
     "--obs-dir", "--artifacts",
+    # pod serving (serve/pod.py); their joint validity is SPEC-010's
+    "--mesh", "--replica-groups", "--comm-quant",
 }
 _SERVE_BENCH_FLAGS = {"--qps", "--duration", "--concurrency", "--prewarm",
                       "--explore", "--explore-db"}
@@ -594,6 +596,121 @@ def _hier_findings(job: Any, label: str) -> list[Finding]:
     return findings
 
 
+def _pod_findings(job: Any, label: str) -> list[Finding]:
+    """SPEC-010 for one serve job: the pod serving flag family.
+
+    --replica-groups must be a positive count that divides the outer
+    axis of every --mesh factorization (serve/placement.py's partition
+    rule — a group spanning a fractional DCN row is cross-group traffic
+    by construction); pod flags without --mesh have no pod to shape;
+    --num-devices must cover the mesh world; --scheduler fixed cannot
+    place (the pod arm requires the continuous scheduler); and every
+    per-link --comm-quant must dry-run the pod collective model over
+    the job's mix buckets so wire-format divisibility errors surface at
+    lint time, not mid-campaign."""
+    import numpy as np
+
+    from tpu_matmul_bench.serve.placement import mesh_world, partition_spec
+
+    argv = list(job.argv)
+    findings: list[Finding] = []
+    group_toks = _flag_values(argv, "--replica-groups")
+    meshes = _raw_flag_values(argv, "--mesh")
+    if not meshes:
+        if group_toks:
+            findings.append(Finding(
+                "SPEC-010", label,
+                "--replica-groups without --mesh — there is no pod "
+                "to partition",
+                details={"replica_groups": group_toks}))
+        return findings
+
+    if "fixed" in _flag_values(argv, "--scheduler"):
+        findings.append(Finding(
+            "SPEC-010", label,
+            "--mesh with --scheduler fixed: pod placement requires the "
+            "continuous scheduler (per-group breakers and SLO state)",
+            details={}))
+
+    group_counts: list[int] = []
+    for tok in group_toks:
+        if not tok.isdigit() or int(tok) < 1:
+            findings.append(Finding(
+                "SPEC-010", label,
+                f"--replica-groups must be a positive count, got {tok!r}",
+                details={"replica_groups": tok}))
+        else:
+            group_counts.append(int(tok))
+
+    devs = [int(x) for x in _flag_values(argv, "--num-devices")
+            if x.isdigit()]
+    per_link = [q for q in _comm_quant_values(argv) if "=" in q]
+    dtypes = _flag_values(argv, "--dtype") or ["float32"]
+    buckets = _serve_mix_buckets(argv)
+    for m in meshes:
+        try:
+            world = mesh_world(m)
+        except ValueError:
+            continue  # grammar errors are SPEC-008's to report
+        for d in devs:
+            if d < world:
+                findings.append(Finding(
+                    "SPEC-010", label,
+                    f"--mesh {m} spans {world} devices but the job caps "
+                    f"--num-devices {d}",
+                    details={"mesh": m, "num_devices": d}))
+        for g in group_counts or [1]:
+            try:
+                parts = partition_spec(m, g)
+            except ValueError as e:
+                findings.append(Finding(
+                    "SPEC-010", label, str(e),
+                    details={"mesh": m, "replica_groups": g}))
+                continue
+            if all(dt.startswith(("int", "uint")) for dt in dtypes):
+                continue  # integer requests keep the exact collective
+            # dry-run the pod collective model per group shape: a block
+            # format that cannot tile a bucket's gather payload dies
+            # here, not an hour into the campaign
+            for q in per_link:
+                for bm, bk, bn in buckets:
+                    try:
+                        from tpu_matmul_bench.analysis.comms_model import (
+                            pod_expected_collectives,
+                        )
+
+                        pod_expected_collectives(
+                            parts[0].mesh_spec, bm, bk, bn,
+                            np.float32, q)
+                    except ValueError as e:
+                        findings.append(Finding(
+                            "SPEC-010", label,
+                            f"--comm-quant {q} cannot serve bucket "
+                            f"{bm}x{bk}x{bn} on a {parts[0].mesh_spec} "
+                            f"group of --mesh {m}: {e}",
+                            details={"comm_quant": q, "mesh": m,
+                                     "replica_groups": g,
+                                     "bucket": [bm, bk, bn]}))
+    return findings
+
+
+def _serve_mix_buckets(argv: list[str]) -> list[tuple[int, int, int]]:
+    """The padded buckets a serve job's --mix lands on (its --grid or
+    the default), deduplicated — what the pod wire model must price."""
+    from tpu_matmul_bench.serve.loadgen import DEFAULT_MIX, parse_mix
+    from tpu_matmul_bench.serve.queue import ShapeGrid
+
+    mixes = _raw_flag_values(argv, "--mix") or [DEFAULT_MIX]
+    grid_toks = [int(t) for t in _flag_values(argv, "--grid")
+                 if t.isdigit()]
+    try:
+        grid = ShapeGrid(grid_toks) if grid_toks else ShapeGrid()
+        entries = [e for mx in mixes for e in parse_mix(mx)]
+    except ValueError:
+        return []  # the mix/grid error is SPEC-001's to report
+    return sorted({grid.bucket(e.m, e.k, e.n) for e in entries})
+
+
 def _lint_train_job(job: Any, label: str) -> list[Finding]:
     """SPEC-009 for one train job: subcommand, the --grad-quant grammar
     (minus the legacy control tier, which has no reduce_scatter half),
@@ -828,6 +945,12 @@ def lint_spec_file(path: str | Path) -> list[Finding]:
     # fail-at-lint-not-mid-campaign contract
     for job in spec.jobs:
         findings.extend(_hier_findings(job, f"{where}:{job.job_id}"))
+
+    # SPEC-010: pod serving jobs — replica-group divisibility against
+    # the mesh factorization + per-group wire formats over the mix
+    for job in spec.jobs:
+        if job.program == "serve":
+            findings.extend(_pod_findings(job, f"{where}:{job.job_id}"))
 
     # mesh divisibility: sharding modes need size % num_devices == 0
     for job in spec.jobs:

@@ -63,7 +63,11 @@ class ExecKey:
     `impl` is the matmul implementation / serving mode the builder
     resolves ("xla", "pallas", "auto"); `mesh_shape` the device mesh the
     program was compiled for — the same program text compiled for a
-    different mesh is a different executable.
+    different mesh is a different executable. `mesh_spec` is the pod
+    placement label (serve/placement.py) for mesh-sharded executables:
+    a deserialized AOT program binds to the concrete devices it was
+    compiled for, so two replica groups of identical shape still key
+    distinct executables. Empty for the single-device serve path.
     """
 
     m: int
@@ -72,6 +76,7 @@ class ExecKey:
     dtype: str
     impl: str
     mesh_shape: tuple[int, ...] = (1,)
+    mesh_spec: str = ""
 
     @property
     def label(self) -> str:

@@ -1,4 +1,4 @@
-"""`python -m tpu_matmul_bench serve {bench,selftest}`.
+"""`python -m tpu_matmul_bench serve {bench,ab,selftest,explain,trace,pod}`.
 
 `bench` runs one load window — open loop (Poisson at `--qps`, the
 default) or closed loop (`--concurrency N`) — over a declarative
@@ -28,6 +28,16 @@ ledger reading — works on machines without jax.
 `trace selftest` certifies the recorder end to end (lint_ci.sh layer
 11): static span-coverage audit (TRACE-001/002/003), a seeded
 in-process run whose span records reconcile, and the exemplar bound.
+
+`--mesh dcn:R,ici:C --replica-groups G` lifts bench/ab to pod scale
+(serve/pod.py): G data-parallel replica groups over the factorized
+mesh, mesh-sharded bucket executables keyed by each group's placement
+label, and the pod SLO block (per-group goodput + worst-tenant
+attainment) in the ledger. `pod selftest` is its CI hook (lint_ci.sh
+layer 13): the POD-001/002/003 audit plus a seeded end-to-end pod
+window on the virtual CPU mesh. The serve CLI forces
+`--xla_force_host_platform_device_count` itself when the mesh needs
+more devices than the host exposes.
 
 Both bench and ab are campaign-able: the executor appends
 `--json-out <ledger>` after the subcommand's flags, so a `[[job]]
@@ -118,6 +128,20 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "compiling, and exports what it had to compile "
                         "(bare flag = the committed "
                         "measurements/artifacts store)")
+    p.add_argument("--mesh", default=None, metavar="SPEC",
+                   help="pod serving: a dcn:R,ici:C factorized mesh spec "
+                        "(parallel/mesh.py grammar) routes bench/ab "
+                        "through replica-group placement over "
+                        "mesh-sharded executables (serve/pod.py)")
+    p.add_argument("--replica-groups", type=int, default=1,
+                   dest="replica_groups", metavar="G",
+                   help="how many data-parallel replica groups to split "
+                        "the pod mesh's outer axis into (must divide it; "
+                        "default %(default)s)")
+    p.add_argument("--comm-quant", default=None, metavar="SPEC",
+                   help="per-link collective wire formats for the sharded "
+                        "group programs, e.g. 'dcn=fp8-block:32,ici=none' "
+                        "(default: exact)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -188,6 +212,15 @@ def build_parser() -> argparse.ArgumentParser:
         "selftest", help="span-coverage audit + seeded-run reconciliation "
                          "+ exemplar bound (CI hook, lint_ci layer 11)")
     _add_common(tselftest)
+
+    pod = sub.add_parser(
+        "pod", help="pod-scale replica-group serving tooling")
+    psub = pod.add_subparsers(dest="pod_command", required=True)
+    pselftest = psub.add_parser(
+        "selftest", help="POD-001..003 static audit + seeded pod run with "
+                         "warm-start, conservation, and group-attribution "
+                         "checks (CI hook, lint_ci layer 13)")
+    _add_common(pselftest)
     return p
 
 
@@ -227,9 +260,26 @@ def _config_from(args: argparse.Namespace):
         obs_dir=args.obs_dir,
         obs_exemplars=args.obs_exemplars,
         artifacts=args.artifacts,
+        mesh=args.mesh,
+        replica_groups=args.replica_groups,
+        comm_quant=args.comm_quant,
     )
     if args.cache_capacity is not None:
         kwargs["cache_capacity"] = args.cache_capacity
+    # pod flags are validated before any backend import: the partition
+    # grammar + divisibility rules are pure (serve/placement.py), so a
+    # bad spec dies in µs instead of after jax init
+    if args.mesh is not None:
+        from tpu_matmul_bench.serve.placement import partition_spec
+
+        try:
+            partition_spec(args.mesh, args.replica_groups)
+        except ValueError as e:
+            raise SystemExit(f"serve: {e}")
+    elif args.replica_groups != 1:
+        raise SystemExit(
+            "serve: --replica-groups needs --mesh (there is no pod to "
+            "partition)")
     if args.command in ("bench", "ab"):
         if not 0.0 <= args.explore <= 1.0:
             raise SystemExit(f"serve: --explore must be in [0, 1], "
@@ -238,6 +288,26 @@ def _config_from(args: argparse.Namespace):
                       concurrency=args.concurrency, prewarm=args.prewarm,
                       explore=args.explore, explore_db=args.explore_db)
     return ServeConfig(**kwargs)
+
+
+def _force_host_devices(mesh_spec: str) -> None:
+    """Before the first jax import: make sure the host (CPU) platform
+    exposes enough virtual devices for the pod mesh — the door that
+    lets the whole pod layer run, and be CI-certified, on one machine.
+    A user-provided count is respected; real accelerator backends are
+    unaffected (the flag only shapes the host platform). Importing jax
+    is fine — XLA_FLAGS is read at backend *init* (the first devices()
+    call), which nothing on the CLI import path triggers."""
+    import os
+
+    from tpu_matmul_bench.serve.placement import mesh_world
+
+    needed = mesh_world(mesh_spec)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if needed > 1 and "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={needed}"
+        ).strip()
 
 
 def main(argv: Sequence[str] | None = None):
@@ -251,6 +321,12 @@ def main(argv: Sequence[str] | None = None):
         if rc:
             raise SystemExit(rc)
         return None
+    if args.command == "pod" and args.mesh is None:
+        args.mesh = "dcn:2,ici:4"  # the selftest's certified default
+        if args.replica_groups == 1:
+            args.replica_groups = 2
+    if args.mesh is not None:
+        _force_host_devices(args.mesh)
     from tpu_matmul_bench.serve.service import (
         run_ab,
         run_bench,
@@ -264,6 +340,10 @@ def main(argv: Sequence[str] | None = None):
         config.tenant_specs  # ... and the tenant definitions
     except ValueError as e:
         raise SystemExit(f"serve: {e}")
+    if args.command == "pod":
+        from tpu_matmul_bench.serve.pod import run_pod_selftest
+
+        return run_pod_selftest(config)
     if args.command == "trace":
         return run_trace_selftest(config)
     if args.command == "selftest":

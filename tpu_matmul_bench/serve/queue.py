@@ -63,6 +63,7 @@ class Request:
     tenant: str = "default"  # traffic class (serve/tenants.py)
     dispatched_at: float = 0.0  # wall clock when its batch was taken
     trace: str = ""  # flight-recorder id, parented under the run context
+    group: int | None = None  # replica group that served it (serve/pod.py)
 
 
 class ShapeGrid:

@@ -124,6 +124,14 @@ class ServeConfig:
     # serialized-executable store root (tune/artifacts.py); None = no
     # store — warm_start compiles as before
     artifacts: str | None = None
+    # pod-scale serving (serve/pod.py): a `dcn:R,ici:C` factorized mesh
+    # spec routes bench/ab through the replica-group arm; None = the
+    # single-device paths below, byte-identical to before
+    mesh: str | None = None
+    replica_groups: int = 1
+    # per-link collective wire formats for the sharded group programs
+    # (parallel/collectives.py grammar, e.g. "dcn=fp8-block:32,ici=none")
+    comm_quant: str | None = None
 
     @property
     def mix_entries(self) -> tuple[MixEntry, ...]:
@@ -244,6 +252,7 @@ def _worker_drain(
     *,
     impl: str,
     mesh_shape: tuple[int, ...],
+    mesh_spec: str = "",
     on_complete=None,
     stream: JsonWriter | None = None,
     explorer=None,
@@ -274,7 +283,7 @@ def _worker_drain(
         batch_seq += 1
         m, k, n = batch[0].bucket
         key = ExecKey(m=m, k=k, n=n, dtype=batch[0].dtype, impl=impl,
-                      mesh_shape=mesh_shape)
+                      mesh_shape=mesh_shape, mesh_spec=mesh_spec)
         a, b = pool.get(key)
         hist = latency_hists.get(key.label)
         if hist is None:
@@ -479,7 +488,7 @@ def _tenant_rows(
     """Per-tenant ledger rows + the total count of SLO-attaining
     completions (the goodput numerator; no-SLO tenants attain by
     definition — every completion is good work)."""
-    if qstats.get("scheduler") == "continuous":
+    if qstats.get("scheduler") in ("continuous", "pod"):
         shed_by = {tid: t["shed"]
                    for tid, t in qstats.get("tenants", {}).items()}
     else:
@@ -957,8 +966,57 @@ def _explore_block(config: ServeConfig, explorer) -> dict[str, Any] | None:
     return block
 
 
+def _ab_verdict(base: dict[str, Any], cand: dict[str, Any],
+                base_name: str, cand_name: str) -> dict[str, Any]:
+    """The noise-aware A/B verdict block: candidate vs baseline on p99
+    and goodput, tolerance widened by both arms' within-run p99 noise
+    (campaign/gate.py discipline). Key names embed the arm names, so
+    the fixed-vs-continuous ledger contract stays byte-identical while
+    the pod arm reuses the block unchanged under its own names."""
+    from tpu_matmul_bench.campaign.gate import tolerance_pct
+
+    tol = tolerance_pct(0.0,
+                        {"noise_pct": base["p99_noise_pct"]},
+                        {"noise_pct": cand["p99_noise_pct"]})
+    base_p99 = base["p99_ms"] or 1e-9
+    p99_delta = 100.0 * (cand["p99_ms"] - base_p99) / base_p99
+    base_good = base["goodput_qps"] or 1e-9
+    good_delta = 100.0 * (cand["goodput_qps"] - base_good) / base_good
+    verdict = {
+        "baseline": base_name,
+        "candidate": cand_name,
+        f"p99_{base_name}_ms": base["p99_ms"],
+        f"p99_{cand_name}_ms": cand["p99_ms"],
+        "p99_delta_pct": round(p99_delta, 2),
+        f"goodput_{base_name}_qps": base["goodput_qps"],
+        f"goodput_{cand_name}_qps": cand["goodput_qps"],
+        "goodput_delta_pct": round(good_delta, 2),
+        f"slo_attainment_{base_name}_pct": base["slo_attainment_pct"],
+        f"slo_attainment_{cand_name}_pct": cand["slo_attainment_pct"],
+        "tolerance_pct": tol,
+        "regressed": p99_delta > tol or good_delta < -tol,
+    }
+    report(
+        f"\nA/B verdict ({base_name} → {cand_name}):",
+        f"  - p99: {base['p99_ms']} → {cand['p99_ms']} ms "
+        f"({p99_delta:+.1f}%)",
+        f"  - goodput: {base['goodput_qps']} → "
+        f"{cand['goodput_qps']} QPS ({good_delta:+.1f}%)",
+        f"  - SLO attainment: {base['slo_attainment_pct']} → "
+        f"{cand['slo_attainment_pct']} %",
+        f"  - tolerance ±{tol}% (noise-aware) → "
+        + ("REGRESSED" if verdict["regressed"] else "ok"),
+    )
+    return verdict
+
+
 def run_bench(config: ServeConfig) -> list[BenchmarkRecord]:
-    """The `serve bench` program: one load run → one ledger."""
+    """The `serve bench` program: one load run → one ledger. A config
+    carrying a pod mesh routes to the replica-group arm."""
+    if config.mesh:
+        from tpu_matmul_bench.serve.pod import run_pod_bench
+
+        return run_pod_bench(config)
     devices, info, pool, cache, q, tenants, explorer = _setup(config)
     world = len(devices)
     _bench_header(config, config.scheduler, tenants)
@@ -1001,9 +1059,12 @@ def run_ab(config: ServeConfig) -> list[BenchmarkRecord]:
     records in one ledger, with the noise-aware verdict on the
     continuous record's ``extras["ab"]``. Exits nonzero when continuous
     batching regresses p99 or goodput beyond the widened tolerance: the
-    in-repo, CPU-verifiable form of the PR's perf claim."""
-    from tpu_matmul_bench.campaign.gate import tolerance_pct
+    in-repo, CPU-verifiable form of the PR's perf claim. A config
+    carrying a pod mesh routes to the pod-vs-single-device A/B."""
+    if config.mesh:
+        from tpu_matmul_bench.serve.pod import run_pod_ab
 
+        return run_pod_ab(config)
     from tpu_matmul_bench.utils.device import (
         collect_device_info,
         device_banner,
@@ -1058,44 +1119,12 @@ def run_ab(config: ServeConfig) -> list[BenchmarkRecord]:
             arm_stats[arm] = stats
             records.append(rec)
 
-        fixed, cont = arm_stats["fixed"], arm_stats["continuous"]
-        tol = tolerance_pct(0.0,
-                            {"noise_pct": fixed["p99_noise_pct"]},
-                            {"noise_pct": cont["p99_noise_pct"]})
-        base_p99 = fixed["p99_ms"] or 1e-9
-        p99_delta = 100.0 * (cont["p99_ms"] - base_p99) / base_p99
-        base_good = fixed["goodput_qps"] or 1e-9
-        good_delta = 100.0 * (cont["goodput_qps"] - base_good) / base_good
-        regressed = p99_delta > tol or good_delta < -tol
-        verdict = {
-            "baseline": "fixed",
-            "candidate": "continuous",
-            "p99_fixed_ms": fixed["p99_ms"],
-            "p99_continuous_ms": cont["p99_ms"],
-            "p99_delta_pct": round(p99_delta, 2),
-            "goodput_fixed_qps": fixed["goodput_qps"],
-            "goodput_continuous_qps": cont["goodput_qps"],
-            "goodput_delta_pct": round(good_delta, 2),
-            "slo_attainment_fixed_pct": fixed["slo_attainment_pct"],
-            "slo_attainment_continuous_pct": cont["slo_attainment_pct"],
-            "tolerance_pct": tol,
-            "regressed": regressed,
-        }
+        verdict = _ab_verdict(arm_stats["fixed"], arm_stats["continuous"],
+                              "fixed", "continuous")
         records[-1].extras["ab"] = verdict
-        report(
-            "\nA/B verdict (fixed-window → continuous):",
-            f"  - p99: {fixed['p99_ms']} → {cont['p99_ms']} ms "
-            f"({p99_delta:+.1f}%)",
-            f"  - goodput: {fixed['goodput_qps']} → "
-            f"{cont['goodput_qps']} QPS ({good_delta:+.1f}%)",
-            f"  - SLO attainment: {fixed['slo_attainment_pct']} → "
-            f"{cont['slo_attainment_pct']} %",
-            f"  - tolerance ±{tol}% (noise-aware) → "
-            + ("REGRESSED" if regressed else "ok"),
-        )
         for rec in records:
             writer.write(rec)
-    if regressed:
+    if verdict["regressed"]:
         raise SystemExit(1)
     return records
 
@@ -1128,6 +1157,9 @@ def _config_manifest(config: ServeConfig,
         "explore": config.explore,
         "explore_db": config.explore_db,
         "artifacts": config.artifacts,
+        "mesh": config.mesh,
+        "replica_groups": config.replica_groups,
+        "comm_quant": config.comm_quant,
     }
 
 

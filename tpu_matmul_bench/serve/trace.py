@@ -165,6 +165,13 @@ class FlightRecorder:
             "wall_ms": round(wall_ms if wall_ms is not None else 0.0, 4),
             "spans": [dict(s) for s in spans],
         }
+        # pod serving (serve/pod.py) stamps the replica group at
+        # placement time; the label rides every terminal record so
+        # `serve explain --slowest N` can attribute tail latency to the
+        # group that served (or refused) the request
+        group = getattr(req, "group", None)
+        if group is not None:
+            record["replica_group"] = int(group)
         if detail:
             record["detail"] = {k: v for k, v in sorted(detail.items())}
         with self._lock:
@@ -217,6 +224,13 @@ def validate_serve_span_record(d: dict[str, Any]) -> list[str]:
                         f"{TERMINAL_STATES}")
     if d["wall_ms"] < 0:
         problems.append(f"serve_span wall_ms {d['wall_ms']} negative")
+    if "replica_group" in d and (
+            not isinstance(d["replica_group"], int)
+            or isinstance(d["replica_group"], bool)
+            or d["replica_group"] < 0):
+        problems.append(
+            f"serve_span replica_group {d['replica_group']!r} is not a "
+            "non-negative integer")
     names: list[str] = []
     for s in d["spans"]:
         if not isinstance(s, dict) or not isinstance(s.get("name"), str) \
@@ -380,6 +394,8 @@ def render_explain(
         head = (f"trace {d.get('trace')}  rid={d.get('rid')}  "
                 f"tenant={d.get('tenant')}  bucket={d.get('bucket')}  "
                 f"state={d.get('state')}  wall {wall:.3f} ms")
+        if "replica_group" in d:
+            head += f"  group=g{d['replica_group']}"
         lines.append(head)
         spans = d.get("spans") or []
         if not spans:

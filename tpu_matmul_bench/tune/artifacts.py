@@ -59,20 +59,27 @@ def default_root(root: str | None = None) -> str:
 
 
 def artifact_key(fingerprint: str, jax_version: str, program_digest: str,
-                 backend: str, mesh_shape: tuple[int, ...]) -> str:
+                 backend: str, mesh_shape: tuple[int, ...],
+                 mesh_spec: str = "") -> str:
     """Stable digest of one artifact identity. Every axis that makes a
     serialized executable non-reusable is part of the key, so staleness
-    is a *miss*, never a wrong hit."""
+    is a *miss*, never a wrong hit. `mesh_spec` (the pod replica-group
+    placement label, serve/placement.py) joins the digest only when set:
+    pre-pod keys recompute byte-identically, while a sharded executable
+    compiled for one group's devices can never be handed to another."""
     from tpu_matmul_bench.analysis.fingerprint import digest
 
-    return digest({
+    identity: dict[str, Any] = {
         "kind": ARTIFACT_RECORD_TYPE,
         "fingerprint": fingerprint,
         "jax_version": jax_version,
         "program_digest": program_digest,
         "backend": backend,
         "mesh_shape": list(mesh_shape),
-    })
+    }
+    if mesh_spec:
+        identity["mesh_spec"] = mesh_spec
+    return digest(identity)
 
 
 def blob_digest(blob: bytes) -> str:
@@ -117,12 +124,14 @@ class ArtifactMeta:
     fingerprint: str           # tune-DB problem fingerprint
     program_digest: str        # tune.db.program_digest of the routed program
     jax_version: str
+    mesh_spec: str = ""        # pod placement label ("" = single-device)
 
     @classmethod
     def build(cls, m: int, k: int, n: int, dtype: Any, *, impl: str,
               blocks: tuple[int, int, int] | None = None,
               device_kind: str = "", backend: str | None = None,
-              mesh_shape: tuple[int, ...] = (1,)) -> "ArtifactMeta":
+              mesh_shape: tuple[int, ...] = (1,),
+              mesh_spec: str = "") -> "ArtifactMeta":
         """Compute the full identity for one executable (one trace for
         the program digest — the same recompute lint's DRIFT gate does)."""
         import jax
@@ -144,13 +153,14 @@ class ArtifactMeta:
             program_digest=program_digest(m, k, n, dt, impl, blocks,
                                           device_kind or "TPU v5e"),
             jax_version=jax.__version__,
+            mesh_spec=mesh_spec,
         )
 
     @property
     def key(self) -> str:
         return artifact_key(self.fingerprint, self.jax_version,
                             self.program_digest, self.backend,
-                            self.mesh_shape)
+                            self.mesh_shape, self.mesh_spec)
 
 
 class ArtifactStore:
@@ -233,6 +243,7 @@ class ArtifactStore:
             "device_kind": meta.device_kind,
             "backend": meta.backend,
             "mesh_shape": list(meta.mesh_shape),
+            **({"mesh_spec": meta.mesh_spec} if meta.mesh_spec else {}),
             "jax_version": meta.jax_version,
             "program_digest": meta.program_digest,
             "blob_digest": digest,
@@ -314,7 +325,8 @@ class ArtifactStore:
                 str(rec.get("jax_version", "")),
                 str(rec.get("program_digest", "")),
                 str(rec.get("backend", "")),
-                tuple(rec.get("mesh_shape") or ()))
+                tuple(rec.get("mesh_shape") or ()),
+                str(rec.get("mesh_spec") or ""))
             if expect != rec.get("key"):
                 problems.append(
                     (where, f"manifest key {rec.get('key')} does not "

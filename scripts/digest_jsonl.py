@@ -287,7 +287,9 @@ def _digest_lint(recs: list[dict],
     """Lint findings ledger: rule-ID x severity table + per-rule example,
     ranked most-severe first (the digest counterpart of `python -m
     tpu_matmul_bench lint --json-out`). Covers every rule family the
-    linter emits — SPEC/COLL/… and the HLO passes' SCHED/MEM/DRIFT —
+    linter emits — SPEC/COLL/… , the HLO passes' SCHED/MEM/DRIFT, and
+    the concurrency certifier's CONC-001..005 (races, lock-order
+    cycles, appender discipline, blocking-under-lock, replay clocks) —
     plus the manifest's per-mode peak-memory column when the memory
     audit ran."""
     findings = [r for r in recs if r.get("record_type") == "lint_finding"]

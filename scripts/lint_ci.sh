@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Thirteen layers, cheapest first:
+# Fourteen layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -93,6 +93,15 @@
 #      groups with zero cold compiles, stamp every terminal span with
 #      its replica group, and render group-attributed tail blame via
 #      `serve explain`.
+#  14. python -m tpu_matmul_bench lint conc selftest — the concurrency
+#      certifier (CONC-00x, analysis/concurrency.py): the whole-tree
+#      race/deadlock/lock-discipline scan of serve/obs/faults must be
+#      clean, each seeded fixture must trip exactly its rule (unguarded
+#      two-root write, lock-order cycle, undeclared appender toucher,
+#      blocking call under a lock, wall clock in replay), two scans
+#      must produce identical findings, and every THREAD_ROLES /
+#      ROLE_HINTS / clock-allowlist entry must still name a live
+#      surface. jax-free: pure AST, runs in well under a second.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -148,3 +157,6 @@ JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_cou
 echo "== serve pod selftest (replica groups / sharded warm start / pod SLO) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m tpu_matmul_bench serve pod selftest
+
+echo "== lint conc selftest (race / deadlock / lock-discipline certifier) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench lint conc selftest

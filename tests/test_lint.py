@@ -761,3 +761,176 @@ def test_pod_audit_clean_on_shipped_tree(devices):
     from tpu_matmul_bench.analysis.auditor import audit_pod
 
     assert [f for f in audit_pod() if f.severity == "error"] == []
+
+
+# ------------------------------------------------ concurrency lint (PR 19)
+
+def _conc_findings(root, **over):
+    from tpu_matmul_bench.analysis.concurrency import conc_findings
+
+    over.setdefault("thread_roles", {})
+    over.setdefault("role_hints", {})
+    over.setdefault("clock_allowlist", {})
+    return conc_findings(root, **over)
+
+
+def test_conc_rules_in_catalog():
+    for rule in ("CONC-001", "CONC-002", "CONC-003", "CONC-004",
+                 "CONC-005"):
+        assert RULES[rule][0] == "error", rule
+
+
+def test_conc_audit_clean_on_shipped_tree():
+    # the tree certifies: every CONC finding ever raised on serve/obs/
+    # faults was either fixed (pod placement lock, operand-pool cache
+    # lock, exporter state lock) or declared (THREAD_ROLES handoffs,
+    # replay clock allowlist) — a regression here is a new race
+    from tpu_matmul_bench.analysis.auditor import audit_conc
+
+    assert audit_conc() == []
+
+
+def test_skip_choices_derive_from_audit_registry():
+    # PR 18 shipped `--skip` with a hand-maintained choices list that
+    # had drifted (artifacts/trace missing); the list is now derived
+    # from the audit registry, and this pins the derivation
+    from tpu_matmul_bench.analysis.auditor import AUDITS, audit_groups
+    from tpu_matmul_bench.analysis.cli import build_parser
+
+    groups = audit_groups()
+    assert set(groups) == set(AUDITS) | {"specs"}
+    assert "conc" in groups and "pod" in groups
+    action = next(a for a in build_parser()._actions
+                  if a.dest == "skip")
+    assert tuple(action.choices) == groups
+
+
+_CONC001_SRC = (
+    "import threading\n\n"
+    "class Box:\n"
+    "    def __init__(self):\n"
+    "        self.n = 0\n"
+    "    def bump(self):\n"
+    "        self.n += 1\n"
+    "    def zero(self):\n"
+    "        self.n = 0\n\n"
+    "def t1(box):\n"
+    "    box.bump()\n\n"
+    "def t2(box):\n"
+    "    box.zero()\n\n"
+    "def main(box):\n"
+    "    threading.Thread(target=t1, args=(box,)).start()\n"
+    "    threading.Thread(target=t2, args=(box,)).start()\n")
+
+
+def test_seeded_unguarded_shared_write_flags_conc001(tmp_path):
+    (tmp_path / "racy.py").write_text(_CONC001_SRC)
+    findings = _conc_findings(tmp_path)
+    assert [(f.rule, f.severity) for f in findings] == \
+        [("CONC-001", "error")]
+    assert "Box.n" in findings[0].message
+
+    # repaired twin: both writers under one lock — clean
+    (tmp_path / "racy.py").write_text(_CONC001_SRC.replace(
+        "        self.n = 0\n    def bump",
+        "        self.n = 0\n"
+        "        self._lock = threading.Lock()\n    def bump").replace(
+        "        self.n += 1",
+        "        with self._lock:\n            self.n += 1").replace(
+        "    def zero(self):\n        self.n = 0",
+        "    def zero(self):\n"
+        "        with self._lock:\n            self.n = 0"))
+    assert _conc_findings(tmp_path) == []
+
+
+def test_seeded_lock_order_cycle_flags_conc002(tmp_path):
+    (tmp_path / "deadlock.py").write_text(
+        "import threading\n\n"
+        "A_LOCK = threading.Lock()\n"
+        "B_LOCK = threading.Lock()\n\n"
+        "def fwd():\n"
+        "    with A_LOCK:\n"
+        "        with B_LOCK:\n"
+        "            pass\n\n"
+        "def rev():\n"
+        "    with B_LOCK:\n"
+        "        with A_LOCK:\n"
+        "            pass\n\n"
+        "def main():\n"
+        "    threading.Thread(target=fwd).start()\n"
+        "    threading.Thread(target=rev).start()\n")
+    findings = _conc_findings(tmp_path)
+    assert [(f.rule, f.severity) for f in findings] == \
+        [("CONC-002", "error")]
+    assert "A_LOCK" in findings[0].message \
+        and "B_LOCK" in findings[0].message
+
+
+def test_seeded_undeclared_appender_toucher_flags_conc003(tmp_path):
+    (tmp_path / "appender.py").write_text(
+        "import threading\n\n"
+        "class Ledger:\n"
+        "    def write_raw(self, rec):\n"
+        "        pass\n\n"
+        "def producer(led):\n"
+        "    led.write_raw('x')\n\n"
+        "def main(led):\n"
+        "    threading.Thread(target=producer, args=(led,)).start()\n")
+    findings = _conc_findings(
+        tmp_path,
+        thread_roles={"appender.py::Ledger.write_raw": ("drainer",)})
+    assert [(f.rule, f.severity) for f in findings] == \
+        [("CONC-003", "error")]
+    assert "producer" in findings[0].message
+
+    # the declared toucher itself stays clean
+    clean = _conc_findings(
+        tmp_path,
+        thread_roles={"appender.py::Ledger.write_raw": ("producer",)})
+    assert clean == []
+
+
+def test_seeded_blocking_call_under_lock_flags_conc004(tmp_path):
+    (tmp_path / "slowpath.py").write_text(
+        "import threading\n"
+        "import time\n\n"
+        "class Hot:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def step(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.5)\n")
+    findings = _conc_findings(tmp_path)
+    assert [(f.rule, f.severity) for f in findings] == \
+        [("CONC-004", "error")]
+    assert "time.sleep" in findings[0].message
+
+
+def test_seeded_wall_clock_in_replay_flags_conc005(tmp_path):
+    (tmp_path / "replay.py").write_text(
+        "import random\n"
+        "import time\n\n"
+        "def run_cell(plan):\n"
+        "    return time.time() + random.random()\n")
+    findings = _conc_findings(tmp_path)
+    assert [(f.rule, f.severity) for f in findings] == \
+        [("CONC-005", "error")] * 2
+
+    # allowlisted file: same source, zero findings, reason on record
+    assert _conc_findings(
+        tmp_path, clock_allowlist={"replay.py": "test pin"}) == []
+
+
+def test_conc_findings_ledger_byte_identical(tmp_path):
+    # the acceptance gate: two independent scans of one tree serialize
+    # to byte-identical finding + summary lines (the manifest line
+    # carries a timestamp and is excluded by design)
+    (tmp_path / "racy.py").write_text(_CONC001_SRC)
+    ledgers = []
+    for name in ("a.jsonl", "b.jsonl"):
+        out = tmp_path / name
+        write_ledger(out, _conc_findings(tmp_path), argv=["lint"],
+                     extra={"fail_on": "error"})
+        ledgers.append(out.read_text().splitlines()[1:])
+    assert ledgers[0] == ledgers[1]
+    assert any('"CONC-001"' in line for line in ledgers[0])

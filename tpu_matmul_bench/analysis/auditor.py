@@ -900,6 +900,17 @@ def audit_trace() -> list[Finding]:
     return trace_findings()
 
 
+def audit_conc() -> list[Finding]:
+    """CONC-001..005: no cross-thread writes without a common guard, no
+    lock-order cycles, appender surfaces touched only by their declared
+    roles, no blocking syscalls under a lock, no wall-clock/unseeded
+    randomness reachable from fault-plan replay
+    (analysis/concurrency.py owns the scan; this is the lint wiring)."""
+    from tpu_matmul_bench.analysis.concurrency import conc_findings
+
+    return conc_findings()
+
+
 def audit_pod() -> list[Finding]:
     """POD-001/002/003: replica-group partitions cover the pod mesh
     disjointly, each group program's traced collective inventory matches
@@ -1244,11 +1255,23 @@ AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "faults": audit_faults,
     "trace": audit_trace,
     "pod": audit_pod,
+    "conc": audit_conc,
 }
 
 #: groups that compile optimized HLO (slower than trace-only audits);
 #: `lint --no-hlo` maps to skipping exactly these
 HLO_AUDITS = ("sched", "memory", "fingerprint")
+
+
+def audit_groups() -> tuple[str, ...]:
+    """Every skippable audit group, derived from the registry — the
+    CLI's --skip choices come from here, so a new audit can never be
+    registered without also becoming skippable (PR 18 shipped with
+    `artifacts`/`trace` missing from the hand-maintained choices list;
+    this makes that drift structurally impossible). "specs" rides along
+    because run_all dispatches it outside AUDITS (it takes the spec
+    paths, not a thunk)."""
+    return tuple(AUDITS) + ("specs",)
 
 
 def run_all(spec_paths: Iterable[str] = (),

@@ -37,6 +37,10 @@ def _force_cpu_backend() -> None:
 
 
 def build_parser() -> argparse.ArgumentParser:
+    # lazy: auditor imports jax, so main() pins the CPU platform before
+    # the parser is built (and plain module import stays jax-free)
+    from tpu_matmul_bench.analysis.auditor import audit_groups
+
     parser = argparse.ArgumentParser(
         prog="lint",
         description="Static contract auditor: jaxpr/HLO checks for every "
@@ -57,12 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="spec files to lint (default: specs/*.toml "
                              "under the repo root)")
     parser.add_argument("--skip", nargs="*", default=(),
-                        choices=("modes", "impls", "donation", "pallas",
-                                 "registry", "tune", "artifacts", "obs",
-                                 "comm_quant", "hier", "train", "specs",
-                                 "sched", "memory", "fingerprint", "faults",
-                                 "trace", "pod"),
-                        help="audit groups to skip")
+                        choices=audit_groups(),
+                        help="audit groups to skip (derived from the "
+                             "audit registry — every registered group "
+                             "is skippable, nothing else is)")
     parser.add_argument("--no-hlo", action="store_true",
                         help="skip the HLO pass family (sched + memory + "
                              "fingerprint) — the compile-heavy groups")
@@ -81,8 +83,21 @@ def _default_specs() -> list[str]:
 
 
 def main(argv: list[str] | None = None):
-    args = build_parser().parse_args(argv)
+    if argv is None:
+        argv = sys.argv[1:]
+    # `lint conc selftest` — the concurrency certifier's self-check
+    # (real tree clean + seeded rules fire + deterministic findings);
+    # jax-free, so it dispatches before any backend setup
+    if argv[:1] == ["conc"]:
+        if argv[1:] != ["selftest"]:
+            print("usage: lint conc selftest", file=sys.stderr)
+            raise SystemExit(2)
+        from tpu_matmul_bench.analysis.concurrency import run_conc_selftest
+
+        return run_conc_selftest()
+
     _force_cpu_backend()
+    args = build_parser().parse_args(argv)
 
     from tpu_matmul_bench.analysis.auditor import HLO_AUDITS, run_all
     from tpu_matmul_bench.analysis.findings import (

@@ -275,6 +275,30 @@ RULES: dict[str, tuple[Severity, str]] = {
                           "with no factorized --mesh, --mesh not covering "
                           "--num-devices, or a per-link --comm-quant the "
                           "pod collective model rejects"),
+    "CONC-001": ("error", "shared mutable attribute or module global "
+                          "written from two or more thread roots with no "
+                          "common guarding lock — a lost-update / torn-"
+                          "read race under any interleaving the GIL "
+                          "happens not to serialize"),
+    "CONC-002": ("error", "lock-order cycle: two code paths acquire the "
+                          "same locks in opposite orders — two threads "
+                          "interleaving those paths deadlock"),
+    "CONC-003": ("error", "appender surface touched from a thread role "
+                          "other than its declared sole toucher "
+                          "(analysis/concurrency.THREAD_ROLES), or an "
+                          "appender-shaped method shipped with no "
+                          "declaration at all — the one-writer-per-"
+                          "ledger contract behind FlightRecorder and the "
+                          "FAULT-002 writer registry, statically checked"),
+    "CONC-004": ("error", "blocking call (fsync, subprocess, time.sleep, "
+                          "AOT compile/serialize) while holding a lock — "
+                          "every thread contending that lock stalls "
+                          "behind the syscall on the serve hot path"),
+    "CONC-005": ("error", "wall-clock or unseeded-randomness call "
+                          "reachable from a fault-plan replay root — the "
+                          "chaos certifier's converged-state verdict "
+                          "assumes replay is a pure function of "
+                          "(plan, seed)"),
 }
 
 

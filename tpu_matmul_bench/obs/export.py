@@ -158,6 +158,11 @@ class SnapshotExporter:
         # of restarting at 1
         self._seq = int(seq_start)
         self._stop = threading.Event()
+        # guards the state the exporter loop writes and the http
+        # thread's readiness probe reads: _seq, _last_flush_unix, and
+        # the _thread handle. Held only around field access — the
+        # fsync and file replace run outside it (CONC-004).
+        self._state_lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._last_flush_unix: float | None = None
         self._http: Any = None
@@ -165,15 +170,18 @@ class SnapshotExporter:
 
     @property
     def snapshots_written(self) -> int:
-        return self._seq
+        with self._state_lock:
+            return self._seq
 
     def write_once(self) -> dict[str, Any]:
         """One snapshot tick: append the JSONL line (fsynced), replace
         the Prometheus file atomically. Returns the snapshot record."""
         self.out_dir.mkdir(parents=True, exist_ok=True)
-        self._seq += 1
+        with self._state_lock:
+            self._seq += 1
+            seq = self._seq
         snap = snapshot_record(self._registry, run_id=self._run_id,
-                               seq=self._seq)
+                               seq=seq)
         repair_torn_tail(self.snapshot_path)
         with open(self.snapshot_path, "a") as fh:
             fh.write(json.dumps(snap, sort_keys=True) + "\n")
@@ -182,7 +190,8 @@ class SnapshotExporter:
         tmp = self.prom_path.with_suffix(".prom.tmp")
         tmp.write_text(prometheus_text(snap, exemplars=self._exemplars))
         os.replace(tmp, self.prom_path)
-        self._last_flush_unix = time.time()
+        with self._state_lock:
+            self._last_flush_unix = time.time()
         return snap
 
     def _loop(self) -> None:
@@ -190,11 +199,12 @@ class SnapshotExporter:
             self.write_once()
 
     def start(self) -> "SnapshotExporter":
-        if self._thread is None:
-            self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._loop, name="obs-exporter", daemon=True)
-            self._thread.start()
+        with self._state_lock:
+            if self._thread is None:
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._loop, name="obs-exporter", daemon=True)
+                self._thread.start()
         return self
 
     def stop(self) -> None:
@@ -202,8 +212,13 @@ class SnapshotExporter:
         than the interval still lands its end-state (OBS-002's bar is
         >= 1 snapshot per instrumented run)."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
+        with self._state_lock:
+            t = self._thread
+        if t is not None:
+            # join OUTSIDE the state lock: the loop's write_once takes
+            # it to stamp the flush, so holding it here would deadlock
+            t.join(timeout=5.0)
+        with self._state_lock:
             self._thread = None
         self.write_once()
         self.stop_http()
@@ -221,16 +236,19 @@ class SnapshotExporter:
         last flush is recent; one-shot callers (write_once without
         start()) count as ready while their flushes stay fresh — probes
         measure the data path, not the threading choice."""
-        alive = self._thread is not None and self._thread.is_alive()
-        if self._last_flush_unix is None:
+        with self._state_lock:
+            t = self._thread
+            last = self._last_flush_unix
+        alive = t is not None and t.is_alive()
+        if last is None:
             return False, "no snapshot flushed yet"
-        age = time.time() - self._last_flush_unix
+        age = time.time() - last
         bound = max(READY_MIN_AGE_S, READY_AGE_FACTOR * self._interval_s)
         if age > bound:
             state = "thread alive" if alive else "thread dead"
             return False, (f"last flush {age:.1f}s ago exceeds the "
                            f"{bound:.1f}s bound ({state})")
-        if not alive and self._thread is not None:
+        if not alive and t is not None:
             return False, "snapshot thread died"
         return True, f"flushed {age:.1f}s ago"
 

@@ -106,6 +106,13 @@ class Gauge(_Instrument):
         with self._lock:
             return self._value
 
+    def _state(self) -> tuple[int, float]:
+        """(seq, value) under the instrument lock — snapshot() resolves
+        last-set-wins across instruments from these pairs without
+        reaching into a foreign instrument's fields."""
+        with self._lock:
+            return self._seq, self._value
+
 
 class Histogram(_Instrument):
     """Sliding-window quantile histogram over a bounded reservoir."""
@@ -213,8 +220,7 @@ class MetricsRegistry:
             if isinstance(inst, Counter):
                 counters[inst.key] = counters.get(inst.key, 0) + inst.value
             elif isinstance(inst, Gauge):
-                with inst._lock:
-                    seq, val = inst._seq, inst._value
+                seq, val = inst._state()
                 if inst.key not in gauges or seq >= gauges[inst.key][0]:
                     gauges[inst.key] = (seq, val)
             elif isinstance(inst, Histogram):
@@ -245,8 +251,13 @@ _REGISTRY_LOCK = threading.Lock()
 
 
 def get_registry() -> MetricsRegistry:
-    """The process-global bus every subsystem records into by default."""
-    return _REGISTRY
+    """The process-global bus every subsystem records into by default.
+
+    Read under the same lock `reset_registry` swaps under: an exporter
+    thread grabbing the bus mid-reset must see either the old registry
+    or the new one, never a torn reference."""
+    with _REGISTRY_LOCK:
+        return _REGISTRY
 
 
 def reset_registry() -> MetricsRegistry:

@@ -150,13 +150,18 @@ class _GroupOperandPool:
     Reuses the base `_OperandPool`'s host arrays (one generation per
     bucket across all groups, shared under `lock`) and device_puts them
     with the group program's input shardings, memoized per bucket.
-    One worker thread per group touches each instance after warm-start.
+    Warm-start populates from the main thread and the group's drain
+    thread fills misses after the window opens, so the memo dict is
+    guarded by its own lock (CONC-001); the device_put itself runs
+    outside both locks — racing fillers build twice and the first
+    store wins.
     """
 
     def __init__(self, base: Any, mesh: Any, lock: threading.Lock) -> None:
         self._base = base
         self._mesh = mesh
         self._lock = lock
+        self._cache_lock = threading.Lock()
         self._cache: dict[tuple[int, int, int, str], tuple[Any, ...]] = {}
 
     def get(self, key: Any) -> tuple[Any, ...]:
@@ -165,7 +170,8 @@ class _GroupOperandPool:
         from jax.sharding import PartitionSpec as P
 
         ck = (key.m, key.k, key.n, key.dtype)
-        got = self._cache.get(ck)
+        with self._cache_lock:
+            got = self._cache.get(ck)
         if got is not None:
             return got
         with self._lock:
@@ -177,8 +183,8 @@ class _GroupOperandPool:
             spec_a, spec_b = P(), P(None, axes[0])
         ops = (jax.device_put(a, NamedSharding(self._mesh, spec_a)),
                jax.device_put(b, NamedSharding(self._mesh, spec_b)))
-        self._cache[ck] = ops
-        return ops
+        with self._cache_lock:
+            return self._cache.setdefault(ck, ops)
 
 
 class _LockedStream:
@@ -321,6 +327,13 @@ class PodQueue:
         # front shares ONE recorder with every group scheduler so
         # terminal records land in a single drained buffer
         self.recorder = recorder
+        # serializes pick→stamp→enqueue: each group's depth read is
+        # individually locked, but without this lock two producers
+        # racing through submit() both see the same backlogs and
+        # dogpile the least-loaded group while its neighbor idles.
+        # Order: _place_lock → scheduler._cond → recorder._lock
+        # (acyclic — nothing takes _place_lock while holding either).
+        self._place_lock = threading.Lock()
 
     @property
     def submitted(self) -> int:
@@ -351,11 +364,13 @@ class PodQueue:
 
     def submit(self, req: Request) -> Request:
         bucket = self.grid.bucket(req.m, req.k, req.n)
-        gi = self._pick_group(bucket, req.dtype)
-        # stamped BEFORE submit: a shed terminal then carries the group
-        # that refused, so `serve explain` attributes refusals too
-        req.group = gi
-        return self.scheds[gi].submit(req)
+        with self._place_lock:
+            gi = self._pick_group(bucket, req.dtype)
+            # stamped BEFORE submit: a shed terminal then carries the
+            # group that refused, so `serve explain` attributes
+            # refusals too
+            req.group = gi
+            return self.scheds[gi].submit(req)
 
     def close(self) -> None:
         for s in self.scheds:

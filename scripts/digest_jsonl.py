@@ -3,11 +3,20 @@
 
 Usage: python scripts/digest_jsonl.py measurements/r3/*.jsonl
        python scripts/digest_jsonl.py measurements/r6_campaign
+       python scripts/digest_jsonl.py --schema
 
 Groups records by (file, shape, dtype, mode) and prints them ranked by
 per-device throughput, with the blocking (tuner records carry it in
 extras) so sweep winners can be read off and baked into
 ops/pallas_matmul.py's tuned tables with provenance.
+
+``--schema`` prints the record-family coverage table instead: one line
+per RECORD_FAMILIES entry in the schema-flow certifier's declaration
+table (analysis/schema_flow.py) — producers, validator, consumers,
+OUTPUT_ONLY/historical allowlist sizes, and the history route — the
+"which digest function reads which record family, and who checks it"
+map in one screen. jax-free (the certifier is pure AST), but it does
+need the package importable, unlike the ledger digests above.
 
 A campaign directory (one holding a ``journal.jsonl`` or a ``jobs/``
 subdirectory, as written by `python -m tpu_matmul_bench campaign run`)
@@ -287,11 +296,13 @@ def _digest_lint(recs: list[dict],
     """Lint findings ledger: rule-ID x severity table + per-rule example,
     ranked most-severe first (the digest counterpart of `python -m
     tpu_matmul_bench lint --json-out`). Covers every rule family the
-    linter emits — SPEC/COLL/… , the HLO passes' SCHED/MEM/DRIFT, and
-    the concurrency certifier's CONC-001..005 (races, lock-order
-    cycles, appender discipline, blocking-under-lock, replay clocks) —
-    plus the manifest's per-mode peak-memory column when the memory
-    audit ran."""
+    linter emits — SPEC/COLL/… , the HLO passes' SCHED/MEM/DRIFT, the
+    concurrency certifier's CONC-001..005 (races, lock-order cycles,
+    appender discipline, blocking-under-lock, replay clocks), and the
+    schema-flow certifier's SCHEMA-001..005 (unwritten consumed keys,
+    validator gaps, unread durable keys, shape conflicts, unrouted
+    durable families) — plus the manifest's per-mode peak-memory
+    column when the memory audit ran."""
     findings = [r for r in recs if r.get("record_type") == "lint_finding"]
     sev_rank = {"error": 0, "warn": 1, "info": 2}
     by_rule: dict[str, list[dict]] = {}
@@ -771,7 +782,49 @@ def _digest_history(recs: list[dict]) -> None:
             "detect)")
 
 
+def _schema_coverage() -> None:
+    """`--schema`: the record-family coverage table, straight from the
+    schema-flow certifier's RECORD_FAMILIES declaration table — every
+    durable ledger/journal/store family with its producer count,
+    validator surface, consumer count, allowlist sizes, and history
+    route. The certifier (`lint schema selftest`) guarantees the table
+    is live; this renders it."""
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    try:
+        from tpu_matmul_bench.analysis.schema_flow import RECORD_FAMILIES
+    except ImportError:
+        print("--schema needs the tpu_matmul_bench package importable "
+              "(jax is NOT required — the certifier is pure AST)",
+              file=sys.stderr)
+        raise SystemExit(2)
+    print(f"{'family':<15} {'prod':>4} {'aux':>4} {'dcls':>4} {'cons':>4} "
+          f"{'out':>4} {'hist':>4}  {'validator':<42} history route")
+    for name in sorted(RECORD_FAMILIES):
+        fam = RECORD_FAMILIES[name]
+        validator = fam.validator[0] if fam.validator \
+            else "(dataclass/consumers are the authority)"
+        if fam.ingest:
+            route = f"ingest={fam.ingest}"
+        elif fam.non_history:
+            route = f"non-history: {fam.non_history}"
+        elif not fam.durable:
+            route = "(ephemeral)"
+        else:
+            route = "UNROUTED"  # SCHEMA-005 would fire; cannot ship
+        print(f"{name:<15} {len(fam.producers):>4} "
+              f"{len(fam.aux_producers):>4} "
+              f"{len(fam.record_dataclasses):>4} {len(fam.consumers):>4} "
+              f"{len(fam.output_only):>4} {len(fam.historical):>4}  "
+              f"{validator:<42} {route}")
+    print(f"-- {len(RECORD_FAMILIES)} families; contract certified by "
+          "`python -m tpu_matmul_bench lint schema selftest` "
+          "(SCHEMA-001..005)")
+
+
 def main(paths: list[str]) -> None:
+    if "--schema" in paths:
+        _schema_coverage()
+        return
     # a directory argument (incl. the no-args default) digests its JSONLs;
     # a CAMPAIGN directory digests its job ledgers as one combined table
     expanded: list[str] = []

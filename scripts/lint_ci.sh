@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI lint gate: ruff (when available) + the static contract auditor.
 #
-# Fourteen layers, cheapest first:
+# Fifteen layers, cheapest first:
 #   1. ruff — pyflakes (F) + import hygiene (I), configured in
 #      pyproject.toml [tool.ruff]. Skipped with a notice when ruff is not
 #      installed (the benchmark containers don't ship it; dev machines and
@@ -102,6 +102,18 @@
 #      must produce identical findings, and every THREAD_ROLES /
 #      ROLE_HINTS / clock-allowlist entry must still name a live
 #      surface. jax-free: pure AST, runs in well under a second.
+#  15. python -m tpu_matmul_bench lint schema selftest — the schema-flow
+#      certifier (SCHEMA-00x, analysis/schema_flow.py): the whole-tree
+#      producer/consumer contract scan of every ledger, journal, and
+#      store record family must be clean (every consumed key has a live
+#      producer, every validator covers its family's written key set,
+#      nothing durable is written that nothing reads without a reviewed
+#      OUTPUT_ONLY reason, shapes agree across producers, durable
+#      families route into the metric history or declare why not), each
+#      seeded fixture must trip exactly its rule with its repaired twin
+#      clean, two scans must produce identical findings, and every
+#      RECORD_FAMILIES qual must still name a live surface. jax-free:
+#      pure AST, runs in well under a second.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -160,3 +172,6 @@ JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_cou
 
 echo "== lint conc selftest (race / deadlock / lock-discipline certifier) =="
 JAX_PLATFORMS=cpu python -m tpu_matmul_bench lint conc selftest
+
+echo "== lint schema selftest (record-family producer/consumer certifier) =="
+JAX_PLATFORMS=cpu python -m tpu_matmul_bench lint schema selftest

@@ -445,6 +445,59 @@ def test_shipped_specs_lint_clean():
     assert spec_lint.lint_specs(paths) == []
 
 
+def _walk_long_flags(parser, zero_arg=False):
+    # independent of spec_lint's helpers on purpose: this test must
+    # keep working if those helpers regress to hand-kept lists
+    flags = set()
+    for action in parser._actions:
+        if zero_arg and action.nargs != 0:
+            continue
+        for opt in action.option_strings:
+            if opt.startswith("--") and opt != "--help":
+                flags.add(opt)
+    return flags
+
+
+def test_serve_spec_vocab_is_the_parser():
+    # the spec linter's serve-flag vocabulary is introspected from
+    # serve/cli.py's real parser; the hand-kept list it replaced had
+    # drifted (--obs-exemplars existed in the CLI but not the list, so
+    # every spec using it was a false SPEC-002)
+    import argparse
+
+    from tpu_matmul_bench.serve.cli import build_parser
+
+    subs = next(a for a in build_parser()._actions
+                if isinstance(a, argparse._SubParsersAction)).choices
+    per = {name: _walk_long_flags(subs[name])
+           for name in ("bench", "ab", "selftest")}
+    common, bench_only, bools = spec_lint._serve_vocab()
+    assert common == per["bench"] & per["ab"] & per["selftest"]
+    assert bench_only == (per["bench"] | per["ab"]) - common
+    assert bools == set().union(*(
+        _walk_long_flags(subs[n], zero_arg=True)
+        for n in ("bench", "ab", "selftest")))
+    # the drift bug, pinned: the flag the hand list lost
+    assert "--obs-exemplars" in common and "--obs-exemplars" in bools
+    assert "--qps" in bench_only and "--qps" not in common
+
+
+def test_obs_spec_vocab_is_the_parser():
+    import argparse
+
+    from tpu_matmul_bench.obs.cli import build_parser
+
+    subs = next(a for a in build_parser()._actions
+                if isinstance(a, argparse._SubParsersAction)).choices
+    by_sub, bools = spec_lint._obs_vocab()
+    assert set(by_sub) == set(spec_lint._OBS_SUBCOMMANDS)
+    for name in by_sub:
+        assert by_sub[name] == _walk_long_flags(subs[name]), name
+    assert bools == set().union(*(
+        _walk_long_flags(subs[n], zero_arg=True) for n in by_sub))
+    assert "--json" in by_sub["status"] and "--json" in bools
+
+
 def test_seeded_unprovenance_registry_tier(monkeypatch):
     from tpu_matmul_bench.ops import impl_select
 

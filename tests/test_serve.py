@@ -291,7 +291,17 @@ def test_validate_serve_record_catches_tampering():
                 "shed_rate_pct": 0.0, "achieved_qps": 10.0, "requests": 3,
                 "scheduler": "continuous", "goodput_qps": 10.0,
                 "slo_attainment_pct": 100.0,
-                "tenants": {"default": {"requests": 3, "slo_ms": None,
+                "load_mode": "closed", "shed": 0, "wall_s": 0.3,
+                "service_p50_ms": 1.0, "wait_p99_ms": 0.5,
+                "p99_noise_pct": 1.0, "cold_requests": 0,
+                "padding_overhead_pct": 0.0, "buckets": {},
+                "tenants": {"default": {"requests": 3, "shed": 0,
+                                        "shed_rate_pct": 0.0,
+                                        "p50_ms": 1.0, "p95_ms": 2.0,
+                                        "p99_ms": 3.0, "max_ms": 4.0,
+                                        "wait_p50_ms": 0.1,
+                                        "wait_p99_ms": 0.5,
+                                        "slo_ms": None,
                                         "slo_attainment_pct": 100.0}},
                 "cache": {"hits": 2, "misses": 1},
                 "queue": {"submitted": 3, "shed": 0}}})

@@ -911,6 +911,18 @@ def audit_conc() -> list[Finding]:
     return conc_findings()
 
 
+def audit_schema() -> list[Finding]:
+    """SCHEMA-001..005: every key a consumer reads has a live producer,
+    validators cover their family's statically-written key set, no key
+    is written that nothing reads (absent a reviewed OUTPUT_ONLY
+    reason), shapes agree across a family's producers, durable families
+    route into the metric history or declare why not
+    (analysis/schema_flow.py owns the scan; this is the lint wiring)."""
+    from tpu_matmul_bench.analysis.schema_flow import schema_findings
+
+    return schema_findings()
+
+
 def audit_pod() -> list[Finding]:
     """POD-001/002/003: replica-group partitions cover the pod mesh
     disjointly, each group program's traced collective inventory matches
@@ -1256,6 +1268,7 @@ AUDITS: dict[str, Callable[[], list[Finding]]] = {
     "trace": audit_trace,
     "pod": audit_pod,
     "conc": audit_conc,
+    "schema": audit_schema,
 }
 
 #: groups that compile optimized HLO (slower than trace-only audits);

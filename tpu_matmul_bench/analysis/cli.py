@@ -96,6 +96,18 @@ def main(argv: list[str] | None = None):
 
         return run_conc_selftest()
 
+    # `lint schema selftest` — the schema-flow certifier's self-check
+    # (14 families certify clean + seeded SCHEMA rules fire + repaired
+    # twins clean + deterministic findings); jax-free, same contract
+    if argv[:1] == ["schema"]:
+        if argv[1:] != ["selftest"]:
+            print("usage: lint schema selftest", file=sys.stderr)
+            raise SystemExit(2)
+        from tpu_matmul_bench.analysis.schema_flow import (
+            run_schema_selftest)
+
+        return run_schema_selftest()
+
     _force_cpu_backend()
     args = build_parser().parse_args(argv)
 

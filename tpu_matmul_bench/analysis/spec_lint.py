@@ -32,26 +32,63 @@ _SWEEP_KEYS = {"id_prefix", "program", "flags", "timeout_s", "retries",
 # axis and therefore needs size % num_devices == 0
 _DIVISIBILITY_MODES = {"matrix_parallel", "model_parallel"}
 
-# serve-CLI flag vocabulary, mirroring serve/cli.py — an unknown flag
-# crashes the job at spawn time, possibly hours into the campaign
+# serve-CLI subcommands a campaign may schedule (the semantic subset:
+# explain/trace/pod are interactive or CI-only) and flag SEMANTICS that
+# argparse cannot express (positivity, scheduler vocabulary). The flag
+# VOCABULARY itself is derived from the real parsers below — PR 19's
+# hand-kept lists had already drifted (--obs-exemplars existed in
+# serve/cli.py but not here, so every spec using it was a false
+# SPEC-002).
 _SERVE_SUBCOMMANDS = ("bench", "ab", "selftest")
-_SERVE_COMMON_FLAGS = {
-    "--mix", "--dtype", "--grid", "--scheduler", "--tenants",
-    "--starvation-ms", "--window-ms", "--max-depth",
-    "--max-batch", "--cache-capacity", "--matmul-impl", "--seed",
-    "--device", "--num-devices", "--json-out", "--append", "--trace-out",
-    "--obs-dir", "--artifacts",
-    # pod serving (serve/pod.py); their joint validity is SPEC-010's
-    "--mesh", "--replica-groups", "--comm-quant",
-}
-_SERVE_BENCH_FLAGS = {"--qps", "--duration", "--concurrency", "--prewarm",
-                      "--explore", "--explore-db"}
-_SERVE_BOOL_FLAGS = {"--prewarm", "--append"}
 # flags whose value must be a strictly positive number
 _SERVE_POSITIVE_FLAGS = {"--qps", "--duration", "--concurrency",
                          "--window-ms", "--starvation-ms", "--max-depth",
                          "--max-batch", "--cache-capacity"}
 _SERVE_SCHEDULERS = ("fixed", "continuous")
+
+#: derived-parser vocabulary cache; built once per process on first use
+_VOCAB_CACHE: dict[str, Any] = {}
+
+
+def _subparsers_of(parser: Any) -> dict[str, Any]:
+    """name -> subparser from an argparse parser's _SubParsersAction."""
+    import argparse
+
+    for action in parser._actions:
+        if isinstance(action, argparse._SubParsersAction):
+            return dict(action.choices)
+    return {}
+
+
+def _flags_of(parser: Any) -> set[str]:
+    """The --long option strings a subparser accepts, minus --help."""
+    return {opt for action in parser._actions
+            for opt in action.option_strings
+            if opt.startswith("--")} - {"--help"}
+
+
+def _bool_flags_of(parser: Any) -> set[str]:
+    """The zero-argument (store_true/store_const) --long options."""
+    return {opt for action in parser._actions if action.nargs == 0
+            for opt in action.option_strings
+            if opt.startswith("--")} - {"--help"}
+
+
+def _serve_vocab() -> tuple[set[str], set[str], set[str]]:
+    """(common, bench/ab-only, zero-arg) serve flags, introspected from
+    serve/cli.py's real parser — the vocabulary can no longer drift
+    from the CLI because it IS the CLI."""
+    if "serve" not in _VOCAB_CACHE:
+        from tpu_matmul_bench.serve.cli import build_parser
+
+        subs = _subparsers_of(build_parser())
+        per = {name: _flags_of(subs[name]) for name in _SERVE_SUBCOMMANDS}
+        common = set.intersection(*per.values())
+        bench_only = (per["bench"] | per["ab"]) - common
+        bools = set().union(*(_bool_flags_of(subs[name])
+                              for name in _SERVE_SUBCOMMANDS))
+        _VOCAB_CACHE["serve"] = (common, bench_only, bools)
+    return _VOCAB_CACHE["serve"]
 
 
 def _raw_flag_values(argv: list[str], flag: str) -> list[str]:
@@ -90,11 +127,13 @@ def _comm_quant_values(argv: list[str]) -> list[str]:
     return out
 
 
-def _serve_flag_items(argv: list[str]) -> tuple[list[tuple[str, str | None]],
-                                                list[str]]:
-    """(flag, value) pairs + stray positional tokens from a serve job's
+def _serve_flag_items(argv: list[str], bool_flags: set[str],
+                      ) -> tuple[list[tuple[str, str | None]],
+                                 list[str]]:
+    """(flag, value) pairs + stray positional tokens from a CLI job's
     argv tail (after the subcommand). Handles --flag=value and the
-    store_true flags; an unknown flag is assumed to take a value."""
+    caller's zero-argument flags; an unknown flag is assumed to take a
+    value."""
     items: list[tuple[str, str | None]] = []
     strays: list[str] = []
     i = 0
@@ -108,7 +147,7 @@ def _serve_flag_items(argv: list[str]) -> tuple[list[tuple[str, str | None]],
         if eq:
             items.append((flag, inline))
             i += 1
-        elif flag in _SERVE_BOOL_FLAGS:
+        elif flag in bool_flags:
             items.append((flag, None))
             i += 1
         else:
@@ -136,10 +175,10 @@ def _lint_serve_job(job: Any, where: str,
             f"{_SERVE_SUBCOMMANDS}, got {argv[:1] or '[]'}",
             details={"argv": argv})]
     sub = argv[0]
-    known = _SERVE_COMMON_FLAGS | (_SERVE_BENCH_FLAGS
-                                   if sub in ("bench", "ab") else set())
+    common, bench_only, bool_flags = _serve_vocab()
+    known = common | (bench_only if sub in ("bench", "ab") else set())
     findings: list[Finding] = []
-    items, strays = _serve_flag_items(argv[1:])
+    items, strays = _serve_flag_items(argv[1:], bool_flags)
     for tok in strays:
         findings.append(Finding(
             "SPEC-001", where,
@@ -225,21 +264,27 @@ def _lint_serve_job(job: Any, where: str,
     return findings
 
 
-# obs-CLI flag vocabulary, mirroring obs/cli.py — campaign specs may
-# schedule observatory steps (ingest after a sweep, detect as a gate),
-# and an unknown flag crashes that job at spawn time like any other
+# obs-CLI subcommands a campaign may schedule (ingest after a sweep,
+# detect as a gate) plus the value semantics argparse cannot express;
+# the per-subcommand flag vocabulary is introspected from obs/cli.py's
+# real parser, same contract as _serve_vocab
 _OBS_SUBCOMMANDS = ("status", "selftest", "ingest", "history", "detect",
                     "report")
-_OBS_FLAGS_BY_SUB = {
-    "status": {"--json", "--follow", "--interval", "--timeout"},
-    "selftest": {"--dir", "--keep"},
-    "ingest": {"--store", "--seq", "--dry-run"},
-    "history": {"--store"},
-    "detect": {"--store", "--spec", "--detect-window", "--threshold-pct",
-               "--stale-rounds", "--fail-on", "--json-out"},
-    "report": {"--store", "--spec", "--out"},
-}
-_OBS_BOOL_FLAGS = {"--json", "--follow", "--keep", "--dry-run"}
+
+
+def _obs_vocab() -> tuple[dict[str, set[str]], set[str]]:
+    """(subcommand -> flags, zero-arg flags) for the observatory CLI,
+    introspected from obs/cli.py's real parser."""
+    if "obs" not in _VOCAB_CACHE:
+        from tpu_matmul_bench.obs.cli import build_parser
+
+        subs = _subparsers_of(build_parser())
+        by_sub = {name: _flags_of(subs[name])
+                  for name in _OBS_SUBCOMMANDS}
+        bools = set().union(*(_bool_flags_of(subs[name])
+                              for name in _OBS_SUBCOMMANDS))
+        _VOCAB_CACHE["obs"] = (by_sub, bools)
+    return _VOCAB_CACHE["obs"]
 #: flags that must parse as a strictly positive integer
 _OBS_POSITIVE_INT_FLAGS = {"--detect-window", "--stale-rounds", "--seq"}
 #: flags that must parse as a strictly positive number
@@ -265,19 +310,12 @@ def _lint_obs_job(job: Any, where: str) -> list[Finding]:
             f"got {argv[:1] or '[]'}",
             details={"argv": argv})]
     sub = argv[0]
-    known = _OBS_FLAGS_BY_SUB[sub]
+    by_sub, bool_flags = _obs_vocab()
+    known = by_sub[sub]
     findings: list[Finding] = []
-    # reuse the serve tokenizer; it only knows serve's bool flags, so an
-    # obs bool flag that captured the next token gives that token back
-    # as a positional
-    items, strays = _serve_flag_items(argv[1:])
-    fixed_items: list[tuple[str, str | None]] = []
-    for flag, val in items:
-        if flag in _OBS_BOOL_FLAGS and val is not None:
-            fixed_items.append((flag, None))
-            strays.append(val)
-        else:
-            fixed_items.append((flag, val))
+    # the shared tokenizer, parameterized with obs's own zero-argument
+    # flags so `--json`-style options never capture the next token
+    fixed_items, strays = _serve_flag_items(argv[1:], bool_flags)
     if sub == "history":
         # optional positional action
         actions = [s for s in strays]

@@ -59,6 +59,7 @@ from tpu_matmul_bench.serve.tenants import (
 )
 from tpu_matmul_bench.serve.trace import (
     FlightRecorder,
+    failure_spans,
     mint_trace_id,
     request_spans,
 )
@@ -334,15 +335,7 @@ def _worker_drain(
                         t_fail = time.perf_counter()
                         recorder.terminal(
                             req, "failed",
-                            spans=[
-                                {"name": "queue_wait", "ms": round(max(
-                                    req.dispatched_at - req.submitted_at,
-                                    0.0) * 1e3, 4)},
-                                {"name": "batch_wait", "ms": round(max(
-                                    t0 - req.dispatched_at, 0.0) * 1e3, 4)},
-                                {"name": "execute", "ms": round(max(
-                                    t_fail - t0, 0.0) * 1e3, 4)},
-                            ],
+                            spans=failure_spans(req, t0, t_fail),
                             wall_ms=round(max(
                                 t_fail - req.submitted_at, 0.0) * 1e3, 4),
                             error=classify(e))
@@ -1337,6 +1330,79 @@ def validate_serve_record(rec: BenchmarkRecord) -> list[str]:
         problems.append(
             f"goodput_qps {s['goodput_qps']} exceeds achieved_qps "
             f"{s['achieved_qps']}")
+    # full headline coverage — every key serve_stats writes
+    # unconditionally must be present (the schema certifier's
+    # SCHEMA-002 contract: the validator may not lag the producer)
+    for key in ("load_mode", "shed", "wall_s", "service_p50_ms",
+                "wait_p99_ms", "p99_noise_pct", "cold_requests",
+                "padding_overhead_pct", "buckets"):
+        if key not in s:
+            problems.append(f"extras['serve'] lacks {key!r}")
+    # mode-dependent extras: present only under open load / --explore,
+    # but never malformed
+    if "offered_qps" in s and not isinstance(s["offered_qps"],
+                                             (int, float)):
+        problems.append(f"offered_qps {s['offered_qps']!r} not numeric")
+    if "explore" in s and not isinstance(s["explore"], dict):
+        problems.append(f"explore block {s['explore']!r} not a dict")
+    # per-tenant rows: the full _tenant_rows schema; weight/priority
+    # travel together (both come from the same TenantSpec)
+    for tid, row in s["tenants"].items():
+        for key in ("requests", "shed", "shed_rate_pct", "p50_ms",
+                    "p95_ms", "p99_ms", "max_ms", "wait_p50_ms",
+                    "wait_p99_ms", "slo_ms", "slo_attainment_pct"):
+            if key not in row:
+                problems.append(f"tenant {tid!r} row lacks {key!r}")
+        if ("weight" in row) != ("priority" in row):
+            problems.append(
+                f"tenant {tid!r} row carries weight/priority "
+                "unpaired — both come from one TenantSpec")
+    # per-bucket rows: count + percentiles always; impl_source from the
+    # routing-tier vocabulary and a plausible padding efficiency when
+    # present
+    for label, row in (s.get("buckets") or {}).items():
+        for key in ("count", "p50_ms", "p95_ms", "p99_ms", "max_ms"):
+            if key not in row:
+                problems.append(f"bucket {label!r} row lacks {key!r}")
+        if not row.get("count"):
+            problems.append(f"bucket {label!r} row has no requests")
+        if "impl_source" in row and row["impl_source"] not in (
+                "db", "table", "online", "artifact", "flag"):
+            problems.append(f"bucket {label!r} impl_source "
+                            f"{row['impl_source']!r} not a routing tier")
+        if "flops_efficiency_pct" in row \
+                and not 0 < row["flops_efficiency_pct"] <= 100.0 + 1e-9:
+            problems.append(
+                f"bucket {label!r} flops_efficiency_pct "
+                f"{row['flops_efficiency_pct']!r} outside (0, 100]")
+    # pod block (present iff the run was mesh-sharded): headlines plus
+    # the per-group rows the pod SLO gate and _pod_points read
+    if "pod" in s:
+        pod = s["pod"]
+        for key in ("mesh", "replica_groups", "groups",
+                    "min_group_goodput_qps",
+                    "worst_tenant_attainment_pct"):
+            if key not in pod:
+                problems.append(f"pod block lacks {key!r}")
+        rows = pod.get("groups") or []
+        if pod.get("replica_groups") != len(rows):
+            problems.append(
+                f"pod replica_groups {pod.get('replica_groups')!r} != "
+                f"{len(rows)} group rows")
+        for row in rows:
+            for key in ("group", "placement", "mesh", "devices",
+                        "requests", "shed", "achieved_qps",
+                        "goodput_qps", "slo_attainment_pct", "p99_ms"):
+                if key not in row:
+                    problems.append(
+                        f"pod group {row.get('group')!r} row lacks "
+                        f"{key!r}")
+        if rows and all("requests" in r for r in rows) \
+                and sum(r["requests"] for r in rows) != s["requests"]:
+            problems.append(
+                f"pod group rows account for "
+                f"{sum(r['requests'] for r in rows)} requests, headline "
+                f"says {s['requests']} — a request crossed groups")
     return problems
 
 

@@ -116,6 +116,23 @@ def request_spans(
     ]
 
 
+def failure_spans(req: Any, t0: float,
+                  t_fail: float) -> list[dict[str, Any]]:
+    """The failed request's span chain: queue wait → batch wait →
+    whatever ran before the exception, attributed to `execute` (there
+    is no cache boundary to split on — the failure may have been the
+    compile itself). Lives here, not in the worker loop, so the span
+    schema has exactly one owning module."""
+    return [
+        {"name": "queue_wait",
+         "ms": round(max(req.dispatched_at - req.submitted_at, 0.0) * 1e3,
+                     4)},
+        {"name": "batch_wait",
+         "ms": round(max(t0 - req.dispatched_at, 0.0) * 1e3, 4)},
+        {"name": "execute", "ms": round(max(t_fail - t0, 0.0) * 1e3, 4)},
+    ]
+
+
 class FlightRecorder:
     """Collects terminal trace events from any serve-harness thread.
 
@@ -231,6 +248,9 @@ def validate_serve_span_record(d: dict[str, Any]) -> list[str]:
         problems.append(
             f"serve_span replica_group {d['replica_group']!r} is not a "
             "non-negative integer")
+    if "detail" in d and not isinstance(d["detail"], str):
+        problems.append(
+            f"serve_span detail {d['detail']!r} is not a string")
     names: list[str] = []
     for s in d["spans"]:
         if not isinstance(s, dict) or not isinstance(s.get("name"), str) \
@@ -242,6 +262,23 @@ def validate_serve_span_record(d: dict[str, Any]) -> list[str]:
         if s["name"] not in SPAN_NAMES:
             problems.append(f"span name {s['name']!r} not in {SPAN_NAMES}")
         names.append(s["name"])
+        # the cache span's provenance keys: hit flag, acquisition
+        # source, and the cold-path timing split — optional, but never
+        # malformed (the explain renderer prices tails from them)
+        if s["name"] == "cache":
+            if "hit" in s and not isinstance(s["hit"], bool):
+                problems.append(
+                    f"cache span hit {s['hit']!r} is not a bool")
+            if "source" in s and not isinstance(s["source"], str):
+                problems.append(
+                    f"cache span source {s['source']!r} is not a string")
+            for tkey in ("cold_compile_ms", "deserialize_ms"):
+                if tkey in s and (isinstance(s[tkey], bool)
+                                  or not isinstance(s[tkey], (int, float))
+                                  or s[tkey] < 0):
+                    problems.append(
+                        f"cache span {tkey} {s[tkey]!r} is not a "
+                        "non-negative number")
     if d["state"] == "complete" and not problems:
         if names != list(SPAN_NAMES):
             problems.append(

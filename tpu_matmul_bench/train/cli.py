@@ -234,6 +234,28 @@ def _selftest(argv: Sequence[str]) -> list:
             f"{drifts[128][-1]:.3e} < fp8-block:16 final "
             f"{drifts[16][-1]:.3e}")
 
+    # 4) the train-ledger schema contract: one tiny measured cell must
+    #    pass validate_train_record — the dynamic twin of the schema
+    #    certifier's static SCHEMA-002 coverage of bench_one
+    from tpu_matmul_bench.train.harness import (
+        TrainArgs,
+        bench_one,
+        validate_train_record,
+    )
+    from tpu_matmul_bench.utils.config import BenchConfig
+
+    cfg = BenchConfig(sizes=[128], iterations=1, warmup=0,
+                      dtype_name="float32", mode="dp", device=None,
+                      num_devices=8, json_out=None, matmul_impl="xla",
+                      seed=0)
+    rec = bench_one(cfg, make_mesh(jax.devices()[:8]),
+                    TrainArgs(mode="dp", zero=True,
+                              grad_quant="fp8-block:16", steps=2), 128)
+    schema_problems = validate_train_record(rec)
+    failures.extend(f"train record schema: {p}" for p in schema_problems)
+    print(f"train record schema: "
+          f"{'ok' if not schema_problems else schema_problems}")
+
     if failures:
         print(f"train selftest: FAILED ({len(failures)} problem(s))")
         for msg in failures:

@@ -92,6 +92,66 @@ def validate_step(setup: TrainStepSetup, dtype) -> dict:
     }
 
 
+def validate_train_record(rec) -> list[str]:
+    """The train-ledger schema contract, as checkable invariants.
+    Empty list = valid — the dynamic twin of the schema certifier's
+    static SCHEMA-002 coverage of bench_one. Shared by `train selftest`
+    and the tests."""
+    problems: list[str] = []
+    t = rec.extras.get("train")
+    if not isinstance(t, dict):
+        return ["extras['train'] block missing"]
+    for key in ("zero", "grad_quant", "steps", "lr", "dp", "tp",
+                "global_batch", "local_batch", "phases", "phase_sum_s",
+                "wall_s", "update_drift"):
+        if key not in t:
+            problems.append(f"extras['train'] lacks {key!r}")
+    if problems:
+        return problems
+    if rec.benchmark != "train":
+        problems.append(f"benchmark field is {rec.benchmark!r}, "
+                        "not 'train'")
+    if t["dp"] * t["tp"] != rec.world:
+        problems.append(f"dp {t['dp']} x tp {t['tp']} != world "
+                        f"{rec.world}")
+    if t["global_batch"] != t["local_batch"] * t["dp"]:
+        problems.append(
+            f"global_batch {t['global_batch']} != local_batch "
+            f"{t['local_batch']} x dp {t['dp']}")
+    phases = t["phases"]
+    if not isinstance(phases, dict) or not phases:
+        problems.append("phases block empty")
+    else:
+        for name, v in phases.items():
+            if not name.endswith("_s"):
+                problems.append(f"phase key {name!r} not *_s-suffixed")
+            if not isinstance(v, (int, float)):
+                problems.append(f"phase {name!r} value {v!r} not numeric")
+        # cumulative-prefix telescoping: the split sums to the wall
+        # time exactly (up to the per-phase rounding)
+        if abs(sum(v for v in phases.values()
+                   if isinstance(v, (int, float)))
+               - t["wall_s"]) > 1e-6 * max(len(phases), 1):
+            problems.append(
+                f"phases sum {sum(phases.values()):.9f} != wall_s "
+                f"{t['wall_s']:.9f} — the prefix split tore")
+    if not isinstance(t["update_drift"], list):
+        problems.append(f"update_drift {t['update_drift']!r} not a list")
+    elif t["update_drift"]:
+        if t.get("update_rel_err") != t["update_drift"][-1]:
+            problems.append(
+                f"update_rel_err {t.get('update_rel_err')!r} is not the "
+                f"drift series' final point {t['update_drift'][-1]!r}")
+    elif "update_rel_err" in t:
+        problems.append("update_rel_err present without a drift series")
+    if "wire" in t and not isinstance(t["wire"], dict):
+        problems.append(f"wire summary {t['wire']!r} not a dict")
+    if "mesh" in rec.extras and not isinstance(rec.extras["mesh"], str):
+        problems.append(f"extras['mesh'] {rec.extras['mesh']!r} not a "
+                        "mesh spec string")
+    return problems
+
+
 def bench_one(config: BenchConfig, mesh, targs: TrainArgs,
               size: int) -> BenchmarkRecord:
     """Measure one (mode, mesh, size) train cell → BenchmarkRecord."""

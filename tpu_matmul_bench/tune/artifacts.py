@@ -309,6 +309,20 @@ class ArtifactStore:
             problems.append((self.manifest_path, lineno_err))
         for rec in self.records():
             where = f"artifact:{rec.get('key', '?')[:12]}"
+            # full manifest-row coverage: every key put() writes must be
+            # present and well-typed (the schema certifier's SCHEMA-002
+            # contract — this validator may not lag the producer)
+            for key, kind in (("record_type", str), ("schema", int),
+                              ("impl", str), ("device_kind", str),
+                              ("blob_digest", str), ("size_bytes", int),
+                              ("created_at", str), ("blocks", (list,
+                                                               type(None)))):
+                v = rec.get(key, None)
+                if key not in rec or not isinstance(v, kind) \
+                        or isinstance(v, bool):
+                    problems.append(
+                        (where, f"manifest row lacks a well-typed "
+                                f"{key!r} (got {v!r})"))
             prob = rec.get("problem") or {}
             try:
                 fp = problem_fingerprint(prob["m"], prob["k"], prob["n"],

@@ -428,6 +428,24 @@ class TuningDB:
         for cell in self.cells():
             label = f"{cell.dtype}@{cell.m}x{cell.k}x{cell.n}" \
                     f"/{cell.device_kind}"
+            # the durability contract: what this cell would serialize as
+            # must survive load()'s record filters, or the promotion
+            # silently vanishes from the store on the next boot
+            rec = cell.to_record()
+            if rec.get("record_type") != "tune_cell":
+                problems.append(f"{label}: record_type "
+                                f"{rec.get('record_type')!r} would be "
+                                "dropped by load()")
+            if rec.get("schema") != CELL_SCHEMA:
+                problems.append(f"{label}: schema {rec.get('schema')!r} "
+                                f"!= {CELL_SCHEMA}")
+            if rec.get("fingerprint") != cell.fingerprint:
+                problems.append(f"{label}: serialized fingerprint "
+                                f"{rec.get('fingerprint')!r} does not "
+                                "recompute — load() would reject it")
+            if Cell.from_record(rec).key != cell.key:
+                problems.append(f"{label}: record round-trip loses the "
+                                "cell's (fingerprint, device) identity")
             if cell.impl not in ("xla", "pallas"):
                 problems.append(f"{label}: unknown impl {cell.impl!r}")
             if cell.impl == "pallas" and not cell.blocks:
